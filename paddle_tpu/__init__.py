@@ -1,0 +1,64 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle Fluid (reference: wang-kangkang/Paddle @ Fluid 1.2),
+re-designed for JAX/XLA/Pallas/pjit.
+
+Architecture (vs the reference, see SURVEY.md):
+  * Python builds a Program IR (core/framework.py) — parity with
+    ProgramDesc/BlockDesc/OpDesc — but execution traces the whole program
+    into ONE jitted XLA computation (core/executor.py); the per-op C++
+    interpreter loop, kernel registry, SSA graph executors, memory
+    transpilers and NCCL op-handles of the reference are deleted by design.
+  * Gradients: program-level grad ops (core/backward.py) whose default
+    lowering is jax.vjp of the forward lowering (core/registry.py).
+  * Parallelism: jax.sharding.Mesh + NamedSharding/pjit (compiler.py,
+    parallel/) instead of ParallelExecutor/DistributeTranspiler RPC.
+"""
+
+from . import ops  # registers all op lowerings  # noqa: F401
+
+from .core.framework import (  # noqa: F401
+    Program,
+    Block,
+    Variable,
+    Parameter,
+    Operator,
+    program_guard,
+    default_main_program,
+    default_startup_program,
+    switch_main_program,
+    switch_startup_program,
+    unique_name,
+    grad_var_name,
+    OpRole,
+    VarType,
+)
+from .core.executor import (  # noqa: F401
+    Executor,
+    Scope,
+    global_scope,
+    CPUPlace,
+    TPUPlace,
+    Place,
+    default_place,
+    as_numpy,
+)
+from .core.backward import append_backward, calc_gradient  # noqa: F401
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import nets  # noqa: F401
+from . import io  # noqa: F401
+from . import metrics  # noqa: F401
+from . import profiler  # noqa: F401
+from .core import registry  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    return calc_gradient(targets, inputs, target_gradients, no_grad_set)

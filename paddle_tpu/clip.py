@@ -1,0 +1,162 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm,
+ErrorClipByValue, set_gradient_clip)."""
+
+from __future__ import annotations
+
+from .core import framework as fw
+from .layer_helper import LayerHelper
+
+
+class BaseErrorClipAttr:
+    def append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def append_clip_op(self, block, grad_name):
+        block.append_op(
+            "clip",
+            inputs={"X": [grad_name]},
+            outputs={"Out": [grad_name]},
+            attrs={"min": self.min, "max": self.max},
+        )
+
+
+class GradientClipBase:
+    def _process(self, param, grad):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _process(self, param, grad):
+        helper = LayerHelper("clip_grad")
+        out = helper.create_variable_for_type_inference(grad.dtype)
+        grad.block.append_op(
+            "clip",
+            inputs={"X": [grad]},
+            outputs={"Out": [out]},
+            attrs={"min": self.min, "max": self.max,
+                   fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+        )
+        return out
+
+
+class GradientClipByNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _process(self, param, grad):
+        helper = LayerHelper("clip_grad_norm")
+        out = helper.create_variable_for_type_inference(grad.dtype)
+        grad.block.append_op(
+            "clip_by_norm",
+            inputs={"X": [grad]},
+            outputs={"Out": [out]},
+            attrs={"max_norm": self.clip_norm,
+                   fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+        )
+        return out
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    """scale_i = clip_norm / max(global_norm, clip_norm) applied to every
+    grad (reference: clip.py GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _process_all(self, params_grads):
+        helper = LayerHelper("global_norm_clip")
+        block = None
+        sq_norms = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            block = g.block
+            sq = helper.create_variable_for_type_inference(g.dtype)
+            block.append_op(
+                "squared_l2_norm", inputs={"X": [g]}, outputs={"Out": [sq]},
+                attrs={fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+            )
+            sq_norms.append(sq)
+        if block is None:
+            return params_grads
+        total = helper.create_variable_for_type_inference("float32")
+        block.append_op("sum", inputs={"X": sq_norms}, outputs={"Out": [total]},
+                        attrs={fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward})
+        gnorm = helper.create_variable_for_type_inference("float32")
+        block.append_op("sqrt", inputs={"X": [total]}, outputs={"Out": [gnorm]},
+                        attrs={fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward})
+        # denom = max(global_norm, clip_norm); scale = clip_norm / denom
+        clip_var = helper.create_variable_for_type_inference("float32")
+        block.append_op(
+            "fill_constant", outputs={"Out": [clip_var]},
+            attrs={"shape": [1], "value": float(self.clip_norm),
+                   "dtype": "float32",
+                   fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+        )
+        denom = helper.create_variable_for_type_inference("float32")
+        block.append_op(
+            "elementwise_max", inputs={"X": [gnorm], "Y": [clip_var]},
+            outputs={"Out": [denom]},
+            attrs={fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+        )
+        scale = helper.create_variable_for_type_inference("float32")
+        block.append_op(
+            "elementwise_div", inputs={"X": [clip_var], "Y": [denom]},
+            outputs={"Out": [scale]},
+            attrs={fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+        )
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            ng = helper.create_variable_for_type_inference(g.dtype)
+            g.block.append_op(
+                "elementwise_mul", inputs={"X": [g], "Y": [scale]},
+                outputs={"Out": [ng]},
+                attrs={fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+            )
+            out.append((p, ng))
+        return out
+
+
+_global_clip = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    global _global_clip
+    _global_clip = clip
+    if param_list:
+        for p in param_list:
+            if isinstance(p, str):
+                p = fw.default_main_program().global_block().var(p)
+            p.gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(param_grads):
+    global _global_clip
+    if _global_clip is None and not any(
+        getattr(p, "gradient_clip_attr", None) for p, g in param_grads
+    ):
+        return param_grads
+    if isinstance(_global_clip, GradientClipByGlobalNorm):
+        return _global_clip._process_all(param_grads)
+    out = []
+    for p, g in param_grads:
+        clip = getattr(p, "gradient_clip_attr", None) or _global_clip
+        if g is None or clip is None or isinstance(clip, GradientClipByGlobalNorm):
+            out.append((p, g))
+            continue
+        out.append((p, clip._process(p, g)))
+    return out

@@ -1,0 +1,1 @@
+from . import framework, registry, executor, backward  # noqa: F401

@@ -1,0 +1,236 @@
+"""Program-level autodiff: append_backward.
+
+Capability parity with the reference (python/paddle/fluid/backward.py:394
+`append_backward`, :135 `_addup_repetitive_outputs_`, :204 no-grad pruning),
+TPU-first: grad ops default to vjp-of-forward lowerings (registry.py), so the
+generated backward program is both introspectable IR *and* exactly XLA's
+gradient when compiled.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import framework as fw
+from . import registry
+
+
+def _forward_slice(block: fw.Block, loss_name: str) -> List[int]:
+    """Indices of ops that (transitively) contribute to loss, in order."""
+    needed = {loss_name}
+    keep = []
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if any(o in needed for o in op.output_arg_names()):
+            keep.append(i)
+            needed.update(n for n in op.input_arg_names() if n)
+    return list(reversed(keep))
+
+
+def _collect_no_grad(
+    block: fw.Block, extra: Optional[Set[str]], want_grads: Optional[Set[str]] = None
+) -> Set[str]:
+    """want_grads: vars that must receive grads even if stop_gradient/is_data
+    (calc_gradient asks for grads of arbitrary vars, incl. data)."""
+    want = want_grads or set()
+    no_grad = set(extra or ()) - want
+    for v in block.vars.values():
+        if (v.stop_gradient or v.is_data) and v.name not in want:
+            no_grad.add(v.name)
+    for op in block.ops:
+        opdef = registry.lookup(op.type)
+        if opdef is not None and opdef.no_grad:
+            no_grad.update(n for n in op.output_arg_names() if n not in want)
+    return no_grad
+
+
+def append_backward(
+    loss: fw.Variable,
+    parameter_list: Optional[Sequence[str]] = None,
+    no_grad_set: Optional[Set[str]] = None,
+    callbacks=None,
+    _want_grads: Optional[Set[str]] = None,
+) -> List[Tuple[fw.Parameter, fw.Variable]]:
+    """Append grad ops for `loss` to its program; return [(param, grad)]."""
+    block = loss.block
+    program = block.program
+    loss_name = loss.name
+
+    fwd_idx = _forward_slice(block, loss_name)
+    no_grad = _collect_no_grad(block, no_grad_set, _want_grads)
+
+    # var -> list of grad var names contributed by already-processed consumers
+    contribs: Dict[str, List[str]] = {}
+    loss_grad = fw.grad_var_name(loss_name)
+    block.create_var(
+        name=loss_grad, shape=loss.shape, dtype=loss.dtype, stop_gradient=True
+    )
+    block.append_op(
+        "fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={
+            "shape": list(loss.shape or [1]),
+            "value": 1.0,
+            "dtype": loss.dtype,
+            fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward | fw.OpRole.Loss,
+        },
+    )
+    contribs[loss_name] = [loss_grad]
+
+    def _ensure_var(name: str, like: Optional[str] = None):
+        if not name or block.has_var_recursive(name):
+            return
+        proto = block._find_var_recursive(like) if like else None
+        block.create_var(
+            name=name,
+            shape=proto.shape if proto is not None else None,
+            dtype=proto.dtype if proto is not None else "float32",
+            stop_gradient=True,
+        )
+
+    def _materialize_grad(var_name: str) -> Optional[str]:
+        """Combine contributions for var_name into its canonical grad var."""
+        lst = contribs.get(var_name)
+        if not lst:
+            return None
+        gname = fw.grad_var_name(var_name)
+        if len(lst) == 1:
+            if lst[0] != gname:
+                _ensure_var(gname, like=var_name)
+                block.append_op(
+                    "assign",
+                    inputs={"X": [lst[0]]},
+                    outputs={"Out": [gname]},
+                    attrs={fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+                )
+            contribs[var_name] = [gname]
+            return gname
+        # multiple consumers: sum (reference _addup_repetitive_outputs_)
+        _ensure_var(gname, like=var_name)
+        block.append_op(
+            "sum",
+            inputs={"X": list(lst)},
+            outputs={"Out": [gname]},
+            attrs={fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+        )
+        contribs[var_name] = [gname]
+        return gname
+
+    processed_grad_names: Set[str] = {loss_grad}
+
+    for i in reversed(fwd_idx):
+        op = block.ops[i]
+        opdef = registry.lookup(op.type)
+        if opdef is not None and opdef.no_grad:
+            continue
+        # materialize output grads; skip op if no output contributes
+        out_grads_exist = False
+        for o in op.output_arg_names():
+            if _materialize_grad(o) is not None:
+                out_grads_exist = True
+        if not out_grads_exist:
+            continue
+        # any inputs needing grads?
+        wants = [
+            n
+            for n in op.input_arg_names()
+            if n and n not in no_grad
+        ]
+        if not wants:
+            continue
+
+        maker = (
+            opdef.grad_maker
+            if (opdef is not None and opdef.grad_maker is not None)
+            else registry.default_grad_maker
+        )
+        grad_op_descs = maker(op, no_grad)
+        for desc in grad_op_descs:
+            # rewrite grad outputs that already have contributions (another
+            # consumer already produced grad for the same var): rename + defer
+            # summation to _materialize_grad of the producing op.
+            outputs = {}
+            for slot, names in desc["outputs"].items():
+                new_names = []
+                for gname in names:
+                    if not gname:
+                        new_names.append("")
+                        continue
+                    base = (
+                        gname[: -len(registry.GRAD_SUFFIX)]
+                        if gname.endswith(registry.GRAD_SUFFIX)
+                        else None
+                    )
+                    if base is not None:
+                        lst = contribs.setdefault(base, [])
+                        if gname in processed_grad_names or lst:
+                            gname_new = f"{gname}@RENAME_{i}_{len(lst)}"
+                            _ensure_var(gname_new, like=base)
+                            lst.append(gname_new)
+                            new_names.append(gname_new)
+                            continue
+                        lst.append(gname)
+                    _ensure_var(gname, like=base)
+                    processed_grad_names.add(gname)
+                    new_names.append(gname)
+                outputs[slot] = new_names
+            # ensure grad input vars exist (zeros-holes handled by lowering)
+            inputs = {}
+            for slot, names in desc["inputs"].items():
+                kept = []
+                for n in names:
+                    if n.endswith(registry.GRAD_SUFFIX) and not block.has_var_recursive(n):
+                        # this fwd output got no grad: leave a hole
+                        kept.append("")
+                    else:
+                        kept.append(n)
+                inputs[slot] = kept
+            block.append_op(desc["type"], inputs=inputs, outputs=outputs, attrs=desc["attrs"])
+
+    # finalize grads for explicitly-requested vars (calc_gradient targets
+    # have no producing op, so their contributions are combined here)
+    for name in _want_grads or ():
+        _materialize_grad(name)
+
+    # finalize grads for parameters (and any leftover multi-contribs)
+    params = (
+        [block.program.global_block().vars[p] for p in parameter_list]
+        if parameter_list
+        else block.program.all_parameters()
+    )
+    param_grads: List[Tuple[fw.Parameter, fw.Variable]] = []
+    for p in params:
+        if p.name in no_grad or not getattr(p, "trainable", True):
+            continue
+        gname = _materialize_grad(p.name)
+        if gname is None:
+            continue
+        gvar = block._find_var_recursive(gname)
+        if gvar.shape is None:
+            gvar.shape = p.shape
+            gvar.dtype = p.dtype
+        param_grads.append((p, gvar))
+    return param_grads
+
+
+def calc_gradient(
+    targets, inputs, target_gradients=None, no_grad_set=None
+) -> List[Optional[fw.Variable]]:
+    """Gradients of `targets` w.r.t. arbitrary `inputs` (reference:
+    backward.py:685 calc_gradient / gradients API)."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    assert len(targets) == 1, "calc_gradient: single target supported"
+    loss = targets[0]
+    block = loss.block
+    want = {i.name for i in inputs}
+    append_backward(
+        loss, no_grad_set=set(no_grad_set or ()) - want, _want_grads=want
+    )
+    out = []
+    for iv in inputs:
+        g = block._find_var_recursive(fw.grad_var_name(iv.name))
+        out.append(g)
+    return out

@@ -1,0 +1,625 @@
+"""Program IR: the Python-visible intermediate representation.
+
+Capability parity with the reference's ProgramDesc/BlockDesc/OpDesc/VarDesc
+(reference: paddle/fluid/framework/framework.proto:43-188, program_desc.h:30,
+block_desc.h:38, op_desc.h:29 and python/paddle/fluid/framework.py:231-1505),
+redesigned TPU-first:
+
+  * The IR exists for *introspection and transformation* (autodiff, transpilers,
+    pruning, serialization) — NOT for per-op interpretation.  Execution lowers a
+    whole block to a single JAX function which XLA compiles for TPU; there is no
+    op-by-op runtime loop (contrast executor.cc:448 in the reference).
+  * Every registered op carries a JAX lowering; gradients come from grad-op
+    makers that default to `jax.vjp` of the forward lowering (see registry.py),
+    so the IR stays honest while XLA owns execution.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import copy
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Versioning (reference: framework.proto:24 `Version`, framework/version.h)
+# ---------------------------------------------------------------------------
+
+PROGRAM_IR_VERSION = 1
+
+
+def is_program_version_supported(version: int) -> bool:
+    return 0 <= version <= PROGRAM_IR_VERSION
+
+
+# ---------------------------------------------------------------------------
+# unique_name (reference: python/paddle/fluid/unique_name.py)
+# ---------------------------------------------------------------------------
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.ids: Dict[str, int] = collections.defaultdict(int)
+
+    def __call__(self, key: str) -> str:
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return self.prefix + "_".join([key, str(tmp)])
+
+
+_name_generator = UniqueNameGenerator()
+
+
+def unique_name(key: str) -> str:
+    return _name_generator(key)
+
+
+@contextlib.contextmanager
+def guard_unique_name(new_generator: Optional[UniqueNameGenerator] = None):
+    global _name_generator
+    old = _name_generator
+    _name_generator = new_generator or UniqueNameGenerator()
+    try:
+        yield
+    finally:
+        _name_generator = old
+
+
+# ---------------------------------------------------------------------------
+# Var types (reference: framework.proto:105-164 VarType; 19 kinds)
+# ---------------------------------------------------------------------------
+
+
+class VarType:
+    """Variable kinds.  DENSE_TENSOR subsumes the reference's LOD_TENSOR —
+    ragged sequences are represented TPU-idiomatically as dense padding +
+    segment ids (see SURVEY.md §5.7) rather than LoD offset tables."""
+
+    DENSE_TENSOR = "dense_tensor"
+    SELECTED_ROWS = "selected_rows"  # sparse row-set gradients (embedding)
+    STEP_SCOPES = "step_scopes"
+    READER = "reader"
+    RAW = "raw"
+
+
+class OpRole:
+    """Op role attrs used by transpilers/optimizer passes (reference:
+    framework.py OpRole / op_proto_maker.h OpRole)."""
+
+    Forward = 0
+    Backward = 1
+    Optimize = 2
+    RPC = 3
+    Dist = 4
+    LRSched = 16
+    Loss = 256
+
+    ROLE_ATTR_NAME = "op_role"
+    ROLE_VAR_ATTR_NAME = "op_role_var"
+
+
+_dtype_aliases = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "bfloat16": "bfloat16",
+    "int8": np.int8,
+    "uint8": np.uint8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+
+def convert_dtype(dtype) -> str:
+    """Normalize dtype spec to a canonical string name."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        if dtype in _dtype_aliases:
+            return dtype
+        return np.dtype(dtype).name
+    try:
+        import jax.numpy as jnp
+
+        if dtype == jnp.bfloat16:
+            return "bfloat16"
+    except Exception:  # pragma: no cover
+        pass
+    return np.dtype(dtype).name
+
+
+# ---------------------------------------------------------------------------
+# Variable (reference: framework.py:231 Variable, var_desc.h)
+# ---------------------------------------------------------------------------
+
+
+class Variable:
+    def __init__(
+        self,
+        block: "Block",
+        name: str,
+        shape: Optional[Sequence[int]] = None,
+        dtype: Any = "float32",
+        type: str = VarType.DENSE_TENSOR,
+        persistable: bool = False,
+        stop_gradient: bool = False,
+        is_data: bool = False,
+        initializer=None,
+    ):
+        self.block = block
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.type = type
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.initializer = initializer
+
+    # -- introspection --------------------------------------------------
+    @property
+    def ndim(self):
+        return len(self.shape) if self.shape is not None else None
+
+    def __repr__(self):
+        return (
+            f"Variable(name={self.name!r}, shape={self.shape}, dtype={self.dtype},"
+            f" persistable={self.persistable})"
+        )
+
+    __str__ = __repr__
+
+    def to_dict(self):
+        return {
+            "name": self.name,
+            "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": self.dtype,
+            "type": self.type,
+            "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient,
+            "is_data": self.is_data,
+        }
+
+    @staticmethod
+    def from_dict(block, d):
+        return Variable(
+            block,
+            d["name"],
+            shape=d["shape"],
+            dtype=d["dtype"],
+            type=d.get("type", VarType.DENSE_TENSOR),
+            persistable=d.get("persistable", False),
+            stop_gradient=d.get("stop_gradient", False),
+            is_data=d.get("is_data", False),
+        )
+
+
+class Parameter(Variable):
+    """Trainable variable (reference: framework.py Parameter).  Carries
+    optimize/regularization attributes consumed by Optimizer."""
+
+    def __init__(self, block, name, shape, dtype, **kwargs):
+        self.trainable = kwargs.pop("trainable", True)
+        self.regularizer = kwargs.pop("regularizer", None)
+        self.gradient_clip_attr = kwargs.pop("gradient_clip_attr", None)
+        self.do_model_average = kwargs.pop("do_model_average", False)
+        kwargs.setdefault("persistable", True)
+        super().__init__(block, name, shape=shape, dtype=dtype, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Operator (reference: framework.py:545 Operator, op_desc.h:29)
+# ---------------------------------------------------------------------------
+
+
+class Operator:
+    def __init__(
+        self,
+        block: "Block",
+        type: str,
+        inputs: Optional[Dict[str, List[str]]] = None,
+        outputs: Optional[Dict[str, List[str]]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        from . import registry
+
+        self.block = block
+        self.type = type
+        self.inputs: Dict[str, List[str]] = {}
+        self.outputs: Dict[str, List[str]] = {}
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+
+        def _norm(io):
+            out = {}
+            for slot, vs in (io or {}).items():
+                if vs is None:
+                    out[slot] = []
+                    continue
+                if isinstance(vs, (Variable, str)):
+                    vs = [vs]
+                out[slot] = [v.name if isinstance(v, Variable) else v for v in vs]
+            return out
+
+        self.inputs = _norm(inputs)
+        self.outputs = _norm(outputs)
+
+        opdef = registry.lookup(type)
+        if opdef is not None and opdef.infer_shape is not None:
+            try:
+                opdef.infer_shape(InferShapeContext(self))
+            except Exception:
+                # Runtime lowering will catch real shape errors with good
+                # messages; build-time inference is best-effort.
+                pass
+
+    # -- slot access -----------------------------------------------------
+    def input(self, slot: str) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot: str) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    def input_arg_names(self) -> List[str]:
+        return [n for vs in self.inputs.values() for n in vs]
+
+    def output_arg_names(self) -> List[str]:
+        return [n for vs in self.outputs.values() for n in vs]
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def set_attr(self, name: str, val):
+        self.attrs[name] = val
+
+    def __repr__(self):
+        ins = {k: v for k, v in self.inputs.items() if v}
+        outs = {k: v for k, v in self.outputs.items() if v}
+        return f"Op(type={self.type}, inputs={ins}, outputs={outs})"
+
+    __str__ = __repr__
+
+    def to_dict(self):
+        attrs = {}
+        for k, v in self.attrs.items():
+            if isinstance(v, np.ndarray):
+                attrs[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+            elif isinstance(v, Block):
+                attrs[k] = {"__block__": v.idx}
+            else:
+                attrs[k] = v
+        return {
+            "type": self.type,
+            "inputs": self.inputs,
+            "outputs": self.outputs,
+            "attrs": attrs,
+        }
+
+    @staticmethod
+    def from_dict(block, d):
+        attrs = {}
+        for k, v in d.get("attrs", {}).items():
+            if isinstance(v, dict) and "__ndarray__" in v:
+                attrs[k] = np.array(v["__ndarray__"], dtype=v["dtype"])
+            elif isinstance(v, dict) and "__block__" in v:
+                attrs[k] = block.program.blocks[v["__block__"]]
+            else:
+                attrs[k] = v
+        op = Operator.__new__(Operator)
+        op.block = block
+        op.type = d["type"]
+        op.inputs = {k: list(v) for k, v in d.get("inputs", {}).items()}
+        op.outputs = {k: list(v) for k, v in d.get("outputs", {}).items()}
+        op.attrs = attrs
+        return op
+
+
+class InferShapeContext:
+    """Build-time shape/dtype inference context handed to op defs
+    (reference: shape_inference.h InferShapeContext)."""
+
+    def __init__(self, op: Operator):
+        self.op = op
+        self.block = op.block
+
+    def input_var(self, slot, i=0) -> Optional[Variable]:
+        names = self.op.input(slot)
+        if i >= len(names):
+            return None
+        return self.block._find_var_recursive(names[i])
+
+    def input_shape(self, slot, i=0):
+        v = self.input_var(slot, i)
+        return v.shape if v is not None else None
+
+    def input_dtype(self, slot, i=0):
+        v = self.input_var(slot, i)
+        return v.dtype if v is not None else None
+
+    def set_output(self, slot, shape, dtype=None, i=0):
+        names = self.op.output(slot)
+        if i >= len(names):
+            return
+        v = self.block._find_var_recursive(names[i])
+        if v is None:
+            return
+        if shape is not None:
+            v.shape = tuple(int(s) for s in shape)
+        if dtype is not None:
+            v.dtype = convert_dtype(dtype)
+
+    def attr(self, name, default=None):
+        return self.op.attr(name, default)
+
+
+# ---------------------------------------------------------------------------
+# Block (reference: framework.py:986 Block, block_desc.h:38)
+# ---------------------------------------------------------------------------
+
+
+class Block:
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = collections.OrderedDict()
+        self.ops: List[Operator] = []
+
+    @property
+    def parent_block(self) -> Optional["Block"]:
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
+
+    # -- vars -------------------------------------------------------------
+    def create_var(self, name=None, **kwargs) -> Variable:
+        if name is None:
+            name = unique_name("_generated_var")
+        if name in self.vars:
+            return self.vars[name]
+        v = Variable(self, name, **kwargs)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, name, shape, dtype, **kwargs) -> Parameter:
+        # Parameters live in the top-most (global) block, like the reference.
+        global_block = self.program.global_block()
+        p = Parameter(global_block, name, shape, dtype, **kwargs)
+        global_block.vars[name] = p
+        return p
+
+    def var(self, name: str) -> Variable:
+        v = self.vars.get(name)
+        if v is None:
+            raise KeyError(f"Variable {name!r} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return name in self.vars
+
+    def _find_var_recursive(self, name: str) -> Optional[Variable]:
+        blk = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = blk.parent_block
+        return None
+
+    def has_var_recursive(self, name: str) -> bool:
+        return self._find_var_recursive(name) is not None
+
+    # -- ops ----------------------------------------------------------------
+    def _bump(self):
+        self.program._mod_count += 1
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        self._bump()
+        return op
+
+    def prepend_op(self, type, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(0, op)
+        self._bump()
+        return op
+
+    def insert_op(self, index, type, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(index, op)
+        self._bump()
+        return op
+
+    def remove_op(self, index):
+        del self.ops[index]
+        self._bump()
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def to_dict(self):
+        return {
+            "idx": self.idx,
+            "parent_idx": self.parent_idx,
+            "vars": [v.to_dict() for v in self.vars.values()],
+            "ops": [op.to_dict() for op in self.ops],
+        }
+
+    def __repr__(self):
+        lines = [f"Block {self.idx} (parent {self.parent_idx})"]
+        for v in self.vars.values():
+            lines.append(f"  {v}")
+        for op in self.ops:
+            lines.append(f"  {op}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Program (reference: framework.py:1505 Program, program_desc.h:30)
+# ---------------------------------------------------------------------------
+
+
+class Program:
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.version = PROGRAM_IR_VERSION
+        self.random_seed = 0
+        self._is_test = False
+        self._mod_count = 0  # mutation stamp; part of the executor cache key
+        # feed/fetch metadata for inference serialization
+        self.feed_var_names: List[str] = []
+        self.fetch_var_names: List[str] = []
+
+    # -- blocks -----------------------------------------------------------
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self.current_block_idx]
+
+    def _create_block(self, parent_idx=None) -> Block:
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self.current_block_idx = b.idx
+        return b
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    # -- introspection / transforms ----------------------------------------
+    def all_parameters(self) -> List[Parameter]:
+        return self.global_block().all_parameters()
+
+    def list_vars(self):
+        for blk in self.blocks:
+            yield from blk.vars.values()
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = copy.deepcopy(self)
+        if for_test:
+            p._is_test = True
+            for blk in p.blocks:
+                for op in blk.ops:
+                    if "is_test" in op.attrs or op.type in ("dropout", "batch_norm"):
+                        op.attrs["is_test"] = True
+        return p
+
+    def prune(self, targets: Sequence[str]) -> "Program":
+        """Backward-slice the program to ops needed for `targets`
+        (reference: Program._prune / prune_impl framework.py)."""
+        p = self.clone()
+        blk = p.global_block()
+        needed = set(targets)
+        kept = []
+        for op in reversed(blk.ops):
+            if any(o in needed for o in op.output_arg_names()):
+                kept.append(op)
+                needed.update(op.input_arg_names())
+        blk.ops = list(reversed(kept))
+        # drop unreferenced non-persistable vars
+        referenced = set()
+        for op in blk.ops:
+            referenced.update(op.input_arg_names())
+            referenced.update(op.output_arg_names())
+        blk.vars = collections.OrderedDict(
+            (n, v)
+            for n, v in blk.vars.items()
+            if n in referenced or v.persistable or n in targets
+        )
+        return p
+
+    # -- serialization -------------------------------------------------------
+    def to_dict(self):
+        return {
+            "version": self.version,
+            "random_seed": self.random_seed,
+            "blocks": [b.to_dict() for b in self.blocks],
+            "feed_var_names": self.feed_var_names,
+            "fetch_var_names": self.fetch_var_names,
+        }
+
+    def serialize_to_string(self) -> bytes:
+        return json.dumps(self.to_dict()).encode("utf-8")
+
+    @staticmethod
+    def parse_from_string(s: bytes) -> "Program":
+        d = json.loads(s.decode("utf-8"))
+        if not is_program_version_supported(d.get("version", 0)):
+            raise ValueError(f"unsupported program version {d.get('version')}")
+        p = Program()
+        p.version = d.get("version", PROGRAM_IR_VERSION)
+        p.random_seed = d.get("random_seed", 0)
+        p.feed_var_names = d.get("feed_var_names", [])
+        p.fetch_var_names = d.get("fetch_var_names", [])
+        p.blocks = []
+        for bd in d["blocks"]:
+            b = Block(p, bd["idx"], bd["parent_idx"])
+            p.blocks.append(b)
+        for b, bd in zip(p.blocks, d["blocks"]):
+            for vd in bd["vars"]:
+                v = Variable.from_dict(b, vd)
+                b.vars[v.name] = v
+            for od in bd["ops"]:
+                b.ops.append(Operator.from_dict(b, od))
+        return p
+
+    def fingerprint(self) -> str:
+        import hashlib
+
+        return hashlib.sha256(self.serialize_to_string()).hexdigest()
+
+    def __repr__(self):
+        return "\n".join(repr(b) for b in self.blocks)
+
+
+# ---------------------------------------------------------------------------
+# default programs + guards (reference: framework.py program_guard)
+# ---------------------------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def switch_main_program(program: Program) -> Program:
+    global _main_program
+    prev, _main_program = _main_program, program
+    return prev
+
+
+def switch_startup_program(program: Program) -> Program:
+    global _startup_program
+    prev, _startup_program = _startup_program, program
+    return prev
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    prev_main = switch_main_program(main_program)
+    prev_startup = None
+    if startup_program is not None:
+        prev_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(prev_main)
+        if prev_startup is not None:
+            switch_startup_program(prev_startup)
+
+
+def grad_var_name(name: str) -> str:
+    return name + "@GRAD"

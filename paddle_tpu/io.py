@@ -1,0 +1,218 @@
+"""Model/param save-load + inference model serialization
+(reference: python/paddle/fluid/io.py:89-843 — save/load_vars/params/
+persistables, save/load_inference_model; operators/save_op.cc tensor format).
+
+TPU-first: tensors serialize via numpy `.npz`-style files (one file per var or
+combined), programs via the JSON IR (framework.py).  The reference's
+per-tensor version header + LoD payload maps to numpy's self-describing
+format; checkpoint/resume of optimizer accumulators works because they are
+persistable Scope vars, exactly like the reference (SURVEY.md §5.4)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core import framework as fw
+from .core.executor import Scope, global_scope
+
+SAVE_FORMAT_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# var save/load
+# ---------------------------------------------------------------------------
+
+
+def _is_persistable(var: fw.Variable) -> bool:
+    return var.persistable and not var.is_data
+
+
+def _is_parameter(var: fw.Variable) -> bool:
+    return isinstance(var, fw.Parameter)
+
+
+def save_vars(
+    executor,
+    dirname,
+    main_program: Optional[fw.Program] = None,
+    vars: Optional[Sequence[fw.Variable]] = None,
+    predicate=None,
+    filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    main_program = main_program or fw.default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [
+            v
+            for v in main_program.list_vars()
+            if predicate is None or predicate(v)
+        ]
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        if str(arr.dtype) == "bfloat16":
+            arrays[v.name] = {"data": arr.astype(np.float32), "dtype": "bfloat16"}
+        else:
+            arrays[v.name] = {"data": arr, "dtype": str(arr.dtype)}
+    if filename is not None:
+        np.savez(
+            os.path.join(dirname, filename),
+            **{k: d["data"] for k, d in arrays.items()},
+        )
+        meta = {k: d["dtype"] for k, d in arrays.items()}
+        with open(os.path.join(dirname, filename + ".meta"), "w") as f:
+            json.dump({"version": SAVE_FORMAT_VERSION, "dtypes": meta}, f)
+    else:
+        for k, d in arrays.items():
+            np.save(os.path.join(dirname, k.replace("/", "__")), d["data"])
+            with open(os.path.join(dirname, k.replace("/", "__") + ".meta"), "w") as f:
+                json.dump({"version": SAVE_FORMAT_VERSION, "dtype": d["dtype"]}, f)
+
+
+def load_vars(
+    executor,
+    dirname,
+    main_program: Optional[fw.Program] = None,
+    vars: Optional[Sequence[fw.Variable]] = None,
+    predicate=None,
+    filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    import jax.numpy as jnp
+
+    main_program = main_program or fw.default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [
+            v
+            for v in main_program.list_vars()
+            if predicate is None or predicate(v)
+        ]
+    if filename is not None:
+        path = os.path.join(dirname, filename)
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        data = np.load(path)
+        meta = {}
+        mp = os.path.join(dirname, filename + ".meta")
+        if os.path.exists(mp):
+            with open(mp) as f:
+                meta = json.load(f).get("dtypes", {})
+        for v in vars:
+            if v.name in data:
+                arr = data[v.name]
+                val = jnp.asarray(arr)
+                if meta.get(v.name) == "bfloat16":
+                    val = val.astype(jnp.bfloat16)
+                scope.set_var(v.name, val)
+    else:
+        for v in vars:
+            p = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
+            if os.path.exists(p):
+                arr = np.load(p)
+                val = jnp.asarray(arr)
+                mp = os.path.join(dirname, v.name.replace("/", "__") + ".meta")
+                if os.path.exists(mp):
+                    with open(mp) as f:
+                        if json.load(f).get("dtype") == "bfloat16":
+                            val = val.astype(jnp.bfloat16)
+                scope.set_var(v.name, val)
+
+
+def save_params(executor, dirname, main_program=None, filename=None, scope=None):
+    return save_vars(
+        executor, dirname, main_program, predicate=_is_parameter,
+        filename=filename, scope=scope,
+    )
+
+
+def load_params(executor, dirname, main_program=None, filename=None, scope=None):
+    return load_vars(
+        executor, dirname, main_program, predicate=_is_parameter,
+        filename=filename, scope=scope,
+    )
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None, scope=None):
+    """Parameters AND optimizer accumulators / BN stats (reference io.py:270)."""
+    return save_vars(
+        executor, dirname, main_program, predicate=_is_persistable,
+        filename=filename, scope=scope,
+    )
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None, scope=None):
+    return load_vars(
+        executor, dirname, main_program, predicate=_is_persistable,
+        filename=filename, scope=scope,
+    )
+
+
+# ---------------------------------------------------------------------------
+# inference model (reference io.py:570 save_inference_model, :704 load)
+# ---------------------------------------------------------------------------
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names: List[str],
+    target_vars: List[fw.Variable],
+    executor,
+    main_program: Optional[fw.Program] = None,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    main_program = main_program or fw.default_main_program()
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+
+    pruned = main_program.clone(for_test=True)
+    target_names = [v.name for v in target_vars]
+    pruned = pruned.prune(target_names)
+    pruned.feed_var_names = list(feeded_var_names)
+    pruned.fetch_var_names = target_names
+
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "wb") as f:
+        f.write(pruned.serialize_to_string())
+
+    persist = [v for v in pruned.list_vars() if _is_persistable(v)]
+    save_vars(
+        executor, dirname, pruned, vars=persist,
+        filename=params_filename or "__params__", scope=scope,
+    )
+    return target_names
+
+
+def load_inference_model(
+    dirname,
+    executor,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    scope = scope or global_scope()
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "rb") as f:
+        program = fw.Program.parse_from_string(f.read())
+    program._is_test = True
+    persist = [v for v in program.list_vars() if _is_persistable(v)]
+    load_vars(
+        executor, dirname, program, vars=persist,
+        filename=params_filename or "__params__", scope=scope,
+    )
+    fetch_vars = [
+        program.global_block()._find_var_recursive(n)
+        for n in program.fetch_var_names
+    ]
+    return program, list(program.feed_var_names), fetch_vars

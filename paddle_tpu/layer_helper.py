@@ -1,0 +1,132 @@
+"""LayerHelper: shared machinery for layer functions
+(reference: python/paddle/fluid/layer_helper.py:55,289)."""
+
+from __future__ import annotations
+
+from .core import framework as fw
+from .initializer import ConstantInitializer, XavierInitializer
+from .param_attr import ParamAttr
+
+
+class LayerHelper:
+    def __init__(self, layer_type, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        name = kwargs.get("name")
+        self.name = name if name is not None else fw.unique_name(layer_type)
+
+    @property
+    def main_program(self) -> fw.Program:
+        return fw.default_main_program()
+
+    @property
+    def startup_program(self) -> fw.Program:
+        return fw.default_startup_program()
+
+    # -- params -----------------------------------------------------------
+    def param_attr(self):
+        return ParamAttr._to_attr(self.kwargs.get("param_attr"))
+
+    def bias_attr(self):
+        return ParamAttr._to_attr(self.kwargs.get("bias_attr"))
+
+    def create_parameter(
+        self,
+        attr,
+        shape,
+        dtype,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        if attr.name is None:
+            attr.name = fw.unique_name(".".join([self.name, "b" if is_bias else "w"]))
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = ConstantInitializer(0.0) if is_bias else XavierInitializer()
+
+        main_block = self.main_program.global_block()
+        param = main_block.create_parameter(
+            attr.name,
+            shape,
+            dtype,
+            trainable=attr.trainable,
+            regularizer=attr.regularizer,
+            gradient_clip_attr=attr.gradient_clip,
+            do_model_average=attr.do_model_average,
+        )
+        param.optimize_attr = {"learning_rate": attr.learning_rate}
+        # mirrored param in startup program with its init op
+        startup_block = self.startup_program.global_block()
+        sp = startup_block.create_parameter(
+            attr.name, shape, dtype, trainable=attr.trainable
+        )
+        init(sp, startup_block)
+        return param
+
+    # -- vars -------------------------------------------------------------
+    def create_variable_for_type_inference(self, dtype, stop_gradient=False):
+        return self.main_program.current_block().create_var(
+            name=fw.unique_name(".".join([self.name, "tmp"])),
+            dtype=dtype,
+            stop_gradient=stop_gradient,
+        )
+
+    create_tmp_variable = create_variable_for_type_inference
+
+    def create_variable(self, *args, **kwargs):
+        return self.main_program.current_block().create_var(*args, **kwargs)
+
+    def create_global_variable(self, persistable=False, *args, **kwargs):
+        return self.main_program.global_block().create_var(
+            *args, persistable=persistable, stop_gradient=True, **kwargs
+        )
+
+    def set_variable_initializer(self, var, initializer):
+        """Create the same var in the startup program and init it there."""
+        sb = self.startup_program.global_block()
+        sv = sb.create_var(
+            name=var.name, shape=var.shape, dtype=var.dtype, persistable=True
+        )
+        initializer(sv, sb)
+        return var
+
+    # -- ops --------------------------------------------------------------
+    def append_op(self, *args, **kwargs):
+        return self.main_program.current_block().append_op(*args, **kwargs)
+
+    def input_dtype(self, input_param_name="input"):
+        x = self.kwargs.get(input_param_name)
+        if isinstance(x, (list, tuple)):
+            x = x[0]
+        return x.dtype
+
+    # -- bias/activation epilogues ---------------------------------------
+    def append_bias_op(self, input_var, dim_start=1, dim_end=None):
+        bias_attr = self.bias_attr()
+        if bias_attr is False:
+            return input_var
+        size = list(input_var.shape[dim_start:dim_end])
+        b = self.create_parameter(bias_attr, shape=size, dtype=input_var.dtype, is_bias=True)
+        tmp = self.create_variable_for_type_inference(dtype=input_var.dtype)
+        self.append_op(
+            "elementwise_add",
+            inputs={"X": [input_var], "Y": [b]},
+            outputs={"Out": [tmp]},
+            attrs={"axis": dim_start},
+        )
+        return tmp
+
+    def append_activation(self, input_var):
+        act = self.kwargs.get("act")
+        if act is None:
+            return input_var
+        if isinstance(act, str):
+            act = {"type": act}
+        act = dict(act)
+        act_type = act.pop("type")
+        tmp = self.create_variable_for_type_inference(dtype=input_var.dtype)
+        self.append_op(act_type, inputs={"X": [input_var]}, outputs={"Out": [tmp]}, attrs=act)
+        return tmp

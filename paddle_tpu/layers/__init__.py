@@ -1,0 +1,8 @@
+"""Layers DSL (reference: python/paddle/fluid/layers/__init__.py)."""
+
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from . import nn, tensor, ops  # noqa: F401
+
+from .tensor import data  # noqa: F401

@@ -1,0 +1,38 @@
+"""LeNet-5 MNIST model (reference: benchmark/fluid/models/mnist.py cnn_model
++ benchmark/fluid/mnist.py) — the v0 end-to-end milestone (SURVEY.md §7.2)."""
+
+from __future__ import annotations
+
+from .. import layers, nets
+
+
+def cnn_model(data):
+    conv_pool_1 = nets.simple_img_conv_pool(
+        input=data,
+        filter_size=5,
+        num_filters=20,
+        pool_size=2,
+        pool_stride=2,
+        act="relu",
+    )
+    conv_pool_2 = nets.simple_img_conv_pool(
+        input=conv_pool_1,
+        filter_size=5,
+        num_filters=50,
+        pool_size=2,
+        pool_stride=2,
+        act="relu",
+    )
+    predict = layers.fc(input=conv_pool_2, size=10, act="softmax")
+    return predict
+
+
+def build_train_net(batch_size=None):
+    """Build loss + accuracy graph; returns (img, label, avg_cost, acc)."""
+    img = layers.data(name="pixel", shape=[1, 28, 28], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    predict = cnn_model(img)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(x=cost)
+    acc = layers.accuracy(input=predict, label=label)
+    return img, label, avg_cost, acc, predict

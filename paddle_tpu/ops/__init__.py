@@ -1,0 +1,11 @@
+"""Importing this package registers all op lowerings."""
+
+from . import (  # noqa: F401
+    math_ops,
+    tensor_ops,
+    nn_ops,
+    reduce_ops,
+    random_ops,
+    optimizer_ops,
+    metric_ops,
+)

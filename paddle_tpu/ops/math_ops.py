@@ -1,0 +1,297 @@
+"""Dense math + elementwise + activation ops.
+
+Capability parity with reference op families (paddle/fluid/operators/
+matmul_op.cc, mul_op.cc, elementwise/*, activation_op.cc, scale_op.cc,
+sum_op.cc, clip_op.cc).  TPU-first: every op is one pure JAX lowering; XLA
+fuses elementwise chains into matmul epilogues on the MXU/VPU, which is what
+the reference needed hand-written fused kernels for (fused_elemwise_activation
+et al.).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# Elementwise binary ops with Paddle broadcast semantics
+# (reference: operators/elementwise/elementwise_op_function.h — Y's shape is a
+# contiguous subsequence of X's dims starting at `axis`)
+# ---------------------------------------------------------------------------
+
+
+def _broadcast_y(x, y, axis):
+    if x.shape == y.shape:
+        return y
+    if x.ndim == y.ndim:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + tuple(y.shape) + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def _ew_infer(ctx):
+    xs = ctx.input_shape("X")
+    if xs is not None:
+        ctx.set_output("Out", xs, ctx.input_dtype("X"))
+
+
+def _register_elementwise(name, fn):
+    def lower(ctx, ins, _fn=fn):
+        x = ins["X"][0]
+        y = ins["Y"][0]
+        yb = _broadcast_y(x, y, ctx.attr("axis", -1))
+        return {"Out": [_fn(x, yb)]}
+
+    lower.__name__ = f"lower_{name}"
+    register(name, infer_shape=_ew_infer)(lower)
+
+
+_jnp_ops = None
+
+
+def _install_elementwise():
+    import jax.numpy as jnp
+
+    _register_elementwise("elementwise_add", lambda x, y: x + y)
+    _register_elementwise("elementwise_sub", lambda x, y: x - y)
+    _register_elementwise("elementwise_mul", lambda x, y: x * y)
+    _register_elementwise("elementwise_div", lambda x, y: x / y)
+    _register_elementwise("elementwise_max", jnp.maximum)
+    _register_elementwise("elementwise_min", jnp.minimum)
+    _register_elementwise("elementwise_pow", jnp.power)
+    _register_elementwise(
+        "elementwise_mod",
+        lambda x, y: jnp.mod(x, y) if jnp.issubdtype(x.dtype, jnp.integer) else jnp.fmod(x, y),
+    )
+    _register_elementwise("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y))
+
+
+# ---------------------------------------------------------------------------
+# Unary activations (reference: operators/activation_op.cc ~30 kernels)
+# ---------------------------------------------------------------------------
+
+
+def _register_unary(name, fn):
+    def lower(ctx, ins, _fn=fn):
+        return {"Out": [_fn(ins["X"][0], ctx)]}
+
+    lower.__name__ = f"lower_{name}"
+    register(name, infer_shape=_ew_infer)(lower)
+
+
+def _install_unary():
+    import jax
+    import jax.numpy as jnp
+    from jax import nn as jnn
+
+    u = _register_unary
+    u("relu", lambda x, c: jnn.relu(x))
+    u("relu6", lambda x, c: jnp.clip(x, 0.0, c.attr("threshold", 6.0)))
+    u("sigmoid", lambda x, c: jax.nn.sigmoid(x))
+    u("logsigmoid", lambda x, c: jax.nn.log_sigmoid(x))
+    u("tanh", lambda x, c: jnp.tanh(x))
+    u("tanh_shrink", lambda x, c: x - jnp.tanh(x))
+    u("sqrt", lambda x, c: jnp.sqrt(x))
+    u("rsqrt", lambda x, c: jax.lax.rsqrt(x))
+    u("abs", lambda x, c: jnp.abs(x))
+    u("ceil", lambda x, c: jnp.ceil(x))
+    u("floor", lambda x, c: jnp.floor(x))
+    u("round", lambda x, c: jnp.round(x))
+    u("reciprocal", lambda x, c: 1.0 / x)
+    u("log", lambda x, c: jnp.log(x))
+    u("square", lambda x, c: jnp.square(x))
+    u("exp", lambda x, c: jnp.exp(x))
+    u("sin", lambda x, c: jnp.sin(x))
+    u("cos", lambda x, c: jnp.cos(x))
+    u(
+        "gelu",
+        lambda x, c: jnn.gelu(x, approximate=bool(c.attr("approximate", False))),
+    )
+    u(
+        "leaky_relu",
+        lambda x, c: jnn.leaky_relu(x, negative_slope=c.attr("alpha", 0.02)),
+    )
+    u("elu", lambda x, c: jnn.elu(x, alpha=c.attr("alpha", 1.0)))
+    u(
+        "soft_relu",
+        lambda x, c: jnp.log1p(
+            jnp.exp(jnp.clip(x, -c.attr("threshold", 40.0), c.attr("threshold", 40.0)))
+        ),
+    )
+    u("softplus", lambda x, c: jnn.softplus(x))
+    u("softsign", lambda x, c: x / (1 + jnp.abs(x)))
+    u(
+        "softshrink",
+        lambda x, c: jnp.where(
+            x > c.attr("lambda", 0.5),
+            x - c.attr("lambda", 0.5),
+            jnp.where(x < -c.attr("lambda", 0.5), x + c.attr("lambda", 0.5), 0.0),
+        ),
+    )
+    u(
+        "hard_sigmoid",
+        lambda x, c: jnp.clip(
+            c.attr("slope", 0.2) * x + c.attr("offset", 0.5), 0.0, 1.0
+        ),
+    )
+    u(
+        "thresholded_relu",
+        lambda x, c: jnp.where(x > c.attr("threshold", 1.0), x, 0.0),
+    )
+    u(
+        "hard_shrink",
+        lambda x, c: jnp.where(jnp.abs(x) > c.attr("threshold", 0.5), x, 0.0),
+    )
+    u(
+        "brelu",
+        lambda x, c: jnp.clip(x, c.attr("t_min", 0.0), c.attr("t_max", 24.0)),
+    )
+    u(
+        "swish",
+        lambda x, c: x * jax.nn.sigmoid(c.attr("beta", 1.0) * x),
+    )
+    u("stanh", lambda x, c: c.attr("scale_b", 1.7159) * jnp.tanh(c.attr("scale_a", 2.0 / 3.0) * x))
+    u(
+        "pow",
+        lambda x, c: jnp.power(x, c.attr("factor", 1.0)),
+    )
+    u("logical_not", lambda x, c: jnp.logical_not(x))
+
+
+# ---------------------------------------------------------------------------
+# matmul / mul / scale / sum / clip
+# ---------------------------------------------------------------------------
+
+
+def _matmul_infer(ctx):
+    xs, ys = ctx.input_shape("X"), ctx.input_shape("Y")
+    if xs is None or ys is None:
+        return
+    tx, ty = ctx.attr("transpose_X", False), ctx.attr("transpose_Y", False)
+    xs = list(xs)
+    ys = list(ys)
+    if len(xs) == 1:
+        xs = [1, xs[0]]
+    if len(ys) == 1:
+        ys = [ys[0], 1]
+    if tx:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if ty:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    batch = xs[:-2] if len(xs) > len(ys) else ys[:-2]
+    ctx.set_output("Out", tuple(batch) + (xs[-2], ys[-1]), ctx.input_dtype("X"))
+
+
+@register("matmul", infer_shape=_matmul_infer)
+def lower_matmul(ctx, ins):
+    """Batched matmul w/ transpose + alpha (reference: matmul_op.cc).
+    Maps directly to the MXU via dot_general."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    if ctx.attr("transpose_X", False):
+        axes = list(range(x.ndim))
+        axes[-1], axes[-2] = axes[-2], axes[-1]
+        x = jnp.transpose(x, axes)
+    if ctx.attr("transpose_Y", False):
+        axes = list(range(y.ndim))
+        axes[-1], axes[-2] = axes[-2], axes[-1]
+        y = jnp.transpose(y, axes)
+    out = jnp.matmul(x, y)
+    alpha = ctx.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+def _mul_infer(ctx):
+    xs, ys = ctx.input_shape("X"), ctx.input_shape("Y")
+    if xs is None or ys is None:
+        return
+    nx = ctx.attr("x_num_col_dims", 1)
+    ny = ctx.attr("y_num_col_dims", 1)
+    ctx.set_output("Out", tuple(xs[:nx]) + tuple(ys[ny:]), ctx.input_dtype("X"))
+
+
+@register("mul", infer_shape=_mul_infer)
+def lower_mul(ctx, ins):
+    """2D matmul with leading-dim flattening (reference: mul_op.cc;
+    x_num_col_dims semantics)."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    nx = ctx.attr("x_num_col_dims", 1)
+    ny = ctx.attr("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:nx])), -1))
+    y2 = y.reshape((int(np.prod(ys[:ny])), -1))
+    out = x2 @ y2
+    return {"Out": [out.reshape(tuple(xs[:nx]) + tuple(ys[ny:]))]}
+
+
+@register("scale", infer_shape=_ew_infer)
+def lower_scale(ctx, ins):
+    """out = scale * (x + bias) or scale * x + bias (reference: scale_op.cc)."""
+    x = ins["X"][0]
+    scale = ctx.attr("scale", 1.0)
+    bias = ctx.attr("bias", 0.0)
+    if ctx.attr("bias_after_scale", True):
+        return {"Out": [x * scale + bias]}
+    return {"Out": [(x + bias) * scale]}
+
+
+def _sum_infer(ctx):
+    xs = ctx.input_shape("X")
+    if xs is not None:
+        ctx.set_output("Out", xs, ctx.input_dtype("X"))
+
+
+@register("sum", infer_shape=_sum_infer)
+def lower_sum(ctx, ins):
+    """Add N tensors (reference: sum_op.cc; also sums SelectedRows grads —
+    here sparse grads arrive pre-densified or as IndexedSlices)."""
+    vals = [v for v in ins["X"] if v is not None]
+    out = vals[0]
+    for v in vals[1:]:
+        out = out + v
+    return {"Out": [out]}
+
+
+@register("clip", infer_shape=_ew_infer)
+def lower_clip(ctx, ins):
+    jnp = _jnp()
+    return {
+        "Out": [jnp.clip(ins["X"][0], ctx.attr("min", -1.0), ctx.attr("max", 1.0))]
+    }
+
+
+@register("clip_by_norm", infer_shape=_ew_infer)
+def lower_clip_by_norm(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    max_norm = ctx.attr("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [x * scale]}
+
+
+@register("squared_l2_norm")
+def lower_squared_l2_norm(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.sum(jnp.square(ins["X"][0])).reshape((1,))]}
+
+
+def _install():
+    _install_elementwise()
+    _install_unary()
+
+
+_install()

@@ -1,0 +1,95 @@
+"""Metric + compare/logical ops (reference: operators/metrics/accuracy_op.cc,
+auc_op.cc, controlflow/compare_op.cc, controlflow/logical_op.cc)."""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register("accuracy", no_grad=True)
+def lower_accuracy(ctx, ins):
+    jnp = _jnp()
+    # Inputs: Out (topk values path uses Indices), Indices, Label
+    indices = ins["Indices"][0]
+    label = ins["Label"][0]
+    lbl = label.reshape(-1, 1)
+    correct = jnp.any(indices == lbl, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.asarray(float(indices.shape[0]), jnp.float32)
+    acc = (num_correct / total).astype(jnp.float32)
+    return {
+        "Accuracy": [acc.reshape((1,))],
+        "Correct": [num_correct.astype(jnp.int32).reshape((1,))],
+        "Total": [jnp.asarray(indices.shape[0], jnp.int32).reshape((1,))],
+    }
+
+
+@register("auc", no_grad=True)
+def lower_auc(ctx, ins):
+    """Streaming AUC with persistent histogram state (reference auc_op.cc:
+    StatPos/StatNeg accumulators are persistable vars written back)."""
+    jnp = _jnp()
+    predict = ins["Predict"][0]
+    label = ins["Label"][0].reshape(-1)
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_thresholds = ctx.attr("num_thresholds", 4095)
+    pos_prob = predict[:, -1] if predict.ndim == 2 else predict.reshape(-1)
+    bucket = jnp.clip(
+        (pos_prob * num_thresholds).astype(jnp.int32), 0, num_thresholds
+    )
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    stat_pos = stat_pos.at[bucket].add(is_pos)
+    stat_neg = stat_neg.at[bucket].add(1 - is_pos)
+    # trapezoidal AUC over thresholds, descending
+    pos_flip = jnp.flip(stat_pos)
+    neg_flip = jnp.flip(stat_neg)
+    tp = jnp.cumsum(pos_flip)
+    fp = jnp.cumsum(neg_flip)
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros((1,), tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros((1,), fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc = jnp.where(
+        (tot_pos > 0) & (tot_neg > 0),
+        area / jnp.maximum(tot_pos * tot_neg, 1.0),
+        jnp.asarray(0.0, area.dtype),
+    )
+    return {
+        "AUC": [auc.astype(jnp.float64 if str(area.dtype) == "float64" else jnp.float32).reshape(())],
+        "StatPosOut": [stat_pos],
+        "StatNegOut": [stat_neg],
+    }
+
+
+def _cmp(name, fn):
+    def lower(ctx, ins, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [_fn(x, y)]}
+
+    lower.__name__ = f"lower_{name}"
+    register(name, no_grad=True)(lower)
+
+
+def _install():
+    import jax.numpy as jnp
+
+    _cmp("equal", lambda x, y: x == y)
+    _cmp("not_equal", lambda x, y: x != y)
+    _cmp("less_than", lambda x, y: x < y)
+    _cmp("less_equal", lambda x, y: x <= y)
+    _cmp("greater_than", lambda x, y: x > y)
+    _cmp("greater_equal", lambda x, y: x >= y)
+    _cmp("logical_and", jnp.logical_and)
+    _cmp("logical_or", jnp.logical_or)
+    _cmp("logical_xor", jnp.logical_xor)
+
+
+_install()

@@ -1,0 +1,635 @@
+"""NN ops: conv, pool, norms, softmax/losses, embedding, dropout.
+
+Reference parity: conv_op.cc / conv_cudnn_op.cu.cc, pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, group_norm_op.cc, softmax_op.cc,
+softmax_with_cross_entropy_op.cu, cross_entropy_op.cc, lookup_table_op.{cc,h},
+dropout_op.cc, lrn_op.cc.  TPU-first notes:
+
+  * conv2d lowers to `lax.conv_general_dilated`; XLA maps it onto the MXU and
+    picks layouts itself — the cuDNN/MKLDNN kernel forks and exhaustive algo
+    search of the reference are unnecessary by design.
+  * batch_norm keeps the reference's stateful contract (running mean/variance
+    passed in and written back) but functionally: the executor threads the
+    updated stats back into the Scope.
+  * dropout has an explicit grad op using the saved Mask (the reference does
+    the same, dropout_op.cc) — required because the generic vjp grad re-traces
+    the forward and would re-draw randomness.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import framework as fw
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# conv2d
+# ---------------------------------------------------------------------------
+
+
+def _conv_infer(ctx):
+    xs = ctx.input_shape("Input")
+    ws = ctx.input_shape("Filter")
+    if xs is None or ws is None:
+        return
+    strides = ctx.attr("strides", [1, 1])
+    paddings = ctx.attr("paddings", [0, 0])
+    dilations = ctx.attr("dilations", [1, 1])
+    n, _, h, w = xs
+    oc, _, kh, kw = ws
+    oh = (h + 2 * paddings[0] - (dilations[0] * (kh - 1) + 1)) // strides[0] + 1
+    ow = (w + 2 * paddings[1] - (dilations[1] * (kw - 1) + 1)) // strides[1] + 1
+    ctx.set_output("Output", (n, oc, oh, ow), ctx.input_dtype("Input"))
+
+
+@register("conv2d", infer_shape=_conv_infer)
+def lower_conv2d(ctx, ins):
+    import jax.lax as lax
+
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(ctx.attr("strides", [1, 1]))
+    p = ctx.attr("paddings", [0, 0])
+    dilations = tuple(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+@register("depthwise_conv2d", infer_shape=_conv_infer)
+def lower_depthwise_conv2d(ctx, ins):
+    import jax.lax as lax
+
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(ctx.attr("strides", [1, 1]))
+    p = ctx.attr("paddings", [0, 0])
+    dilations = tuple(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", x.shape[1])
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+@register("conv2d_transpose")
+def lower_conv2d_transpose(ctx, ins):
+    """Transpose conv as input-dilated conv (supports groups, which
+    lax.conv_transpose does not).  Filter layout [C_in, C_out/g, kh, kw]
+    (reference conv_transpose_op.cc IOHW convention)."""
+    import jax.lax as lax
+
+    jnp = _jnp()
+    x, w = ins["Input"][0], ins["Filter"][0]
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    d = ctx.attr("dilations", [1, 1])
+    g = ctx.attr("groups", 1) or 1
+    c_in, co_g, kh, kw = w.shape
+    # [C_in, C_out/g, kh, kw] -> grouped OIHW [C_out, C_in/g, kh, kw], flipped
+    w2 = w.reshape(g, c_in // g, co_g, kh, kw)
+    w2 = jnp.transpose(w2, (0, 2, 1, 3, 4)).reshape(g * co_g, c_in // g, kh, kw)
+    w2 = jnp.flip(w2, axis=(-2, -1))
+    pad_h = d[0] * (kh - 1) - p[0]
+    pad_w = d[1] * (kw - 1) - p[1]
+    out = lax.conv_general_dilated(
+        x,
+        w2,
+        window_strides=(1, 1),
+        padding=[(pad_h, pad_h), (pad_w, pad_w)],
+        lhs_dilation=tuple(s),
+        rhs_dilation=tuple(d),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=g,
+    )
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# pool2d
+# ---------------------------------------------------------------------------
+
+
+def _pool_infer(ctx):
+    xs = ctx.input_shape("X")
+    if xs is None:
+        return
+    if ctx.attr("global_pooling", False):
+        ctx.set_output("Out", (xs[0], xs[1], 1, 1), ctx.input_dtype("X"))
+        return
+    k = ctx.attr("ksize", [2, 2])
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    n, c, h, w = xs
+    if ctx.attr("ceil_mode", False):
+        oh = int(np.ceil((h - k[0] + 2 * p[0]) / s[0])) + 1
+        ow = int(np.ceil((w - k[1] + 2 * p[1]) / s[1])) + 1
+    else:
+        oh = (h - k[0] + 2 * p[0]) // s[0] + 1
+        ow = (w - k[1] + 2 * p[1]) // s[1] + 1
+    ctx.set_output("Out", (n, c, oh, ow), ctx.input_dtype("X"))
+
+
+@register("pool2d", infer_shape=_pool_infer)
+def lower_pool2d(ctx, ins):
+    import jax.lax as lax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    ptype = ctx.attr("pooling_type", "max")
+    if ctx.attr("global_pooling", False):
+        if ptype == "max":
+            return {"Out": [jnp.max(x, axis=(2, 3), keepdims=True)]}
+        return {"Out": [jnp.mean(x, axis=(2, 3), keepdims=True)]}
+    k = ctx.attr("ksize", [2, 2])
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    window = (1, 1, k[0], k[1])
+    strides = (1, 1, s[0], s[1])
+    padding = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides, padding)
+    else:
+        ssum = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if ctx.attr("exclusive", True) and (p[0] or p[1]):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+            out = ssum / counts
+        else:
+            out = ssum / (k[0] * k[1])
+    return {"Out": [out]}
+
+
+@register("adaptive_pool2d")
+def lower_adaptive_pool2d(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    oh, ow = ctx.attr("pooling_size", ctx.attr("ksize", [1, 1]))
+    n, c, h, w = x.shape
+    # static adaptive pooling: only even-division supported (TPU static shapes)
+    assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible sizes"
+    xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if ctx.attr("pooling_type", "avg") == "max":
+        return {"Out": [jnp.max(xr, axis=(3, 5))]}
+    return {"Out": [jnp.mean(xr, axis=(3, 5))]}
+
+
+# ---------------------------------------------------------------------------
+# Norms
+# ---------------------------------------------------------------------------
+
+
+def _bn_infer(ctx):
+    xs = ctx.input_shape("X")
+    if xs is not None:
+        ctx.set_output("Y", xs, ctx.input_dtype("X"))
+
+
+@register("batch_norm", infer_shape=_bn_infer)
+def lower_batch_norm(ctx, ins):
+    """reference: batch_norm_op.cc.  Stateful contract preserved: MeanOut/
+    VarianceOut (same var names as Mean/Variance inputs) are returned and the
+    executor writes them back to the Scope."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean_in, var_in = ins["Mean"][0], ins["Variance"][0]
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    layout = ctx.attr("data_layout", "NCHW")
+    is_test = ctx.attr("is_test", False) or ctx.is_test
+    use_global = ctx.attr("use_global_stats", False) or is_test
+
+    axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
+    bshape = [1] * x.ndim
+    bshape[1 if layout == "NCHW" else -1] = x.shape[1 if layout == "NCHW" else -1]
+
+    if use_global:
+        mean, var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+        saved_mean, saved_var = mean_in, var_in
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+        m = jax.lax.stop_gradient(mean)
+        v = jax.lax.stop_gradient(var)
+        mean_out = mean_in * momentum + m * (1 - momentum)
+        var_out = var_in * momentum + v * (1 - momentum)
+        saved_mean, saved_var = m, v
+
+    inv_std = jax.lax.rsqrt(var.reshape(bshape) + eps)
+    y = (x - mean.reshape(bshape)) * inv_std * scale.reshape(bshape) + bias.reshape(
+        bshape
+    )
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+
+
+@register("layer_norm", infer_shape=_bn_infer)
+def lower_layer_norm(ctx, ins):
+    """reference: layer_norm_op.cc; normalizes over dims >= begin_norm_axis."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    eps = ctx.attr("epsilon", 1e-5)
+    axis = ctx.attr("begin_norm_axis", 1)
+    axes = tuple(range(axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    scale = ins.get("Scale", [None])[0]
+    bias = ins.get("Bias", [None])[0]
+    norm_shape = x.shape[axis:]
+    if scale is not None:
+        y = y * scale.reshape((1,) * axis + norm_shape)
+    if bias is not None:
+        y = y + bias.reshape((1,) * axis + norm_shape)
+    return {
+        "Y": [y],
+        "Mean": [mean.reshape(x.shape[:axis])],
+        "Variance": [var.reshape(x.shape[:axis])],
+    }
+
+
+@register("group_norm")
+def lower_group_norm(ctx, ins):
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    groups = ctx.attr("groups")
+    eps = ctx.attr("epsilon", 1e-5)
+    n, c, h, w = x.shape
+    xg = x.reshape(n, groups, c // groups, h, w)
+    mean = jnp.mean(xg, axis=(2, 3, 4), keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=(2, 3, 4), keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    scale = ins.get("Scale", [None])[0]
+    bias = ins.get("Bias", [None])[0]
+    if scale is not None:
+        y = y * scale.reshape(1, c, 1, 1)
+    if bias is not None:
+        y = y + bias.reshape(1, c, 1, 1)
+    return {
+        "Y": [y],
+        "Mean": [mean.reshape(n, groups)],
+        "Variance": [var.reshape(n, groups)],
+    }
+
+
+@register("lrn")
+def lower_lrn(ctx, ins):
+    import jax.lax as lax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    n_size = ctx.attr("n", 5)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    k = ctx.attr("k", 1.0)
+    sq = jnp.square(x)
+    half = n_size // 2
+    acc = lax.reduce_window(
+        sq, 0.0, lax.add, (1, n_size, 1, 1), (1, 1, 1, 1), ((0, 0), (half, half), (0, 0), (0, 0))
+    )
+    mid = jnp.power(k + alpha * acc, beta)
+    return {"Out": [x / mid], "MidOut": [mid]}
+
+
+@register("norm")
+def lower_norm(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = ctx.attr("axis", 1)
+    eps = ctx.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+# ---------------------------------------------------------------------------
+# Softmax & losses
+# ---------------------------------------------------------------------------
+
+
+@register("softmax", infer_shape=_bn_infer)
+def lower_softmax(ctx, ins):
+    import jax
+
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=ctx.attr("axis", -1))]}
+
+
+@register("log_softmax")
+def lower_log_softmax(ctx, ins):
+    import jax
+
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=ctx.attr("axis", -1))]}
+
+
+def _take_label(logp, label):
+    """Pick -log p[label] along the last axis; label has trailing dim 1."""
+    jnp = _jnp()
+    lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
+    picked = jnp.take_along_axis(logp, lbl[..., None].astype("int32"), axis=-1)
+    return -picked
+
+
+@register("softmax_with_cross_entropy")
+def lower_softmax_with_ce(ctx, ins):
+    """Fused stable softmax+CE (reference: softmax_with_cross_entropy_op.cu)."""
+    import jax
+
+    jnp = _jnp()
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    softmax = jnp.exp(logp)
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        ignore = ctx.attr("ignore_index", -100)
+        loss = _take_label(logp, label)
+        if ignore >= 0:
+            lbl = label.reshape(loss.shape)
+            loss = jnp.where(lbl == ignore, 0.0, loss)
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register("cross_entropy")
+def lower_cross_entropy(ctx, ins):
+    jnp = _jnp()
+    x, label = ins["X"][0], ins["Label"][0]
+    logp = jnp.log(jnp.clip(x, 1e-12, None))
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        loss = _take_label(logp, label)
+        ignore = ctx.attr("ignore_index", -100)
+        if ignore >= 0:
+            lbl = label.reshape(loss.shape)
+            loss = jnp.where(lbl == ignore, 0.0, loss)
+    return {"Y": [loss]}
+
+
+@register("sigmoid_cross_entropy_with_logits")
+def lower_sigmoid_ce(ctx, ins):
+    jnp = _jnp()
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = ctx.attr("ignore_index", -100)
+    if ignore >= 0:
+        loss = jnp.where(label == ignore, 0.0, loss)
+    if ctx.attr("normalize", False):
+        n_valid = jnp.sum((label != ignore).astype(loss.dtype))
+        loss = loss / jnp.maximum(n_valid, 1.0)
+    return {"Out": [loss]}
+
+
+@register("square_error_cost")
+def lower_square_error_cost(ctx, ins):
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register("huber_loss")
+def lower_huber_loss(ctx, ins):
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * jnp.square(r), delta * (ar - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register("log_loss")
+def lower_log_loss(ctx, ins):
+    jnp = _jnp()
+    p, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = ctx.attr("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}
+
+
+@register("hinge_loss")
+def lower_hinge_loss(ctx, ins):
+    jnp = _jnp()
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2 * labels - 1) * logits)]}
+
+
+@register("margin_rank_loss")
+def lower_margin_rank_loss(ctx, ins):
+    jnp = _jnp()
+    label, left, right = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    margin = ctx.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (left - right) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(left.dtype)]}
+
+
+@register("bpr_loss")
+def lower_bpr_loss(ctx, ins):
+    jnp = _jnp()
+    x, label = ins["X"][0], ins["Label"][0]
+    lbl = label.reshape(label.shape[0])
+    pos = jnp.take_along_axis(x, lbl[:, None].astype("int32"), axis=1)
+    diff = x - pos
+    loss = jnp.mean(jnp.log1p(jnp.exp(diff)), axis=1, keepdims=True)
+    return {"Y": [loss]}
+
+
+# ---------------------------------------------------------------------------
+# Embedding (reference: lookup_table_op.{cc,h} — the sparse-CTR workhorse)
+# ---------------------------------------------------------------------------
+
+
+def _lookup_infer(ctx):
+    ws = ctx.input_shape("W")
+    ids = ctx.input_shape("Ids")
+    if ws is None or ids is None:
+        return
+    base = ids[:-1] if ids and ids[-1] == 1 else ids
+    ctx.set_output("Out", tuple(base) + (ws[-1],), ctx.input_dtype("W"))
+
+
+def _lookup_table_grad_maker(op, no_grad_set, grad_sub_block_map=None):
+    """Sparse-aware grad: emits lookup_table_grad producing a row-sparse
+    gradient (SelectedRows parity, lookup_table_op.h:132) when is_sparse."""
+    g_w = fw.grad_var_name(op.input("W")[0])
+    if op.input("W")[0] in no_grad_set:
+        return []
+    return [
+        {
+            "type": "lookup_table_grad",
+            "inputs": {
+                "Ids": op.input("Ids"),
+                "W": op.input("W"),
+                "Out@GRAD": [fw.grad_var_name(n) for n in op.output("Out")],
+            },
+            "outputs": {"W@GRAD": [g_w]},
+            "attrs": dict(op.attrs, **{fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward}),
+        }
+    ]
+
+
+@register("lookup_table", infer_shape=_lookup_infer, grad_maker=_lookup_table_grad_maker)
+def lower_lookup_table(ctx, ins):
+    jnp = _jnp()
+    w, ids = ins["W"][0], ins["Ids"][0]
+    idshape = ids.shape
+    flat = ids.reshape(-1).astype("int32")
+    out = jnp.take(w, flat, axis=0)
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (flat != padding_idx)[:, None]
+        out = out * mask.astype(out.dtype)
+    base = idshape[:-1] if idshape and idshape[-1] == 1 else idshape
+    return {"Out": [out.reshape(tuple(base) + (w.shape[-1],))]}
+
+
+@register("lookup_table_grad", no_grad=True)
+def lower_lookup_table_grad(ctx, ins):
+    """Scatter-add of output grads into a dense row gradient.  XLA lowers
+    segment-sum/scatter efficiently on TPU; true SelectedRows materialization
+    is kept for the host-offloaded embedding path (parallel/embedding)."""
+    w = ins["W"][0]
+    ids = ins["Ids"][0].reshape(-1).astype("int32")
+    gout = ins["Out@GRAD"][0]
+    gout2 = gout.reshape(-1, w.shape[-1])
+    jnp = _jnp()
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        gout2 = gout2 * (ids != padding_idx)[:, None].astype(gout2.dtype)
+    gw = jnp.zeros_like(w).at[ids].add(gout2.astype(w.dtype))
+    return {"W@GRAD": [gw]}
+
+
+# ---------------------------------------------------------------------------
+# Dropout (explicit grad via saved mask — see module docstring)
+# ---------------------------------------------------------------------------
+
+
+def _dropout_grad_maker(op, no_grad_set, grad_sub_block_map=None):
+    x = op.input("X")[0]
+    if x in no_grad_set:
+        return []
+    return [
+        {
+            "type": "dropout_grad",
+            "inputs": {
+                "Mask": op.output("Mask"),
+                "Out@GRAD": [fw.grad_var_name(n) for n in op.output("Out")],
+            },
+            "outputs": {"X@GRAD": [fw.grad_var_name(x)]},
+            "attrs": dict(op.attrs, **{fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward}),
+        }
+    ]
+
+
+@register("dropout", infer_shape=_bn_infer, grad_maker=_dropout_grad_maker)
+def lower_dropout(ctx, ins):
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    p = ctx.attr("dropout_prob", 0.5)
+    is_test = ctx.attr("is_test", False) or ctx.is_test
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        mask = jnp.ones_like(x)
+        if impl == "downgrade_in_infer":
+            return {"Out": [x * (1.0 - p)], "Mask": [mask]}
+        return {"Out": [x], "Mask": [mask]}
+    seed = ctx.attr("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        mask = keep.astype(x.dtype) / (1.0 - p)
+    else:
+        mask = keep.astype(x.dtype)
+    return {"Out": [x * mask], "Mask": [mask]}
+
+
+@register("dropout_grad", no_grad=True)
+def lower_dropout_grad(ctx, ins):
+    return {"X@GRAD": [ins["Out@GRAD"][0] * ins["Mask"][0]]}
+
+
+# ---------------------------------------------------------------------------
+# prelu / maxout / interpolate
+# ---------------------------------------------------------------------------
+
+
+@register("prelu")
+def lower_prelu(ctx, ins):
+    jnp = _jnp()
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = ctx.attr("mode", "all")
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:
+        a = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": [jnp.where(x > 0, x, a * x)]}
+
+
+@register("maxout")
+def lower_maxout(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    groups = ctx.attr("groups")
+    n, c, h, w = x.shape
+    return {"Out": [jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2)]}
+
+
+@register("bilinear_interp")
+def lower_bilinear_interp(ctx, ins):
+    import jax
+
+    x = ins["X"][0]
+    oh = ctx.attr("out_h")
+    ow = ctx.attr("out_w")
+    n, c, h, w = x.shape
+    out = jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+    return {"Out": [out]}
+
+
+@register("nearest_interp")
+def lower_nearest_interp(ctx, ins):
+    import jax
+
+    x = ins["X"][0]
+    oh = ctx.attr("out_h")
+    ow = ctx.attr("out_w")
+    n, c, h, w = x.shape
+    out = jax.image.resize(x, (n, c, oh, ow), method="nearest")
+    return {"Out": [out]}

@@ -1,0 +1,208 @@
+"""Optimizer update ops (reference: operators/optimizers/{sgd,momentum,adam,
+adagrad,rmsprop,adamax,adadelta,ftrl,decayed_adagrad,lars_momentum,
+proximal_gd}_op.cc).
+
+Kept as *ops in the program* for parity — Optimizer.minimize appends them —
+but each is a pure functional update; the executor writes Param/moment
+outputs back to the Scope (donated buffers, in-place in HBM).  All have
+no_grad=True (reference marks them with OpRole.Optimize)."""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _lr(ins):
+    return ins["LearningRate"][0].reshape(())
+
+
+@register("sgd", no_grad=True)
+def lower_sgd(ctx, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
+
+
+@register("momentum", no_grad=True)
+def lower_momentum(ctx, ins):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = ctx.attr("mu", 0.9)
+    lr = _lr(ins)
+    v_out = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register("lars_momentum", no_grad=True)
+def lower_lars_momentum(ctx, ins):
+    jnp = _jnp()
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = ctx.attr("mu", 0.9)
+    coeff = ctx.attr("lars_coeff", 0.001)
+    decay = ctx.attr("lars_weight_decay", 0.0005)
+    lr = _lr(ins)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = lr * coeff * pn / (gn + decay * pn + 1e-12)
+    v_out = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+
+
+@register("adam", no_grad=True)
+def lower_adam(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ins)
+    g = g.astype(p.dtype)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_out = p - lr_t * m1o / (jnp.sqrt(m2o) + eps)
+    return {
+        "ParamOut": [p_out],
+        "Moment1Out": [m1o],
+        "Moment2Out": [m2o],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register("adamax", no_grad=True)
+def lower_adamax(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ins)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr_t = lr / (1 - b1p.reshape(()))
+    p_out = p - lr_t * m_out / (inf_out + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [inf_out]}
+
+
+@register("adagrad", no_grad=True)
+def lower_adagrad(ctx, ins):
+    jnp = _jnp()
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = ctx.attr("epsilon", 1e-6)
+    m_out = m + jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register("decayed_adagrad", no_grad=True)
+def lower_decayed_adagrad(ctx, ins):
+    jnp = _jnp()
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register("adadelta", no_grad=True)
+def lower_adadelta(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    asg = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (asg + eps)) * g
+    asu = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    return {
+        "ParamOut": [p + update],
+        "AvgSquaredGradOut": [asg],
+        "AvgSquaredUpdateOut": [asu],
+    }
+
+
+@register("rmsprop", no_grad=True)
+def lower_rmsprop(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    rho = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    momentum = ctx.attr("momentum", 0.0)
+    lr = _lr(ins)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    outs = {}
+    if ctx.attr("centered", False):
+        mg = ins["MeanGrad"][0]
+        mg_out = rho * mg + (1 - rho) * g
+        mom_out = momentum * mom + lr * g / jnp.sqrt(
+            ms_out - jnp.square(mg_out) + eps
+        )
+        outs["MeanGradOut"] = [mg_out]
+    else:
+        mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out + eps)
+    outs.update(
+        {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out], "MomentOut": [mom_out]}
+    )
+    return outs
+
+
+@register("ftrl", no_grad=True)
+def lower_ftrl(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr_power = ctx.attr("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    p_out = pre / denom
+    return {
+        "ParamOut": [p_out],
+        "SquaredAccumOut": [new_sq],
+        "LinearAccumOut": [new_lin],
+    }
+
+
+@register("proximal_gd", no_grad=True)
+def lower_proximal_gd(ctx, ins):
+    jnp = _jnp()
+    p, g = ins["Param"][0], ins["Grad"][0]
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr = _lr(ins)
+    prox = p - lr * g
+    if l1 > 0:
+        p_out = (
+            jnp.sign(prox)
+            * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+            / (1.0 + lr * l2)
+        )
+    else:
+        p_out = prox / (1.0 + lr * l2)
+    return {"ParamOut": [p_out]}

@@ -1,0 +1,83 @@
+"""Random ops (reference: operators/uniform_random_op.cc,
+gaussian_random_op.cc, truncated_gaussian_random_op.cc, sampling_id_op.cc).
+
+TPU-first: stateless threefry PRNG — each op folds a per-trace counter into
+the run's base key (TraceContext.next_rng_key), giving reproducible,
+order-independent randomness under XLA; per-op `seed` attrs override."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.framework import convert_dtype
+from ..core.registry import register
+
+
+def _key(ctx):
+    import jax
+
+    seed = ctx.attr("seed", 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.next_rng_key()
+
+
+def _shape_dtype(ctx):
+    import jax.numpy as jnp
+
+    shape = tuple(int(s) for s in ctx.attr("shape"))
+    dtype = convert_dtype(ctx.attr("dtype", "float32"))
+    target = jnp.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)
+    return shape, target
+
+
+def _rand_infer(ctx):
+    ctx.set_output("Out", ctx.attr("shape", [1]), ctx.attr("dtype", "float32"))
+
+
+@register("uniform_random", infer_shape=_rand_infer, no_grad=True)
+def lower_uniform_random(ctx, ins):
+    import jax
+
+    shape, dtype = _shape_dtype(ctx)
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    out = jax.random.uniform(_key(ctx), shape, minval=lo, maxval=hi)
+    return {"Out": [out.astype(dtype)]}
+
+
+@register("gaussian_random", infer_shape=_rand_infer, no_grad=True)
+def lower_gaussian_random(ctx, ins):
+    import jax
+
+    shape, dtype = _shape_dtype(ctx)
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    out = jax.random.normal(_key(ctx), shape) * std + mean
+    return {"Out": [out.astype(dtype)]}
+
+
+@register("truncated_gaussian_random", infer_shape=_rand_infer, no_grad=True)
+def lower_truncated_gaussian_random(ctx, ins):
+    import jax
+
+    shape, dtype = _shape_dtype(ctx)
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    out = jax.random.truncated_normal(_key(ctx), -2.0, 2.0, shape) * std + mean
+    return {"Out": [out.astype(dtype)]}
+
+
+@register("sampling_id", no_grad=True)
+def lower_sampling_id(ctx, ins):
+    import jax
+
+    x = ins["X"][0]
+    out = jax.random.categorical(_key(ctx), jax.numpy.log(x + 1e-20), axis=-1)
+    return {"Out": [out.astype("int64")]}
+
+
+@register("shuffle_batch", no_grad=True)
+def lower_shuffle_batch(ctx, ins):
+    import jax
+
+    x = ins["X"][0]
+    perm = jax.random.permutation(_key(ctx), x.shape[0])
+    return {"Out": [x[perm]], "ShuffleIdx": [perm.astype("int64")]}

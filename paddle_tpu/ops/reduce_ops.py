@@ -1,0 +1,46 @@
+"""Reductions (reference: operators/reduce_ops/reduce_{sum,mean,max,min,prod}_op.cc)."""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _reduce_infer(ctx):
+    xs = ctx.input_shape("X")
+    if xs is None:
+        return
+    dims = ctx.attr("dim", [0])
+    keep = ctx.attr("keep_dim", False)
+    if ctx.attr("reduce_all", False):
+        out = [1] if keep else []
+    else:
+        dims = [d % len(xs) for d in dims]
+        if keep:
+            out = [1 if i in dims else s for i, s in enumerate(xs)]
+        else:
+            out = [s for i, s in enumerate(xs) if i not in dims]
+    ctx.set_output("Out", out or [], ctx.input_dtype("X"))
+
+
+def _make(name, jfn_name):
+    def lower(ctx, ins):
+        import jax.numpy as jnp
+
+        fn = getattr(jnp, jfn_name)
+        x = ins["X"][0]
+        if ctx.attr("reduce_all", False):
+            out = fn(x, keepdims=ctx.attr("keep_dim", False))
+        else:
+            dims = tuple(d % x.ndim for d in ctx.attr("dim", [0]))
+            out = fn(x, axis=dims, keepdims=ctx.attr("keep_dim", False))
+        return {"Out": [out]}
+
+    lower.__name__ = f"lower_{name}"
+    register(name, infer_shape=_reduce_infer)(lower)
+
+
+_make("reduce_sum", "sum")
+_make("reduce_mean", "mean")
+_make("reduce_max", "max")
+_make("reduce_min", "min")
+_make("reduce_prod", "prod")

@@ -1,0 +1,467 @@
+"""Tensor manipulation ops (reference: operators/ reshape_op.cc,
+transpose_op.cc, concat_op.cc, split_op.cc, slice_op.cc, cast_op.cc,
+fill_constant_op.cc, one_hot_op.cc, gather_op.cc, scatter_op.cc,
+expand_op.cc, top_k_op.cc, arg_min_max_op_base.h, cum_op.h, pad_op.cc, ...).
+
+All static-shape by construction — attrs carry the shape parameters, so XLA
+sees fully static programs (no dynamic shapes that would block MXU tiling).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.framework import convert_dtype
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _resolve_shape(shape, x):
+    """Resolve -1 / 0 entries in a reshape target (reference reshape_op.cc:
+    0 copies the input dim, -1 is inferred)."""
+    shape = list(shape)
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        total = int(np.prod(x.shape))
+        shape[shape.index(-1)] = total // max(known, 1)
+    return tuple(int(s) for s in shape)
+
+
+def _reshape_infer(ctx):
+    xs = ctx.input_shape("X")
+    shape = ctx.attr("shape")
+    if xs is None or shape is None:
+        return
+    shape = list(shape)
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = xs[i]
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        total = int(np.prod(xs))
+        shape[shape.index(-1)] = total // max(known, 1)
+    ctx.set_output("Out", shape, ctx.input_dtype("X"))
+
+
+@register("reshape", infer_shape=_reshape_infer)
+def lower_reshape(ctx, ins):
+    x = ins["X"][0]
+    return {"Out": [x.reshape(_resolve_shape(ctx.attr("shape"), x))]}
+
+
+@register("reshape2", infer_shape=_reshape_infer)
+def lower_reshape2(ctx, ins):
+    x = ins["X"][0]
+    out = x.reshape(_resolve_shape(ctx.attr("shape"), x))
+    jnp = _jnp()
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+def _transpose_infer(ctx):
+    xs = ctx.input_shape("X")
+    axis = ctx.attr("axis")
+    if xs is None or axis is None:
+        return
+    ctx.set_output("Out", [xs[a] for a in axis], ctx.input_dtype("X"))
+
+
+@register("transpose", infer_shape=_transpose_infer)
+def lower_transpose(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.transpose(ins["X"][0], ctx.attr("axis"))]}
+
+
+@register("transpose2", infer_shape=_transpose_infer)
+def lower_transpose2(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    out = jnp.transpose(x, ctx.attr("axis"))
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+def _concat_infer(ctx):
+    shapes = []
+    i = 0
+    while True:
+        s = ctx.input_shape("X", i)
+        if s is None:
+            break
+        shapes.append(s)
+        i += 1
+    if not shapes:
+        return
+    axis = ctx.attr("axis", 0)
+    out = list(shapes[0])
+    out[axis] = sum(s[axis] for s in shapes)
+    ctx.set_output("Out", out, ctx.input_dtype("X"))
+
+
+@register("concat", infer_shape=_concat_infer)
+def lower_concat(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.concatenate([v for v in ins["X"]], axis=ctx.attr("axis", 0))]}
+
+
+@register("split")
+def lower_split(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = ctx.attr("axis", 0)
+    sections = ctx.attr("sections")
+    num = ctx.attr("num", 0)
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("slice")
+def lower_slice(ctx, ins):
+    x = ins["Input"][0]
+    axes = ctx.attr("axes")
+    starts = ctx.attr("starts")
+    ends = ctx.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        st = max(st + dim, 0) if st < 0 else min(st, dim)
+        en = max(en + dim, 0) if en < 0 else min(en, dim)
+        idx[ax] = slice(st, en)
+    return {"Out": [x[tuple(idx)]]}
+
+
+def _squeeze_axes(shape, axes):
+    if axes:
+        return [i for i in range(len(shape)) if not (i in axes or i - len(shape) in axes)]
+    return [i for i, s in enumerate(shape) if s != 1]
+
+
+@register("squeeze")
+def lower_squeeze(ctx, ins):
+    x = ins["X"][0]
+    keep = _squeeze_axes(x.shape, ctx.attr("axes", []))
+    return {"Out": [x.reshape(tuple(x.shape[i] for i in keep))]}
+
+
+@register("squeeze2")
+def lower_squeeze2(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    keep = _squeeze_axes(x.shape, ctx.attr("axes", []))
+    out = x.reshape(tuple(x.shape[i] for i in keep))
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+def _unsqueeze_shape(shape, axes):
+    out = list(shape)
+    for ax in sorted(a if a >= 0 else a + len(shape) + 1 for a in axes):
+        out.insert(ax, 1)
+    return tuple(out)
+
+
+@register("unsqueeze")
+def lower_unsqueeze(ctx, ins):
+    x = ins["X"][0]
+    return {"Out": [x.reshape(_unsqueeze_shape(x.shape, ctx.attr("axes")))]}
+
+
+@register("unsqueeze2")
+def lower_unsqueeze2(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    out = x.reshape(_unsqueeze_shape(x.shape, ctx.attr("axes")))
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+def _flatten_infer(ctx):
+    xs = ctx.input_shape("X")
+    if xs is None:
+        return
+    axis = ctx.attr("axis", 1)
+    outer = int(np.prod(xs[:axis])) if axis > 0 else 1
+    inner = int(np.prod(xs[axis:])) if axis < len(xs) else 1
+    ctx.set_output("Out", (outer, inner), ctx.input_dtype("X"))
+
+
+@register("flatten", infer_shape=_flatten_infer)
+def lower_flatten(ctx, ins):
+    x = ins["X"][0]
+    axis = ctx.attr("axis", 1)
+    outer = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": [x.reshape((outer, -1))]}
+
+
+@register("flatten2", infer_shape=_flatten_infer)
+def lower_flatten2(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = ctx.attr("axis", 1)
+    outer = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    out = x.reshape((outer, -1))
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("stack")
+def lower_stack(ctx, ins):
+    jnp = _jnp()
+    return {"Y": [jnp.stack([v for v in ins["X"]], axis=ctx.attr("axis", 0))]}
+
+
+@register("unstack")
+def lower_unstack(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = ctx.attr("axis", 0)
+    n = x.shape[axis]
+    outs = [jnp.squeeze(v, axis=axis) for v in jnp.split(x, n, axis=axis)]
+    return {"Y": outs}
+
+
+def _cast_infer(ctx):
+    xs = ctx.input_shape("X")
+    if xs is not None:
+        ctx.set_output("Out", xs, ctx.attr("out_dtype", "float32"))
+
+
+@register("cast", infer_shape=_cast_infer)
+def lower_cast(ctx, ins):
+    jnp = _jnp()
+    dtype = convert_dtype(ctx.attr("out_dtype", "float32"))
+    target = jnp.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)
+    return {"Out": [ins["X"][0].astype(target)]}
+
+
+def _fill_constant_infer(ctx):
+    ctx.set_output("Out", ctx.attr("shape", [1]), ctx.attr("dtype", "float32"))
+
+
+@register("fill_constant", infer_shape=_fill_constant_infer, no_grad=True)
+def lower_fill_constant(ctx, ins):
+    jnp = _jnp()
+    dtype = convert_dtype(ctx.attr("dtype", "float32"))
+    target = jnp.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)
+    shape = tuple(int(s) for s in ctx.attr("shape", [1]))
+    return {"Out": [jnp.full(shape, ctx.attr("value", 0.0), dtype=target)]}
+
+
+@register("fill_zeros_like", no_grad=True)
+def lower_fill_zeros_like(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register("assign")
+def lower_assign(ctx, ins):
+    return {"Out": [ins["X"][0]]}
+
+
+@register("assign_value", no_grad=True)
+def lower_assign_value(ctx, ins):
+    jnp = _jnp()
+    values = np.array(ctx.attr("values"), dtype=convert_dtype(ctx.attr("dtype", "float32")))
+    return {"Out": [jnp.asarray(values.reshape(ctx.attr("shape")))]}
+
+
+@register("shape", no_grad=True)
+def lower_shape(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.asarray(np.array(ins["Input"][0].shape, dtype=np.int32))]}
+
+
+@register("one_hot", no_grad=True)
+def lower_one_hot(ctx, ins):
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    depth = ctx.attr("depth")
+    x = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register("arg_max", no_grad=True)
+def lower_arg_max(ctx, ins):
+    jnp = _jnp()
+    return {
+        "Out": [jnp.argmax(ins["X"][0], axis=ctx.attr("axis", -1)).astype(jnp.int64)]
+    }
+
+
+@register("arg_min", no_grad=True)
+def lower_arg_min(ctx, ins):
+    jnp = _jnp()
+    return {
+        "Out": [jnp.argmin(ins["X"][0], axis=ctx.attr("axis", -1)).astype(jnp.int64)]
+    }
+
+
+@register("argsort", no_grad=True)
+def lower_argsort(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = ctx.attr("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": [jnp.take_along_axis(x, idx, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("top_k", no_grad=True)
+def lower_top_k(ctx, ins):
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    k = ctx.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("cumsum")
+def lower_cumsum(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = ctx.attr("axis", -1)
+    if ctx.attr("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    if ctx.attr("reverse", False):
+        x = jnp.flip(x, axis=axis)
+    out = jnp.cumsum(x, axis=axis)
+    if ctx.attr("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(0, x.shape[axis])
+        out = jnp.pad(out, pad)[tuple(sl)]
+    if ctx.attr("reverse", False):
+        out = jnp.flip(out, axis=axis)
+    return {"Out": [out]}
+
+
+@register("gather")
+def lower_gather(ctx, ins):
+    jnp = _jnp()
+    x, idx = ins["X"][0], ins["Index"][0]
+    idx = idx.reshape(-1)
+    return {"Out": [jnp.take(x, idx, axis=0)]}
+
+
+@register("scatter")
+def lower_scatter(ctx, ins):
+    x, idx, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    idx = idx.reshape(-1)
+    if ctx.attr("overwrite", True):
+        out = x.at[idx].set(updates)
+    else:
+        out = x.at[idx].add(updates)
+    return {"Out": [out]}
+
+
+@register("expand")
+def lower_expand(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    times = ctx.attr("expand_times")
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register("expand_as")
+def lower_expand_as(ctx, ins):
+    jnp = _jnp()
+    x, target = ins["X"][0], ins["target_tensor"][0]
+    times = [t // s for s, t in zip(x.shape, target.shape)]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register("pad")
+def lower_pad(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    paddings = ctx.attr("paddings")
+    pad_width = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return {
+        "Out": [
+            jnp.pad(x, pad_width, constant_values=ctx.attr("pad_value", 0.0))
+        ]
+    }
+
+
+@register("pad2d")
+def lower_pad2d(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    p = ctx.attr("paddings", [0, 0, 0, 0])
+    mode = ctx.attr("mode", "constant")
+    fmt = ctx.attr("data_format", "NCHW")
+    if fmt == "NCHW":
+        pad_width = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pad_width = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    if mode == "constant":
+        out = jnp.pad(x, pad_width, constant_values=ctx.attr("pad_value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, pad_width, mode="reflect")
+    else:
+        out = jnp.pad(x, pad_width, mode="edge")
+    return {"Out": [out]}
+
+
+@register("pad_constant_like")
+def lower_pad_constant_like(ctx, ins):
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    pad_width = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {
+        "Out": [jnp.pad(y, pad_width, constant_values=ctx.attr("pad_value", 0.0))]
+    }
+
+
+@register("mean")
+def lower_mean(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+@register("reverse")
+def lower_reverse(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    out = x
+    for ax in ctx.attr("axis"):
+        out = jnp.flip(out, axis=ax)
+    return {"Out": [out]}
+
+
+@register("space_to_depth")
+def lower_space_to_depth(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    bs = ctx.attr("blocksize")
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    out = jnp.transpose(out, (0, 3, 5, 1, 2, 4))
+    return {"Out": [out.reshape(n, c * bs * bs, h // bs, w // bs)]}
+
+
+@register("increment")
+def lower_increment(ctx, ins):
+    return {"Out": [ins["X"][0] + ctx.attr("step", 1.0)]}
+
+
+@register("isfinite", no_grad=True)
+def lower_isfinite(ctx, ins):
+    jnp = _jnp()
+    vals = [v for v in ins["X"] if v is not None]
+    ok = jnp.array(True)
+    for v in vals:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(v.astype(jnp.float32))))
+    return {"Out": [ok]}

@@ -1,0 +1,101 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py + platform/profiler.cc
+host event tables + CUPTI device tracer → chrome trace).
+
+TPU equivalent: jax.profiler captures XPlane traces viewable in
+TensorBoard/Perfetto (the reference's tools/timeline.py chrome-trace role),
+plus a lightweight host-side step timer table for the per-op summary role."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Optional
+
+
+class _HostEvents:
+    def __init__(self):
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+        self.maxes = defaultdict(float)
+        self._stack = []
+
+    def push(self, name):
+        self._stack.append((name, time.perf_counter()))
+
+    def pop(self):
+        name, t0 = self._stack.pop()
+        dt = time.perf_counter() - t0
+        self.totals[name] += dt
+        self.counts[name] += 1
+        self.maxes[name] = max(self.maxes[name], dt)
+
+    def summary(self, sorted_key="total"):
+        rows = []
+        for name in self.totals:
+            total = self.totals[name]
+            cnt = self.counts[name]
+            rows.append((name, cnt, total, total / cnt, self.maxes[name]))
+        key_idx = {"total": 2, "calls": 1, "ave": 3, "max": 4}.get(sorted_key, 2)
+        rows.sort(key=lambda r: r[key_idx], reverse=True)
+        return rows
+
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()
+        self.maxes.clear()
+
+
+_events = _HostEvents()
+_profiling = False
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """RAII range (reference: platform/profiler.h:72 RecordEvent)."""
+    _events.push(name)
+    try:
+        yield
+    finally:
+        _events.pop()
+
+
+def start_profiler(state="All", trace_dir: Optional[str] = None):
+    global _profiling
+    _profiling = True
+    _events.reset()
+    if trace_dir:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key="total", profile_path: Optional[str] = None,
+                  tracing: bool = False):
+    global _profiling
+    _profiling = False
+    if tracing:
+        import jax
+
+        jax.profiler.stop_trace()
+    rows = _events.summary(sorted_key)
+    lines = ["Event                          Calls     Total(s)    Ave(s)      Max(s)"]
+    for name, cnt, total, ave, mx in rows:
+        lines.append(f"{name:<30} {cnt:>6} {total:>12.6f} {ave:>10.6f} {mx:>10.6f}")
+    report = "\n".join(lines)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(report)
+    print(report)
+    return rows
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None,
+             trace_dir: Optional[str] = None):
+    """reference: fluid.profiler.profiler contextmanager."""
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path, tracing=trace_dir is not None)

@@ -1,0 +1,95 @@
+"""Weight-decay regularizers appended as grad ops
+(reference: python/paddle/fluid/regularizer.py)."""
+
+from __future__ import annotations
+
+from .core import framework as fw
+from .layer_helper import LayerHelper
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._regularization_coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        helper = LayerHelper("l2_decay")
+        decay = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(
+            "scale",
+            inputs={"X": [param]},
+            outputs={"Out": [decay]},
+            attrs={"scale": self._regularization_coeff,
+                   fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+        )
+        return decay
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._regularization_coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        # d|p|/dp = sign(p) = p / (|p| + eps)
+        helper = LayerHelper("l1_decay")
+        sign = helper.create_variable_for_type_inference(param.dtype)
+        decay = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(
+            "elementwise_div",
+            inputs={"X": [param], "Y": [_abs_plus_eps(helper, param)]},
+            outputs={"Out": [sign]},
+            attrs={fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+        )
+        block.append_op(
+            "scale",
+            inputs={"X": [sign]},
+            outputs={"Out": [decay]},
+            attrs={"scale": self._regularization_coeff,
+                   fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+        )
+        return decay
+
+
+def _abs_plus_eps(helper, param):
+    a = helper.create_variable_for_type_inference(param.dtype)
+    helper.append_op("abs", inputs={"X": [param]}, outputs={"Out": [a]})
+    b = helper.create_variable_for_type_inference(param.dtype)
+    helper.append_op(
+        "scale", inputs={"X": [a]}, outputs={"Out": [b]}, attrs={"bias": 1e-12}
+    )
+    return b
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    """Add decay terms onto grads (reference: regularizer.py
+    append_regularization_ops)."""
+    params_and_grads = []
+    for param, grad in parameters_and_grads:
+        if grad is None:
+            params_and_grads.append((param, grad))
+            continue
+        regularization_term = None
+        reg = getattr(param, "regularizer", None) or regularization
+        if reg is not None:
+            regularization_term = reg(param, grad, grad.block)
+        if regularization_term is None:
+            params_and_grads.append((param, grad))
+            continue
+        helper = LayerHelper("regularized_grad")
+        new_grad = helper.create_variable_for_type_inference(grad.dtype)
+        grad.block.append_op(
+            "sum",
+            inputs={"X": [grad, regularization_term]},
+            outputs={"Out": [new_grad]},
+            attrs={fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward},
+        )
+        params_and_grads.append((param, new_grad))
+    return params_and_grads
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

@@ -1,0 +1,37 @@
+import os
+
+# Force a virtual 8-device CPU mesh for all tests (SURVEY.md §4 test plan:
+# multi-host behavior simulated via xla_force_host_platform_device_count).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+import jax
+
+# Numeric tests compare against float64 numpy references; use full-precision
+# matmuls (the framework default is device-native fast precision).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Give every test fresh default programs + scope."""
+    import paddle_tpu as pt
+    from paddle_tpu.core import framework as fw
+    from paddle_tpu.core import executor as ex
+
+    old_main = fw.switch_main_program(fw.Program())
+    old_startup = fw.switch_startup_program(fw.Program())
+    old_scope = ex._global_scope
+    ex._global_scope = ex.Scope()
+    with fw.guard_unique_name():
+        yield
+    fw.switch_main_program(old_main)
+    fw.switch_startup_program(old_startup)
+    ex._global_scope = old_scope

@@ -1,0 +1,197 @@
+"""OpTest harness — parity with the reference's
+python/paddle/fluid/tests/unittests/op_test.py: run a single op through the
+executor, check outputs against a numpy reference, and check analytic
+gradients (append_backward over a tiny program) against numeric finite
+differences (reference op_test.py:43 get_numeric_gradient, :425 check_grad).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core import framework as fw
+
+
+def build_op_program(op_type, inputs, attrs, output_slots):
+    """Build a fresh program containing just `op_type`.
+
+    inputs: {slot: [(name, np_array)]}
+    output_slots: {slot: [names]}
+    Returns (program, feed_dict, out_names)
+    """
+    prog = fw.Program()
+    startup = fw.Program()
+    feed = {}
+    with fw.program_guard(prog, startup):
+        block = prog.global_block()
+        in_spec = {}
+        for slot, pairs in inputs.items():
+            names = []
+            for name, arr in pairs:
+                arr = np.asarray(arr)
+                block.create_var(
+                    name=name, shape=arr.shape, dtype=str(arr.dtype), is_data=True
+                )
+                feed[name] = arr
+                names.append(name)
+            in_spec[slot] = names
+        out_spec = {}
+        for slot, names in output_slots.items():
+            for n in names:
+                block.create_var(name=n, dtype="float32")
+            out_spec[slot] = list(names)
+        block.append_op(op_type, inputs=in_spec, outputs=out_spec, attrs=attrs)
+    return prog, feed, out_spec
+
+
+class OpTest:
+    """Subclass and set: op_type, inputs {slot: np or [(name, np)]},
+    attrs, outputs {slot: expected np or name list}."""
+
+    op_type: str = ""
+    attrs: Dict = {}
+
+    def _norm_inputs(self, inputs):
+        out = {}
+        for slot, v in inputs.items():
+            if isinstance(v, list):
+                out[slot] = [(n, np.asarray(a)) for n, a in v]
+            else:
+                out[slot] = [(slot, np.asarray(v))]
+        return out
+
+    def _out_slots(self, outputs):
+        slots = {}
+        for slot, v in outputs.items():
+            if isinstance(v, list):
+                slots[slot] = [n for n, _ in v]
+            else:
+                slots[slot] = [slot + "@out"]
+        return slots
+
+    def check_output(self, inputs, outputs, attrs=None, atol=1e-5, rtol=1e-5):
+        attrs = attrs if attrs is not None else self.attrs
+        norm_in = self._norm_inputs(inputs)
+        out_slots = self._out_slots(outputs)
+        prog, feed, out_spec = build_op_program(
+            self.op_type, norm_in, attrs, out_slots
+        )
+        exe = pt.Executor(pt.CPUPlace())
+        fetch = [n for ns in out_spec.values() for n in ns]
+        res = exe.run(prog, feed=feed, fetch_list=fetch)
+        got = dict(zip(fetch, res))
+        for slot, v in outputs.items():
+            if isinstance(v, list):
+                for n, expected in v:
+                    np.testing.assert_allclose(
+                        got[n], expected, atol=atol, rtol=rtol,
+                        err_msg=f"{self.op_type} output {n}",
+                    )
+            else:
+                np.testing.assert_allclose(
+                    got[slot + "@out"], v, atol=atol, rtol=rtol,
+                    err_msg=f"{self.op_type} output {slot}",
+                )
+        return got
+
+    def check_grad(
+        self,
+        inputs,
+        output_slots: Dict[str, List[str]],
+        grad_targets: List[str],
+        loss_slot: Optional[str] = None,
+        attrs=None,
+        delta=1e-3,
+        atol=1e-3,
+        rtol=1e-2,
+    ):
+        """Compare analytic grads (append_backward) vs finite differences of
+        mean(sum(outputs)) — mirrors reference check_grad."""
+        attrs = attrs if attrs is not None else self.attrs
+        norm_in = self._norm_inputs(inputs)
+
+        def build(feed_override=None):
+            prog = fw.Program()
+            startup = fw.Program()
+            with fw.program_guard(prog, startup):
+                block = prog.global_block()
+                feed = {}
+                in_spec = {}
+                for slot, pairs in norm_in.items():
+                    names = []
+                    for name, arr in pairs:
+                        a = (
+                            feed_override[name]
+                            if feed_override and name in feed_override
+                            else arr
+                        )
+                        block.create_var(
+                            name=name, shape=a.shape, dtype=str(a.dtype),
+                            is_data=name not in grad_targets,
+                            stop_gradient=name not in grad_targets,
+                        )
+                        feed[name] = a
+                        names.append(name)
+                    in_spec[slot] = names
+                out_spec = {}
+                for slot, names in output_slots.items():
+                    for n in names:
+                        block.create_var(name=n, dtype="float32")
+                    out_spec[slot] = list(names)
+                block.append_op(self.op_type, inputs=in_spec, outputs=out_spec, attrs=attrs)
+                # loss = mean over (sum of) outputs in loss_slot (or first)
+                tslot = loss_slot or list(output_slots)[0]
+                tnames = out_spec[tslot]
+                from paddle_tpu import layers
+
+                target = tnames[0]
+                loss = layers.reduce_mean(block.var(target))
+            return prog, feed, loss
+
+        # analytic
+        prog, feed, loss = build()
+        with fw.program_guard(prog):
+            pt.append_backward(loss)
+        exe = pt.Executor(pt.CPUPlace())
+        grad_names = [fw.grad_var_name(n) for n in grad_targets]
+        analytic = exe.run(prog, feed=feed, fetch_list=grad_names)
+
+        # numeric: ONE program, rerun with perturbed feeds (executor caches
+        # the compiled executable across calls)
+        prog2, base_feed2, loss2 = build()
+        exe2 = pt.Executor(pt.CPUPlace())
+
+        def fwd(feed_override):
+            feed2 = dict(base_feed2)
+            feed2.update(feed_override)
+            (out,) = exe2.run(prog2, feed=feed2, fetch_list=[loss2])
+            return float(np.asarray(out))
+
+        for gname, tname, g_analytic in zip(grad_names, grad_targets, analytic):
+            base = None
+            for slot, pairs in norm_in.items():
+                for name, arr in pairs:
+                    if name == tname:
+                        base = arr.astype(np.float64)
+            assert base is not None
+            numeric = np.zeros_like(base)
+            flat = base.ravel()
+            num_flat = numeric.ravel()
+            for i in range(flat.size):
+                orig = flat[i]
+                flat[i] = orig + delta
+                f_pos = fwd({tname: base.astype(np.float32)})
+                flat[i] = orig - delta
+                f_neg = fwd({tname: base.astype(np.float32)})
+                flat[i] = orig
+                num_flat[i] = (f_pos - f_neg) / (2 * delta)
+            np.testing.assert_allclose(
+                np.asarray(g_analytic),
+                numeric,
+                atol=atol,
+                rtol=rtol,
+                err_msg=f"{self.op_type} grad wrt {tname}",
+            )

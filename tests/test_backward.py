@@ -1,0 +1,97 @@
+"""Autodiff-machinery tests (reference: test_backward.py, test_calc_gradient.py)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_gradients_wrt_data_var():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.reduce_mean(layers.square(x))
+    (gx,) = pt.gradients(y, x)
+    assert gx is not None
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.arange(8, dtype="float32").reshape(2, 4)
+    (g,) = exe.run(feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xv / xv.size, rtol=1e-5)
+
+
+def test_repeated_use_accumulates():
+    # x used by two consumers: grads must sum
+    x = layers.data(name="x", shape=[3], dtype="float32")
+    a = layers.scale(x, scale=2.0)
+    b = layers.scale(x, scale=3.0)
+    s = layers.elementwise_add(a, b)
+    loss = layers.reduce_sum(s)
+    (gx,) = pt.gradients(loss, x)
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.ones((2, 3), np.float32)
+    (g,) = exe.run(feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, np.full((2, 3), 5.0), rtol=1e-6)
+
+
+def test_stop_gradient_blocks():
+    x = layers.data(name="x", shape=[3], dtype="float32")
+    w = layers.data(name="w", shape=[3], dtype="float32")
+    w.stop_gradient = True
+    y = layers.elementwise_mul(x, w)
+    loss = layers.reduce_sum(y)
+    pg = pt.append_backward(loss)
+    blk = pt.default_main_program().global_block()
+    assert not blk.has_var_recursive(pt.grad_var_name("w"))
+
+
+def test_dropout_seed_reproducible():
+    x = layers.data(name="x", shape=[100], dtype="float32")
+    out = layers.dropout(x, dropout_prob=0.5, seed=1234)
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.ones((4, 100), np.float32)
+    (o1,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    (o2,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_array_equal(o1, o2)  # fixed seed -> same mask
+    assert (o1 == 0).mean() > 0.2  # dropout actually active
+
+
+def test_dropout_no_seed_varies():
+    x = layers.data(name="x", shape=[100], dtype="float32")
+    out = layers.dropout(x, dropout_prob=0.5)
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.ones((4, 100), np.float32)
+    (o1,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    (o2,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    assert (np.asarray(o1) != np.asarray(o2)).any()
+
+
+def test_dropout_grad_uses_mask():
+    x = layers.data(name="x", shape=[50], dtype="float32")
+    x.stop_gradient = False
+    x.is_data = False
+    out = layers.dropout(x, dropout_prob=0.3,
+                         dropout_implementation="upscale_in_train")
+    loss = layers.reduce_sum(out)
+    (gx,) = pt.gradients(loss, x)
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.ones((4, 50), np.float32)
+    g, o = exe.run(feed={"x": xv}, fetch_list=[gx, out])
+    # grad == mask: zero where dropped, 1/(1-p) where kept
+    np.testing.assert_allclose(g, np.asarray(o), rtol=1e-6)
+
+
+def test_cumsum_exclusive_reverse():
+    x = layers.data(name="x", shape=[3], dtype="float32")
+    out = layers.cumsum(x, axis=-1, exclusive=True, reverse=True)
+    exe = pt.Executor(pt.CPUPlace())
+    (o,) = exe.run(feed={"x": np.array([[1, 2, 3]], np.float32)}, fetch_list=[out])
+    np.testing.assert_allclose(o, [[5, 3, 0]])
+
+
+def test_conv2d_transpose_groups():
+    x = layers.data(name="x", shape=[4, 5, 5], dtype="float32")
+    out = layers.conv2d_transpose(x, num_filters=8, filter_size=3, groups=2,
+                                  stride=2, bias_attr=False)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    (o,) = exe.run(feed={"x": np.random.rand(2, 4, 5, 5).astype("float32")},
+                   fetch_list=[out])
+    assert o.shape == (2, 8, 11, 11), o.shape

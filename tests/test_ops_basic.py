@@ -1,0 +1,329 @@
+"""Per-op numeric tests (reference test strategy: unittests/test_*_op.py via
+OpTest — SURVEY.md §4.2)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def test_same_shape(self):
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(3, 4).astype("float32")
+        self.check_output({"X": x, "Y": y}, {"Out": x + y})
+
+    def test_broadcast_axis1(self):
+        x = np.random.rand(2, 3, 4, 5).astype("float32")
+        y = np.random.rand(3).astype("float32")
+        self.check_output(
+            {"X": x, "Y": y},
+            {"Out": x + y.reshape(1, 3, 1, 1)},
+            attrs={"axis": 1},
+        )
+
+    def test_grad(self):
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(3, 4).astype("float32")
+        self.check_grad(
+            {"X": [("x", x)], "Y": [("y", y)]},
+            {"Out": ["out"]},
+            grad_targets=["x", "y"],
+        )
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def test_basic(self):
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(4, 5).astype("float32")
+        self.check_output({"X": x, "Y": y}, {"Out": x @ y})
+
+    def test_transpose(self):
+        x = np.random.rand(4, 3).astype("float32")
+        y = np.random.rand(5, 4).astype("float32")
+        self.check_output(
+            {"X": x, "Y": y},
+            {"Out": x.T @ y.T},
+            attrs={"transpose_X": True, "transpose_Y": True},
+        )
+
+    def test_batched(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(2, 4, 5).astype("float32")
+        self.check_output({"X": x, "Y": y}, {"Out": x @ y})
+
+    def test_grad(self):
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(4, 2).astype("float32")
+        self.check_grad(
+            {"X": [("x", x)], "Y": [("y", y)]},
+            {"Out": ["out"]},
+            grad_targets=["x", "y"],
+        )
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def test_flatten(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(12, 5).astype("float32")
+        self.check_output(
+            {"X": x, "Y": y},
+            {"Out": x.reshape(2, 12) @ y},
+            attrs={"x_num_col_dims": 1, "y_num_col_dims": 1},
+        )
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def test_output(self):
+        x = np.random.rand(4, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.check_output({"X": x}, {"Out": e / e.sum(-1, keepdims=True)})
+
+    def test_grad(self):
+        x = np.random.rand(3, 5).astype("float32")
+        self.check_grad({"X": [("x", x)]}, {"Out": ["out"]}, grad_targets=["x"])
+
+
+class TestSoftmaxWithCE(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test_output(self):
+        logits = np.random.rand(5, 7).astype("float32")
+        label = np.random.randint(0, 7, (5, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), label.ravel()]).reshape(5, 1)
+        self.check_output(
+            {"Logits": [("Logits", logits)], "Label": [("Label", label)]},
+            {"Softmax": [("sm", sm)], "Loss": [("loss", loss)]},
+            atol=1e-4, rtol=1e-3,
+        )
+
+
+class TestReduce(OpTest):
+    op_type = "reduce_sum"
+
+    def test_dim(self):
+        x = np.random.rand(3, 4, 5).astype("float32")
+        self.check_output(
+            {"X": x}, {"Out": x.sum(1)}, attrs={"dim": [1], "keep_dim": False}
+        )
+
+    def test_all(self):
+        x = np.random.rand(3, 4).astype("float32")
+        self.check_output(
+            {"X": x}, {"Out": x.sum()}, attrs={"reduce_all": True, "dim": [0]}
+        )
+
+    def test_grad(self):
+        x = np.random.rand(3, 4).astype("float32")
+        self.check_grad(
+            {"X": [("x", x)]}, {"Out": ["out"]}, grad_targets=["x"],
+            attrs={"dim": [1], "keep_dim": False},
+        )
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def _ref_conv(self, x, w, stride, pad):
+        import jax
+
+        out = jax.lax.conv_general_dilated(
+            x, w, (stride, stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        return np.asarray(out)
+
+    def test_output(self):
+        x = np.random.rand(2, 3, 8, 8).astype("float32")
+        w = np.random.rand(4, 3, 3, 3).astype("float32")
+        expected = self._ref_conv(x, w, 1, 1)
+        self.check_output(
+            {"Input": [("Input", x)], "Filter": [("Filter", w)]},
+            {"Output": [("out", expected)]},
+            attrs={"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1], "groups": 1},
+            atol=1e-4, rtol=1e-4,
+        )
+
+    def test_grad(self):
+        x = np.random.rand(1, 2, 5, 5).astype("float32")
+        w = np.random.rand(2, 2, 3, 3).astype("float32")
+        self.check_grad(
+            {"Input": [("Input", x)], "Filter": [("Filter", w)]},
+            {"Output": ["out"]},
+            grad_targets=["Input", "Filter"],
+            attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1], "groups": 1},
+            atol=5e-3, rtol=5e-2,
+        )
+
+
+class TestPool2d(OpTest):
+    op_type = "pool2d"
+
+    def test_max(self):
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        expected = x.reshape(2, 3, 2, 2, 2, 2).max((3, 5))
+        self.check_output(
+            {"X": x},
+            {"Out": expected},
+            attrs={"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+                   "paddings": [0, 0]},
+        )
+
+    def test_avg(self):
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        expected = x.reshape(2, 3, 2, 2, 2, 2).mean((3, 5))
+        self.check_output(
+            {"X": x},
+            {"Out": expected},
+            attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+                   "paddings": [0, 0]},
+        )
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def test_output(self):
+        x = np.random.rand(4, 10).astype("float32")
+        scale = np.random.rand(10).astype("float32")
+        bias = np.random.rand(10).astype("float32")
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        self.check_output(
+            {"X": [("X", x)], "Scale": [("Scale", scale)], "Bias": [("Bias", bias)]},
+            {"Y": [("y", y)]},
+            attrs={"begin_norm_axis": 1, "epsilon": 1e-5},
+            atol=1e-4,
+        )
+
+    def test_grad(self):
+        x = np.random.rand(3, 6).astype("float32")
+        scale = np.random.rand(6).astype("float32")
+        bias = np.random.rand(6).astype("float32")
+        self.check_grad(
+            {"X": [("X", x)], "Scale": [("Scale", scale)], "Bias": [("Bias", bias)]},
+            {"Y": ["y"], "Mean": ["m"], "Variance": ["v"]},
+            grad_targets=["X", "Scale"],
+            loss_slot="Y",
+            atol=5e-3, rtol=5e-2,
+        )
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def test_output(self):
+        w = np.random.rand(10, 4).astype("float32")
+        ids = np.array([[1], [3], [1], [9]]).astype("int64")
+        self.check_output(
+            {"W": [("W", w)], "Ids": [("Ids", ids)]},
+            {"Out": [("out", w[ids.ravel()])]},
+        )
+
+    def test_grad(self):
+        w = np.random.rand(6, 3).astype("float32")
+        ids = np.array([[1], [3], [1]]).astype("int64")
+        self.check_grad(
+            {"W": [("W", w)], "Ids": [("Ids", ids)]},
+            {"Out": ["out"]},
+            grad_targets=["W"],
+        )
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose"
+
+    def test_output(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.check_output(
+            {"X": x}, {"Out": x.transpose(2, 0, 1)}, attrs={"axis": [2, 0, 1]}
+        )
+
+
+class TestReshape(OpTest):
+    op_type = "reshape"
+
+    def test_infer(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.check_output(
+            {"X": x}, {"Out": x.reshape(2, 12)}, attrs={"shape": [0, -1]}
+        )
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def test_output(self):
+        a = np.random.rand(2, 3).astype("float32")
+        b = np.random.rand(2, 5).astype("float32")
+        self.check_output(
+            {"X": [("a", a), ("b", b)]},
+            {"Out": [("out", np.concatenate([a, b], 1))]},
+            attrs={"axis": 1},
+        )
+
+
+class TestBatchNorm(OpTest):
+    op_type = "batch_norm"
+
+    def test_train(self):
+        x = np.random.rand(4, 3, 2, 2).astype("float32")
+        scale = np.random.rand(3).astype("float32")
+        bias = np.random.rand(3).astype("float32")
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        bm = x.mean((0, 2, 3))
+        bv = x.var((0, 2, 3))
+        y = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(
+            bv.reshape(1, 3, 1, 1) + 1e-5
+        ) * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.check_output(
+            {
+                "X": [("X", x)],
+                "Scale": [("Scale", scale)],
+                "Bias": [("Bias", bias)],
+                "Mean": [("Mean", mean)],
+                "Variance": [("Variance", var)],
+            },
+            {
+                "Y": [("y", y)],
+                "MeanOut": [("mo", 0.9 * mean + 0.1 * bm)],
+                "VarianceOut": [("vo", 0.9 * var + 0.1 * bv)],
+                "SavedMean": [("sm", bm)],
+                "SavedVariance": [("sv", bv)],
+            },
+            attrs={"momentum": 0.9, "epsilon": 1e-5, "is_test": False},
+            atol=1e-4,
+        )
+
+
+class TestActivations(OpTest):
+    def test_relu_grad(self):
+        self.op_type = "relu"
+        x = (np.random.rand(3, 4).astype("float32") - 0.5) * 2
+        x[np.abs(x) < 0.05] = 0.1  # keep away from kink
+        self.check_grad({"X": [("x", x)]}, {"Out": ["out"]}, grad_targets=["x"])
+
+    def test_tanh(self):
+        self.op_type = "tanh"
+        x = np.random.rand(3, 4).astype("float32")
+        self.check_output({"X": x}, {"Out": np.tanh(x)})
+
+    def test_gelu(self):
+        self.op_type = "gelu"
+        x = np.random.randn(3, 4).astype("float32")
+        from scipy.special import erf  # scipy ships with the env? fallback below
+
+        expected = x * 0.5 * (1 + erf(x / np.sqrt(2)))
+        self.check_output({"X": x}, {"Out": expected}, atol=1e-5)
