@@ -1,0 +1,196 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+Replaces the reference's unfused matmul+softmax+matmul attention chain
+(tests/unittests/transformer_model.py:44 builds it op-by-op; the reference
+has no fused attention kernel at all — this is the TPU capability upgrade
+called out in SURVEY.md §7.6).
+
+Design (per pallas_guide.md): grid over (batch*heads, q_blocks); K/V stream
+through VMEM in kv_blocks of the inner loop with running max/sum
+(online softmax), accumulating in fp32.  Falls back to a pure-XLA
+implementation off-TPU or for unaligned shapes.  Causal masking is
+bottom-right aligned (same as the XLA fallback) so tq != tk is consistent
+across paths.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def reference_attention(q, k, v, bias=None, scale=1.0, causal=False):
+    """Pure-XLA fallback (and numerics reference for tests)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        logits = jnp.where(mask, logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale, block_k,
+                  causal, seq_k, block_q, causal_offset):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    d = q.shape[-1]
+    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    n_kv = seq_k // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T  # [block_q, block_k]
+        if bias_ref is not None:
+            b = bias_ref[0, :, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+            s = s + b
+        if causal:
+            # bottom-right aligned: allow k_pos <= q_pos + (seq_k - seq_q)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos + causal_offset >= k_pos, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=1)
+        acc_new = acc * alpha[:, None] + p @ v
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m, l, acc))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
+                    block_q=512, block_k=512, interpret=None):
+    """q,k,v: [B, H, T, D]; bias: broadcastable [B, H, Tq, Tk] or None.
+    Returns [B, H, Tq, D].
+
+    Differentiable: forward runs the Pallas kernel; backward is the XLA vjp
+    of the reference formulation (activation-recompute style — no softmax
+    matrix is materialized in fwd residuals)."""
+    import jax
+
+    if bias is None:
+        @jax.custom_vjp
+        def _attn3(q, k, v):
+            return _flash_forward(q, k, v, None, scale, causal, block_q,
+                                  block_k, interpret)
+
+        def _fwd3(q, k, v):
+            return _attn3(q, k, v), (q, k, v)
+
+        def _bwd3(res, g):
+            q, k, v = res
+            _, vjp = jax.vjp(
+                lambda q, k, v: reference_attention(q, k, v, None, scale, causal),
+                q, k, v,
+            )
+            return vjp(g)
+
+        _attn3.defvjp(_fwd3, _bwd3)
+        return _attn3(q, k, v)
+
+    @jax.custom_vjp
+    def _attn(q, k, v, bias):
+        return _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
+                              interpret)
+
+    def _fwd(q, k, v, bias):
+        return _attn(q, k, v, bias), (q, k, v, bias)
+
+    def _bwd(res, g):
+        q, k, v, bias = res
+        _, vjp = jax.vjp(
+            lambda q, k, v, bias: reference_attention(q, k, v, bias, scale, causal),
+            q, k, v, bias,
+        )
+        return vjp(g)
+
+    _attn.defvjp(_fwd, _bwd)
+    return _attn(q, k, v, bias)
+
+
+def _flash_forward(q, k, v, bias=None, scale=1.0, causal=False,
+                   block_q=512, block_k=512, interpret=None):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    # Mosaic constraint: lane-dim (last-dim) slice offsets must be 128-aligned
+    # on real TPU, so block_k must be a multiple of 128 there.
+    if on_tpu and not interpret:
+        if block_k % 128:
+            block_k = 128 if tk % 128 == 0 else 0
+        if block_q % 8:
+            block_q = 0
+    if (
+        not block_q
+        or not block_k
+        or tq % block_q
+        or tk % block_k
+        or d % 128
+        or (not on_tpu and not interpret)
+    ):
+        return reference_attention(q, k, v, bias, scale, causal)
+
+    bh = b * h
+    q3 = q.reshape(bh, tq, d)
+    k3 = k.reshape(bh, tk, d)
+    v3 = v.reshape(bh, tk, d)
+    grid = (bh, tq // block_q)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+    ]
+    args = [q3, k3, v3]
+    kern = functools.partial(
+        _flash_kernel, scale=scale, block_k=block_k, causal=causal,
+        seq_k=tk, block_q=block_q, causal_offset=tk - tq,
+    )
+    if bias is not None:
+        bias_full = jnp.broadcast_to(bias, (b, h, tq, tk)).reshape(bh, tq, tk)
+        in_specs.append(pl.BlockSpec((1, block_q, tk), lambda i, j: (i, j, 0)))
+        args.append(bias_full)
+        kernel = kern
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref):
+            return kern(q_ref, k_ref, v_ref, None, o_ref)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b, h, tq, d)

@@ -3,6 +3,6 @@
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
-from . import nn, tensor, ops  # noqa: F401
+from . import nn, tensor, ops, contrib  # noqa: F401
 
 from .tensor import data  # noqa: F401

@@ -1,0 +1,38 @@
+"""Contrib layers: fused/TPU-native extensions beyond the reference API."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+
+def fused_attention(q, k, v, bias=None, scale=1.0, causal=False,
+                    dropout_rate=0.0, block_q=512, block_k=512, name=None):
+    """Flash-attention layer over [B,H,T,D] tensors (Pallas kernel on TPU).
+
+    NOTE: with dropout_rate > 0 this applies dropout to the attention
+    *output* (flash-style), not to the attention weights like the unfused
+    path — toggling use_flash changes regularization semantics under
+    dropout."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op(
+        "fused_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={
+            "scale": float(scale),
+            "causal": causal,
+            "block_q": block_q,
+            "block_k": block_k,
+        },
+    )
+    out.shape = q.shape
+    if dropout_rate:
+        from .nn import dropout
+
+        out = dropout(out, dropout_prob=dropout_rate,
+                      dropout_implementation="upscale_in_train")
+    return out

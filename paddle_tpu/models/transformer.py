@@ -1,0 +1,301 @@
+"""Transformer (reference: python/paddle/fluid/tests/unittests/
+transformer_model.py — multi_head_attention:44, positionwise_feed_forward,
+pre/post_process_layer, encoder_layer, decoder_layer, transformer:396).
+
+TPU-first: static padded sequences + additive attention bias (instead of the
+reference's LoD-free padded path), bf16-friendly; the fused flash-attention
+path lives in kernels/attention.py and is switched in via use_flash."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..initializer import NormalInitializer
+from ..param_attr import ParamAttr
+
+
+def multi_head_attention(
+    queries,
+    keys,
+    values,
+    attn_bias,
+    d_key,
+    d_value,
+    d_model,
+    n_head=1,
+    dropout_rate=0.0,
+    use_flash=False,
+):
+    """reference transformer_model.py:44."""
+    keys = queries if keys is None else keys
+    values = keys if values is None else values
+
+    q = layers.fc(input=queries, size=d_key * n_head, bias_attr=False,
+                  num_flatten_dims=2)
+    k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
+                  num_flatten_dims=2)
+    v = layers.fc(input=values, size=d_value * n_head, bias_attr=False,
+                  num_flatten_dims=2)
+
+    def split_heads(x, d):
+        b, t, _ = x.shape
+        r = layers.reshape(x, [b, t, n_head, d])
+        return layers.transpose(r, [0, 2, 1, 3])
+
+    q = split_heads(q, d_key)
+    k = split_heads(k, d_key)
+    v = split_heads(v, d_value)
+
+    if use_flash:
+        from ..layers.contrib import fused_attention
+
+        ctx = fused_attention(q, k, v, attn_bias, scale=d_key**-0.5,
+                              dropout_rate=dropout_rate)
+    else:
+        product = layers.matmul(q, k, transpose_y=True, alpha=d_key**-0.5)
+        if attn_bias is not None:
+            product = layers.elementwise_add(product, attn_bias)
+        weights = layers.softmax(product)
+        if dropout_rate:
+            weights = layers.dropout(
+                weights, dropout_prob=dropout_rate,
+                dropout_implementation="upscale_in_train",
+            )
+        ctx = layers.matmul(weights, v)
+
+    b, h, t, d = ctx.shape
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [b, t, h * d])
+    return layers.fc(input=ctx, size=d_model, bias_attr=False, num_flatten_dims=2)
+
+
+def positionwise_feed_forward(x, d_inner_hid, d_hid):
+    hidden = layers.fc(input=x, size=d_inner_hid, act="relu", num_flatten_dims=2)
+    return layers.fc(input=hidden, size=d_hid, num_flatten_dims=2)
+
+
+def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):
+    """reference transformer_model.py pre_post_process_layer: a=add, n=norm,
+    d=dropout."""
+    for cmd in process_cmd:
+        if cmd == "a":
+            out = layers.elementwise_add(out, prev_out) if prev_out is not None else out
+        elif cmd == "n":
+            out = layers.layer_norm(
+                out, begin_norm_axis=len(out.shape) - 1,
+                param_attr=ParamAttr(initializer=None),
+            )
+        elif cmd == "d":
+            if dropout_rate:
+                out = layers.dropout(
+                    out, dropout_prob=dropout_rate,
+                    dropout_implementation="upscale_in_train",
+                )
+    return out
+
+
+def prepare_encoder(
+    src_word,
+    src_pos,
+    src_vocab_size,
+    src_emb_dim,
+    src_max_len,
+    dropout_rate=0.0,
+    word_emb_param_name=None,
+    pos_enc_param_name=None,
+):
+    """Word + sinusoid position embedding (reference prepare_encoder)."""
+    src_word_emb = layers.embedding(
+        src_word,
+        size=[src_vocab_size, src_emb_dim],
+        param_attr=ParamAttr(
+            name=word_emb_param_name,
+            initializer=NormalInitializer(0.0, src_emb_dim**-0.5),
+        ),
+    )
+    src_pos_enc = layers.embedding(
+        src_pos,
+        size=[src_max_len, src_emb_dim],
+        param_attr=ParamAttr(
+            name=pos_enc_param_name,
+            initializer=NormalInitializer(0.0, src_emb_dim**-0.5),
+            trainable=False,
+        ),
+    )
+    src_pos_enc.stop_gradient = True
+    enc_input = layers.elementwise_add(src_word_emb, src_pos_enc)
+    if dropout_rate:
+        enc_input = layers.dropout(
+            enc_input, dropout_prob=dropout_rate,
+            dropout_implementation="upscale_in_train",
+        )
+    return enc_input
+
+
+def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
+                  d_inner_hid, dropout_rate=0.0, use_flash=False):
+    attn_output = multi_head_attention(
+        enc_input, None, None, attn_bias, d_key, d_value, d_model, n_head,
+        dropout_rate, use_flash=use_flash,
+    )
+    attn_output = pre_post_process_layer(enc_input, attn_output, "dan",
+                                         dropout_rate)
+    ffd_output = positionwise_feed_forward(attn_output, d_inner_hid, d_model)
+    return pre_post_process_layer(attn_output, ffd_output, "dan", dropout_rate)
+
+
+def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
+            d_inner_hid, dropout_rate=0.0, use_flash=False):
+    for i in range(n_layer):
+        enc_output = encoder_layer(
+            enc_input, attn_bias, n_head, d_key, d_value, d_model,
+            d_inner_hid, dropout_rate, use_flash=use_flash,
+        )
+        enc_input = enc_output
+    return enc_output
+
+
+def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
+                  n_head, d_key, d_value, d_model, d_inner_hid,
+                  dropout_rate=0.0, use_flash=False):
+    slf_attn_output = multi_head_attention(
+        dec_input, None, None, slf_attn_bias, d_key, d_value, d_model, n_head,
+        dropout_rate, use_flash=use_flash,
+    )
+    slf_attn_output = pre_post_process_layer(dec_input, slf_attn_output, "dan",
+                                             dropout_rate)
+    enc_attn_output = multi_head_attention(
+        slf_attn_output, enc_output, enc_output, dec_enc_attn_bias, d_key,
+        d_value, d_model, n_head, dropout_rate, use_flash=use_flash,
+    )
+    enc_attn_output = pre_post_process_layer(
+        slf_attn_output, enc_attn_output, "dan", dropout_rate
+    )
+    ffd_output = positionwise_feed_forward(enc_attn_output, d_inner_hid, d_model)
+    return pre_post_process_layer(enc_attn_output, ffd_output, "dan", dropout_rate)
+
+
+def decoder(dec_input, enc_output, dec_slf_attn_bias, dec_enc_attn_bias,
+            n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
+            dropout_rate=0.0, use_flash=False):
+    for i in range(n_layer):
+        dec_output = decoder_layer(
+            dec_input, enc_output, dec_slf_attn_bias, dec_enc_attn_bias,
+            n_head, d_key, d_value, d_model, d_inner_hid, dropout_rate,
+            use_flash=use_flash,
+        )
+        dec_input = dec_output
+    return dec_output
+
+
+def transformer(
+    src_vocab_size=10000,
+    trg_vocab_size=10000,
+    max_length=256,
+    n_layer=6,
+    n_head=8,
+    d_key=64,
+    d_value=64,
+    d_model=512,
+    d_inner_hid=2048,
+    dropout_rate=0.1,
+    batch_size=None,
+    src_seq_len=None,
+    trg_seq_len=None,
+    use_flash=False,
+):
+    """Full encoder-decoder Transformer-base (reference
+    transformer_model.py:396).  Declares padded-sequence data vars + attention
+    bias vars; returns (avg_cost, predict, feed_names)."""
+    src_seq_len = src_seq_len or max_length
+    trg_seq_len = trg_seq_len or max_length
+
+    src_word = layers.data(name="src_word", shape=[src_seq_len, 1], dtype="int64")
+    src_pos = layers.data(name="src_pos", shape=[src_seq_len, 1], dtype="int64")
+    trg_word = layers.data(name="trg_word", shape=[trg_seq_len, 1], dtype="int64")
+    trg_pos = layers.data(name="trg_pos", shape=[trg_seq_len, 1], dtype="int64")
+    src_slf_attn_bias = layers.data(
+        name="src_slf_attn_bias", shape=[n_head, src_seq_len, src_seq_len],
+        dtype="float32",
+    )
+    trg_slf_attn_bias = layers.data(
+        name="trg_slf_attn_bias", shape=[n_head, trg_seq_len, trg_seq_len],
+        dtype="float32",
+    )
+    trg_src_attn_bias = layers.data(
+        name="trg_src_attn_bias", shape=[n_head, trg_seq_len, src_seq_len],
+        dtype="float32",
+    )
+    gold = layers.data(name="lbl_word", shape=[trg_seq_len, 1], dtype="int64")
+    weights = layers.data(name="lbl_weight", shape=[trg_seq_len, 1], dtype="float32")
+
+    enc_input = prepare_encoder(
+        src_word, src_pos, src_vocab_size, d_model, max_length, dropout_rate,
+        word_emb_param_name="src_word_emb_table",
+        pos_enc_param_name="src_pos_enc_table",
+    )
+    enc_output = encoder(
+        enc_input, src_slf_attn_bias, n_layer, n_head, d_key, d_value,
+        d_model, d_inner_hid, dropout_rate, use_flash=use_flash,
+    )
+
+    dec_input = prepare_encoder(
+        trg_word, trg_pos, trg_vocab_size, d_model, max_length, dropout_rate,
+        word_emb_param_name="trg_word_emb_table",
+        pos_enc_param_name="trg_pos_enc_table",
+    )
+    dec_output = decoder(
+        dec_input, enc_output, trg_slf_attn_bias, trg_src_attn_bias,
+        n_layer, n_head, d_key, d_value, d_model, d_inner_hid, dropout_rate,
+        use_flash=use_flash,
+    )
+
+    predict = layers.fc(input=dec_output, size=trg_vocab_size,
+                        num_flatten_dims=2)
+    b, t, v = predict.shape
+    predict_2d = layers.reshape(predict, [-1, v])
+    gold_2d = layers.reshape(gold, [-1, 1])
+    cost = layers.softmax_with_cross_entropy(logits=predict_2d, label=gold_2d)
+    w2d = layers.reshape(weights, [-1, 1])
+    weighted_cost = layers.elementwise_mul(cost, w2d)
+    sum_cost = layers.reduce_sum(weighted_cost)
+    token_count = layers.reduce_sum(w2d)
+    avg_cost = layers.elementwise_div(sum_cost, token_count)
+
+    feed_names = [
+        "src_word", "src_pos", "trg_word", "trg_pos",
+        "src_slf_attn_bias", "trg_slf_attn_bias", "trg_src_attn_bias",
+        "lbl_word", "lbl_weight",
+    ]
+    return avg_cost, predict, feed_names
+
+
+def make_batch(batch_size, src_len, trg_len, n_head, src_vocab, trg_vocab,
+               rng=None):
+    """Synthetic padded batch with proper attention biases."""
+    rng = rng or np.random.RandomState(0)
+    neg_inf = -1e9
+
+    def pos(n, t):
+        return np.tile(np.arange(t, dtype=np.int64)[None, :, None], (n, 1, 1))
+
+    src_word = rng.randint(1, src_vocab, (batch_size, src_len, 1)).astype("int64")
+    trg_word = rng.randint(1, trg_vocab, (batch_size, trg_len, 1)).astype("int64")
+    lbl_word = rng.randint(1, trg_vocab, (batch_size, trg_len, 1)).astype("int64")
+    src_bias = np.zeros((batch_size, n_head, src_len, src_len), "float32")
+    causal = np.triu(np.full((trg_len, trg_len), neg_inf, "float32"), 1)
+    trg_bias = np.tile(causal[None, None], (batch_size, n_head, 1, 1))
+    cross_bias = np.zeros((batch_size, n_head, trg_len, src_len), "float32")
+    lbl_weight = np.ones((batch_size, trg_len, 1), "float32")
+    return {
+        "src_word": src_word,
+        "src_pos": pos(batch_size, src_len),
+        "trg_word": trg_word,
+        "trg_pos": pos(batch_size, trg_len),
+        "src_slf_attn_bias": src_bias,
+        "trg_slf_attn_bias": trg_bias,
+        "trg_src_attn_bias": cross_bias,
+        "lbl_word": lbl_word,
+        "lbl_weight": lbl_weight,
+    }

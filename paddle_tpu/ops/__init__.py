@@ -8,4 +8,5 @@ from . import (  # noqa: F401
     random_ops,
     optimizer_ops,
     metric_ops,
+    fused_ops,
 )

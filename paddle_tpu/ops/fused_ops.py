@@ -1,0 +1,48 @@
+"""Fused ops backed by Pallas kernels (the TPU analogue of the reference's
+operators/fused/ CPU+cuDNN fusions and operators/jit/ codegen kernels —
+SURVEY.md §2.3)."""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+@register("fused_attention")
+def lower_fused_attention(ctx, ins):
+    """Flash attention over [B,H,T,D] q/k/v with optional additive bias.
+
+    No dropout inside the op: attention-weight dropout is not expressible in
+    the streaming kernel, and in-op randomness would break the generic vjp
+    re-trace.  The contrib layer applies a separate dropout op on the output
+    (correct masked gradients via the dropout op's saved Mask)."""
+    from ..kernels.attention import flash_attention
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = ins.get("Bias", [None])[0]
+    out = flash_attention(
+        q, k, v, bias,
+        scale=ctx.attr("scale", 1.0),
+        causal=ctx.attr("causal", False),
+        block_q=ctx.attr("block_q", 512),
+        block_k=ctx.attr("block_k", 512),
+    )
+    return {"Out": [out]}
+
+
+@register("fused_layer_norm_gelu")
+def lower_fused_ln_gelu(ctx, ins):
+    """layer_norm + gelu epilogue; XLA fuses these — kept as one op so graph
+    passes can target it (parity with fuse_elewise_add_act ideas)."""
+    import jax
+
+    from .nn_ops import layer_norm_core
+
+    x = ins["X"][0]
+    y, _, _ = layer_norm_core(
+        x,
+        ins.get("Scale", [None])[0],
+        ins.get("Bias", [None])[0],
+        ctx.attr("begin_norm_axis", x.ndim - 1),
+        ctx.attr("epsilon", 1e-5),
+    )
+    return {"Out": [jax.nn.gelu(y)]}

@@ -200,10 +200,17 @@ def lower_adaptive_pool2d(ctx, ins):
 # ---------------------------------------------------------------------------
 
 
-def _bn_infer(ctx):
-    xs = ctx.input_shape("X")
-    if xs is not None:
-        ctx.set_output("Y", xs, ctx.input_dtype("X"))
+def _same_shape_infer(out_slot="Y", in_slot="X"):
+    def infer(ctx):
+        xs = ctx.input_shape(in_slot)
+        if xs is not None:
+            ctx.set_output(out_slot, xs, ctx.input_dtype(in_slot))
+
+    return infer
+
+
+_bn_infer = _same_shape_infer("Y")
+_out_infer = _same_shape_infer("Out")
 
 
 @register("batch_norm", infer_shape=_bn_infer)
@@ -253,26 +260,35 @@ def lower_batch_norm(ctx, ins):
     }
 
 
-@register("layer_norm", infer_shape=_bn_infer)
-def lower_layer_norm(ctx, ins):
-    """reference: layer_norm_op.cc; normalizes over dims >= begin_norm_axis."""
+def layer_norm_core(x, scale, bias, axis, eps):
+    """Shared layer-norm math (also used by fused_layer_norm_gelu)."""
     import jax
 
     jnp = _jnp()
-    x = ins["X"][0]
-    eps = ctx.attr("epsilon", 1e-5)
-    axis = ctx.attr("begin_norm_axis", 1)
     axes = tuple(range(axis, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
     y = (x - mean) * jax.lax.rsqrt(var + eps)
-    scale = ins.get("Scale", [None])[0]
-    bias = ins.get("Bias", [None])[0]
-    norm_shape = x.shape[axis:]
+    norm_shape = (1,) * axis + x.shape[axis:]
     if scale is not None:
-        y = y * scale.reshape((1,) * axis + norm_shape)
+        y = y * scale.reshape(norm_shape)
     if bias is not None:
-        y = y + bias.reshape((1,) * axis + norm_shape)
+        y = y + bias.reshape(norm_shape)
+    return y, mean, var
+
+
+@register("layer_norm", infer_shape=_bn_infer)
+def lower_layer_norm(ctx, ins):
+    """reference: layer_norm_op.cc; normalizes over dims >= begin_norm_axis."""
+    x = ins["X"][0]
+    axis = ctx.attr("begin_norm_axis", 1)
+    y, mean, var = layer_norm_core(
+        x,
+        ins.get("Scale", [None])[0],
+        ins.get("Bias", [None])[0],
+        axis,
+        ctx.attr("epsilon", 1e-5),
+    )
     return {
         "Y": [y],
         "Mean": [mean.reshape(x.shape[:axis])],
@@ -340,7 +356,7 @@ def lower_norm(ctx, ins):
 # ---------------------------------------------------------------------------
 
 
-@register("softmax", infer_shape=_bn_infer)
+@register("softmax", infer_shape=_out_infer)
 def lower_softmax(ctx, ins):
     import jax
 
@@ -554,7 +570,7 @@ def _dropout_grad_maker(op, no_grad_set, grad_sub_block_map=None):
     ]
 
 
-@register("dropout", infer_shape=_bn_infer, grad_maker=_dropout_grad_maker)
+@register("dropout", infer_shape=_out_infer, grad_maker=_dropout_grad_maker)
 def lower_dropout(ctx, ins):
     import jax
 

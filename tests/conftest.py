@@ -2,7 +2,9 @@ import os
 
 # Force a virtual 8-device CPU mesh for all tests (SURVEY.md §4 test plan:
 # multi-host behavior simulated via xla_force_host_platform_device_count).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# PT_TEST_PLATFORM=tpu runs the suite against a real TPU backend (exercises
+# the actual Mosaic kernel paths); default is deterministic CPU.
+os.environ["JAX_PLATFORMS"] = os.environ.get("PT_TEST_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,113 @@
+"""Transformer model + flash attention tests (reference:
+test_parallel_executor_transformer.py / dist_transformer.py scale-downs)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import transformer as T
+
+
+def _tiny_transformer(use_flash=False):
+    return T.transformer(
+        src_vocab_size=64,
+        trg_vocab_size=64,
+        max_length=16,
+        n_layer=2,
+        n_head=2,
+        d_key=8,
+        d_value=8,
+        d_model=16,
+        d_inner_hid=32,
+        dropout_rate=0.0,
+        src_seq_len=16,
+        trg_seq_len=16,
+        use_flash=use_flash,
+    )
+
+
+def test_transformer_trains():
+    avg_cost, predict, feed_names = _tiny_transformer()
+    pt.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    batch = T.make_batch(4, 16, 16, 2, 64, 64, rng)
+    losses = []
+    for _ in range(30):
+        (l,) = exe.run(feed=batch, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0] * 0.6, losses  # memorizes the fixed batch
+
+
+def test_flash_attention_matches_reference():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.attention import (
+        flash_attention,
+        reference_attention,
+    )
+
+    with jax.default_matmul_precision("highest"):
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(1, 2, 128, 128).astype("float32"))
+        k = jnp.asarray(rng.randn(1, 2, 128, 128).astype("float32"))
+        v = jnp.asarray(rng.randn(1, 2, 128, 128).astype("float32"))
+        bias = jnp.asarray(rng.randn(1, 2, 128, 128).astype("float32"))
+        ref = reference_attention(q, k, v, bias, scale=0.125)
+        out = flash_attention(q, k, v, bias, scale=0.125, block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+        refc = reference_attention(q, k, v, None, 0.125, causal=True)
+        outc = flash_attention(q, k, v, None, 0.125, causal=True,
+                               block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(outc), np.asarray(refc), atol=1e-5)
+
+
+def test_fused_attention_layer_in_program():
+    from paddle_tpu import layers
+
+    q = layers.data(name="q", shape=[2, 64, 128], dtype="float32")
+    k = layers.data(name="k", shape=[2, 64, 128], dtype="float32")
+    v = layers.data(name="v", shape=[2, 64, 128], dtype="float32")
+    out = layers.contrib.fused_attention(q, k, v, scale=0.1)
+    exe = pt.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {
+        n: rng.randn(1, 2, 64, 128).astype("float32") for n in ("q", "k", "v")
+    }
+    (o,) = exe.run(feed=feed, fetch_list=[out])
+    assert o.shape == (1, 2, 64, 128)
+
+    from paddle_tpu.kernels.attention import reference_attention
+    import jax.numpy as jnp
+
+    ref = reference_attention(
+        jnp.asarray(feed["q"]), jnp.asarray(feed["k"]), jnp.asarray(feed["v"]),
+        None, 0.1,
+    )
+    np.testing.assert_allclose(o, np.asarray(ref), atol=2e-2, rtol=2e-2)
+
+
+def test_transformer_with_flash_matches_unfused():
+    # same seed -> same params; flash vs unfused attention give same loss
+    prog_a, prog_b = pt.Program(), pt.Program()
+    startup_a, startup_b = pt.Program(), pt.Program()
+    losses = {}
+    rng_batch = np.random.RandomState(3)
+    batch = T.make_batch(2, 16, 16, 2, 64, 64, rng_batch)
+    for name, prog, startup, flash in (
+        ("unfused", prog_a, startup_a, False),
+        ("flash", prog_b, startup_b, True),
+    ):
+        with pt.program_guard(prog, startup):
+            with pt.core.framework.guard_unique_name():
+                avg_cost, _, _ = _tiny_transformer(use_flash=flash)
+        prog.random_seed = startup.random_seed = 17
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        (l,) = exe.run(prog, feed=batch, fetch_list=[avg_cost], scope=scope)
+        losses[name] = float(np.asarray(l))
+    assert abs(losses["flash"] - losses["unfused"]) < 2e-2, losses
