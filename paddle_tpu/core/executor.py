@@ -348,9 +348,16 @@ class Executor:
 
     # -- internals -------------------------------------------------------
     def _to_device_array(self, program, name, value):
+        import jax
         import jax.numpy as jnp
 
         v = program.global_block()._find_var_recursive(name)
+        if isinstance(value, jax.Array):
+            # already device-resident: never round-trip to host (but honor a
+            # declared bfloat16 feed dtype, same as the numpy path)
+            if v is not None and v.dtype == "bfloat16" and value.dtype != jnp.bfloat16:
+                return value.astype(jnp.bfloat16)
+            return value
         arr = np.asarray(value)
         if v is not None and v.dtype and arr.dtype != np.dtype("O"):
             target = v.dtype

@@ -1,0 +1,81 @@
+"""DataFeeder: sample batches -> feed dict of dense arrays
+(reference: python/paddle/fluid/data_feeder.py — DataToLoDTensorConverter/
+DataFeeder).
+
+TPU-first: instead of LoD tensors for ragged samples, variable-length
+sequences are padded to the var's static sequence length (SURVEY.md §5.7:
+dense padding + masks replaces LoD)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .core import framework as fw
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        self.program = program or fw.default_main_program()
+        self.feed_vars: List[fw.Variable] = []
+        for v in feed_list:
+            if isinstance(v, str):
+                v = self.program.global_block().var(v)
+            self.feed_vars.append(v)
+        self.place = place
+
+    def feed(self, iterable) -> Dict[str, np.ndarray]:
+        """iterable: list of samples; each sample is a tuple aligned with
+        feed_list.  Returns {name: batched ndarray}."""
+        columns: List[List] = [[] for _ in self.feed_vars]
+        for sample in iterable:
+            assert len(sample) == len(self.feed_vars), (
+                f"sample arity {len(sample)} != feed arity {len(self.feed_vars)}"
+            )
+            for c, v in zip(columns, sample):
+                c.append(v)
+        out = {}
+        for var, col in zip(self.feed_vars, columns):
+            arr = self._to_batch(var, col)
+            out[var.name] = arr
+        return out
+
+    def _to_batch(self, var: fw.Variable, col: List) -> np.ndarray:
+        if not col:
+            raise ValueError(f"DataFeeder.feed: empty batch for {var.name!r}")
+        dtype = np.float32 if var.dtype == "bfloat16" else np.dtype(var.dtype)
+        # dim 0 of the var is the batch dim by convention (layers.data
+        # prepends -1); per-sample target shape is the rest
+        sample_shape = tuple(var.shape[1:]) if var.shape else None
+        arrs = [np.asarray(c, dtype=dtype) for c in col]
+        shapes = {a.shape for a in arrs}
+        if len(shapes) == 1:
+            batch = np.stack(arrs)
+            if sample_shape and batch.shape[1:] != sample_shape and all(
+                s not in (-1, None) for s in sample_shape
+            ):
+                try:
+                    batch = batch.reshape(
+                        (len(arrs),) + tuple(int(s) for s in sample_shape)
+                    )
+                except ValueError:
+                    pass  # shape-inference mismatch: let the lowering report
+            return batch
+        # ragged: pad each sample's first axis to the var's static sequence
+        # length (dense padding replaces the reference's LoD, SURVEY.md §5.7)
+        if sample_shape and sample_shape[0] not in (-1, None):
+            max_len = int(sample_shape[0])
+            too_long = max(a.shape[0] for a in arrs)
+            if too_long > max_len:
+                raise ValueError(
+                    f"sample length {too_long} exceeds {var.name!r} static "
+                    f"sequence length {max_len}"
+                )
+        else:
+            max_len = max(a.shape[0] for a in arrs)
+        padded = []
+        for a in arrs:
+            pad = [(0, max_len - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+            padded.append(np.pad(a, pad))
+        return np.stack(padded)

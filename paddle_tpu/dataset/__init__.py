@@ -1,0 +1,1 @@
+from . import common, mnist  # noqa: F401

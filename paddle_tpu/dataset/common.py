@@ -1,0 +1,49 @@
+"""Dataset cache/download helpers (reference: python/paddle/dataset/common.py
+— DATA_HOME, download with md5 check, cached unpacking)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset")
+)
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Download-with-cache (reference common.py:download).  In zero-egress
+    environments, place the file at the cache path manually; a missing file
+    raises with that path in the message."""
+    dirname = must_mkdirs(os.path.join(DATA_HOME, module_name))
+    filename = os.path.join(dirname, save_name or url.split("/")[-1])
+    if os.path.exists(filename) and (not md5sum or md5file(filename) == md5sum):
+        return filename
+    try:
+        import urllib.request
+
+        tmp = filename + ".part"
+        urllib.request.urlretrieve(url, tmp)
+        shutil.move(tmp, filename)
+    except Exception as e:
+        raise RuntimeError(
+            f"cannot download {url} (offline?): {e}. "
+            f"Place the file manually at {filename}."
+        ) from e
+    if md5sum and md5file(filename) != md5sum:
+        raise RuntimeError(f"md5 mismatch for {filename}")
+    return filename

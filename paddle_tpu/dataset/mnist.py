@@ -1,0 +1,72 @@
+"""MNIST dataset (reference: python/paddle/dataset/mnist.py — idx-format
+parser, train()/test() reader creators yielding (image[784] in [-1,1],
+label)).
+
+Offline fallback: `synthetic=True` (or PADDLE_TPU_SYNTH_DATA=1) yields a
+deterministic separable pseudo-MNIST so training pipelines can run without
+network egress."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import common
+
+URL_PREFIX = "https://ossci-datasets.s3.amazonaws.com/mnist/"
+TRAIN_IMAGE = "train-images-idx3-ubyte.gz"
+TRAIN_LABEL = "train-labels-idx1-ubyte.gz"
+TEST_IMAGE = "t10k-images-idx3-ubyte.gz"
+TEST_LABEL = "t10k-labels-idx1-ubyte.gz"
+
+
+def _parse(image_path, label_path):
+    with gzip.open(image_path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    with gzip.open(label_path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), dtype=np.uint8)
+    images = images.astype(np.float32) / 127.5 - 1.0
+    return images, labels.astype(np.int64)
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype(np.int64)
+    images = rng.rand(n, 784).astype(np.float32) * 0.1 - 1.0
+    img2d = images.reshape(n, 28, 28)
+    for i in range(n):
+        k = int(labels[i])
+        img2d[i, k * 2 : k * 2 + 4, k * 2 : k * 2 + 4] = 1.0
+    return images, labels
+
+
+def _use_synth(synthetic):
+    return synthetic or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1"
+
+
+def _reader_creator(image_file, label_file, synthetic, n_synth, seed):
+    def reader():
+        if _use_synth(synthetic):
+            images, labels = _synthetic(n_synth, seed)
+        else:
+            images, labels = _parse(
+                common.download(URL_PREFIX + image_file, "mnist", None),
+                common.download(URL_PREFIX + label_file, "mnist", None),
+            )
+        for img, lbl in zip(images, labels):
+            yield img, int(lbl)
+
+    return reader
+
+
+def train(synthetic=False):
+    return _reader_creator(TRAIN_IMAGE, TRAIN_LABEL, synthetic, 6000, 0)
+
+
+def test(synthetic=False):
+    return _reader_creator(TEST_IMAGE, TEST_LABEL, synthetic, 1000, 1)

@@ -3,6 +3,15 @@
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
-from . import nn, tensor, ops, contrib  # noqa: F401
+from .control_flow import (  # noqa: F401
+    While,
+    Switch,
+    array_write,
+    array_read,
+    array_length,
+    create_array,
+)
+from . import nn, tensor, ops, contrib, control_flow  # noqa: F401
+from . import learning_rate_scheduler  # noqa: F401
 
 from .tensor import data  # noqa: F401

@@ -1,0 +1,206 @@
+"""Control-flow DSL (reference: python/paddle/fluid/layers/control_flow.py —
+While:~800, Switch, array_write/array_read/array_length, increment...).
+
+While builds a sub-block; the `while` op lowers it to lax.while_loop."""
+
+from __future__ import annotations
+
+from ..core import framework as fw
+from ..layer_helper import LayerHelper
+from . import tensor as T
+
+
+class While:
+    """reference control_flow.py While.
+
+    with While(cond).block():  build the loop body; update cond inside.
+    Every var written inside the body that exists outside is loop-carried.
+    """
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self.main_program = self.helper.main_program
+
+    def block(self):
+        return _WhileBlockGuard(self)
+
+
+class _WhileBlockGuard:
+    def __init__(self, while_op: While):
+        self.w = while_op
+
+    def __enter__(self):
+        self.sub_block = self.w.main_program._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        prog = self.w.main_program
+        if exc_type is not None:
+            prog._rollback()  # don't leave the program inside the sub-block
+            return False
+        sub = self.sub_block
+        prog._rollback()
+        written = []
+        seen = set()
+        for op in sub.ops:
+            for n in op.output_arg_names():
+                if n and n not in seen:
+                    seen.add(n)
+                    written.append(n)
+        parent = prog.current_block()
+        out_names = [n for n in written if parent._find_var_recursive(n) is not None]
+        parent.append_op(
+            "while",
+            inputs={"Condition": [self.w.cond_var]},
+            outputs={"Out": out_names},
+            attrs={"sub_block": sub},
+        )
+        return True
+
+
+def array_write(x, i, array=None, capacity=64):
+    helper = LayerHelper("array_write")
+    if array is None:
+        if x.shape is None or any(s is None or s < 0 for s in x.shape):
+            raise ValueError(
+                f"array_write: {x.name} has non-static shape {x.shape}; "
+                "create the array explicitly with create_array(dtype, "
+                "element_shape=<concrete shape>) and pass it in"
+            )
+        array = helper.create_variable(
+            name=fw.unique_name("array"), dtype=x.dtype,
+            type=fw.VarType.DENSE_TENSOR,
+        )
+        helper.append_op(
+            "create_array",
+            outputs={"Out": [array]},
+            attrs={
+                "capacity": capacity,
+                "element_shape": list(x.shape),
+                "dtype": x.dtype,
+            },
+        )
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "write_to_array",
+        inputs={"Array": [array], "X": [x], "I": [i]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def create_array(dtype, element_shape, capacity=64):
+    helper = LayerHelper("create_array")
+    array = helper.create_variable(name=fw.unique_name("array"), dtype=dtype)
+    helper.append_op(
+        "create_array",
+        outputs={"Out": [array]},
+        attrs={
+            "capacity": capacity,
+            "element_shape": list(element_shape),
+            "dtype": dtype,
+        },
+    )
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(
+        "read_from_array", inputs={"X": [array], "I": [i]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("array_length", inputs={"X": [array]}, outputs={"Out": [out]})
+    return out
+
+
+class Switch:
+    """reference control_flow.py Switch — sequential case guards built on
+    conditional_block."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.pre_not_conditions = []
+
+    def case(self, condition):
+        return _SwitchCaseGuard(self, condition)
+
+    def default(self):
+        return _SwitchCaseGuard(self, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *args):
+        return False
+
+
+class _SwitchCaseGuard:
+    def __init__(self, switch, condition):
+        self.switch = switch
+        self.condition = condition
+
+    @staticmethod
+    def _and(a, b):
+        helper = LayerHelper("logical_and")
+        out = helper.create_variable_for_type_inference("bool")
+        helper.append_op(
+            "logical_and", inputs={"X": [a], "Y": [b]}, outputs={"Out": [out]}
+        )
+        return out
+
+    def __enter__(self):
+        prog = self.switch.helper.main_program
+        prev = self.switch.pre_not_conditions
+        cond = self.condition
+        if cond is None:
+            # default: none of the previous conditions held
+            assert prev, "Switch.default() before any case()"
+            cond = prev[0]
+            for c in prev[1:]:
+                cond = self._and(cond, c)
+        else:
+            # first-match-wins (reference Switch): this case fires only if no
+            # earlier case matched
+            helper = LayerHelper("logical_not")
+            notc = helper.create_variable_for_type_inference("bool")
+            helper.append_op(
+                "logical_not", inputs={"X": [cond]}, outputs={"Out": [notc]}
+            )
+            for c in prev:
+                cond = self._and(cond, c)
+            prev.append(notc)
+        self.cond = cond
+        self.sub_block = prog._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        prog = self.switch.helper.main_program
+        if exc_type is not None:
+            prog._rollback()  # don't leave the program inside the sub-block
+            return False
+        sub = self.sub_block
+        prog._rollback()
+        written = []
+        seen = set()
+        for op in sub.ops:
+            for n in op.output_arg_names():
+                if n and n not in seen:
+                    seen.add(n)
+                    written.append(n)
+        parent = prog.current_block()
+        outs = [n for n in written if parent._find_var_recursive(n) is not None]
+        parent.append_op(
+            "conditional_block",
+            inputs={"Cond": [self.cond]},
+            outputs={"Out": outs},
+            attrs={"sub_block": sub},
+        )
+        return True

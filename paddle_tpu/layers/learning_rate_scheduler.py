@@ -1,0 +1,165 @@
+"""LR schedules as graph ops over a persistable global-step counter
+(reference: python/paddle/fluid/layers/learning_rate_scheduler.py —
+noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
+polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup).
+
+The returned Variable is recomputed every step inside the same compiled XLA
+program (the step counter increments as Scope state)."""
+
+from __future__ import annotations
+
+import math
+
+from ..core import framework as fw
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+from . import tensor as T
+
+
+def _global_step_counter():
+    """Persistable step counter incremented once per run."""
+    helper = LayerHelper("global_step")
+    counter = helper.create_global_variable(
+        persistable=True,
+        name=fw.unique_name("@LR_DECAY_COUNTER@"),
+        shape=[1],
+        dtype="float32",
+    )
+    helper.set_variable_initializer(counter, ConstantInitializer(0.0))
+    helper.append_op(
+        "increment",
+        inputs={"X": [counter]},
+        outputs={"Out": [counter]},
+        attrs={"step": 1.0, fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.LRSched},
+    )
+    return counter
+
+
+def noam_decay(d_model, warmup_steps):
+    """lr = d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)
+    (reference learning_rate_scheduler.py noam_decay)."""
+    step = _global_step_counter()
+    helper = LayerHelper("noam_decay")
+    a = T.elementwise_pow(step, T.fill_constant([1], "float32", -0.5))
+    b = T.scale(step, scale=warmup_steps ** -1.5)
+    m = T.elementwise_min(a, b)
+    return T.scale(m, scale=d_model ** -0.5)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step_counter()
+    div = T.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        helper = LayerHelper("floor")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op("floor", inputs={"X": [div]}, outputs={"Out": [out]})
+        div = out
+    pow_ = T.elementwise_pow(
+        T.fill_constant([1], "float32", decay_rate), div
+    )
+    return T.scale(pow_, scale=float(learning_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step_counter()
+    div = T.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        helper = LayerHelper("floor")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op("floor", inputs={"X": [div]}, outputs={"Out": [out]})
+        div = out
+    helper = LayerHelper("natural_exp_decay")
+    e = helper.create_variable_for_type_inference("float32")
+    neg = T.scale(div, scale=-decay_rate)
+    helper.append_op("exp", inputs={"X": [neg]}, outputs={"Out": [e]})
+    return T.scale(e, scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step_counter()
+    div = T.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        helper = LayerHelper("floor")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op("floor", inputs={"X": [div]}, outputs={"Out": [out]})
+        div = out
+    denom = T.scale(div, scale=decay_rate, bias=1.0)
+    lr = T.fill_constant([1], "float32", float(learning_rate))
+    return T.elementwise_div(lr, denom)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _global_step_counter()
+    if cycle:
+        raise NotImplementedError(
+            "polynomial_decay(cycle=True) requires data-dependent ceil; use "
+            "staircase-style schedules on TPU"
+        )
+    capped = T.elementwise_min(
+        step, T.fill_constant([1], "float32", float(decay_steps))
+    )
+    ratio = T.scale(capped, scale=1.0 / decay_steps)
+    one_minus = T.scale(ratio, scale=-1.0, bias=1.0)
+    poly = T.elementwise_pow(
+        one_minus, T.fill_constant([1], "float32", float(power))
+    )
+    return T.scale(poly, scale=float(learning_rate) - end_learning_rate,
+                   bias=end_learning_rate)
+
+
+def piecewise_decay(boundaries, values):
+    """Step function via sum of gated constants."""
+    assert len(values) == len(boundaries) + 1
+    step = _global_step_counter()
+    helper = LayerHelper("piecewise_decay")
+    lr = T.fill_constant([1], "float32", float(values[0]))
+    for b, (v_prev, v_next) in zip(boundaries, zip(values[:-1], values[1:])):
+        cond = helper.create_variable_for_type_inference("bool")
+        helper.append_op(
+            "greater_than",
+            inputs={"X": [step], "Y": [T.fill_constant([1], "float32", float(b))]},
+            outputs={"Out": [cond]},
+        )
+        gate = T.cast(cond, "float32")
+        delta = T.scale(gate, scale=float(v_next) - float(v_prev))
+        lr = T.elementwise_add(lr, delta)
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _global_step_counter()
+    helper = LayerHelper("cosine_decay")
+    epoch_f = T.scale(step, scale=1.0 / step_each_epoch)
+    fl = helper.create_variable_for_type_inference("float32")
+    helper.append_op("floor", inputs={"X": [epoch_f]}, outputs={"Out": [fl]})
+    angle = T.scale(fl, scale=math.pi / epochs)
+    c = helper.create_variable_for_type_inference("float32")
+    helper.append_op("cos", inputs={"X": [angle]}, outputs={"Out": [c]})
+    return T.scale(T.scale(c, bias=1.0), scale=float(learning_rate) / 2.0)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Blend from start_lr to end_lr over warmup_steps, then the wrapped
+    schedule (or constant)."""
+    step = _global_step_counter()
+    helper = LayerHelper("lr_warmup")
+    frac = T.scale(step, scale=1.0 / warmup_steps)
+    capped = T.elementwise_min(frac, T.fill_constant([1], "float32", 1.0))
+    warm = T.scale(capped, scale=float(end_lr - start_lr), bias=float(start_lr))
+    if isinstance(learning_rate, (int, float)):
+        after = T.fill_constant([1], "float32", float(learning_rate))
+    else:
+        after = learning_rate
+    cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op(
+        "less_than",
+        inputs={"X": [step],
+                "Y": [T.fill_constant([1], "float32", float(warmup_steps))]},
+        outputs={"Out": [cond]},
+    )
+    gate = T.cast(cond, "float32")
+    inv_gate = T.scale(gate, scale=-1.0, bias=1.0)
+    return T.elementwise_add(
+        T.elementwise_mul(warm, gate), T.elementwise_mul(after, inv_gate)
+    )

@@ -9,4 +9,5 @@ from . import (  # noqa: F401
     optimizer_ops,
     metric_ops,
     fused_ops,
+    control_flow_ops,
 )

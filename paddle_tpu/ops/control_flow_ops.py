@@ -1,0 +1,167 @@
+"""Control-flow ops with sub-blocks (reference: operators/controlflow/
+while_op.cc, conditional_block_op.cc, tensor-array ops
+lod_tensor_to_array_op / array read-write).
+
+TPU-first: sub-blocks lower to `lax.while_loop` / `lax.cond` — traced once,
+compiled into the same XLA program (the reference spawns a nested Executor
+per iteration, while_op.cc; that interpreter recursion disappears here).
+Tensor arrays are fixed-capacity device buffers (stacked tensor +
+dynamic_update_slice) — the TPU-idiomatic replacement for the reference's
+std::vector<LoDTensor> arrays, sized by the static `capacity` attr."""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _written_names(block):
+    out = []
+    seen = set()
+    for op in block.ops:
+        for n in op.output_arg_names():
+            if n and n not in seen:
+                seen.add(n)
+                out.append(n)
+    return out
+
+
+def _read_names(block):
+    out = []
+    seen = set()
+    for op in block.ops:
+        for n in op.input_arg_names():
+            if n and n not in seen:
+                seen.add(n)
+                out.append(n)
+    return out
+
+
+@register("while", no_grad=True)
+def lower_while(ctx, ins):
+    """Carries = condition + sub-block-written vars that live in the outer
+    env.  Loop-invariant outer vars close over the body (XLA hoists them)."""
+    import jax
+    from ..core import executor as ex
+
+    sub_block = ctx.attr("sub_block")
+    cond_name = ctx.op.input("Condition")[0]
+    env = ctx.env
+
+    written = _written_names(sub_block)
+    reads = _read_names(sub_block)
+    # carried: written names present in env (loop state) — order is stable
+    carry_names = [n for n in written if n in env]
+    if cond_name not in carry_names:
+        carry_names = [cond_name] + carry_names
+
+    invariant = {
+        n: env[n]
+        for n in reads
+        if n in env and n not in carry_names
+    }
+
+    tctx = ctx.executor_ctx
+
+    def cond_fn(carry):
+        vals = dict(zip(carry_names, carry))
+        return vals[cond_name].reshape(())
+
+    def body_fn(carry):
+        env2 = dict(invariant)
+        env2.update(zip(carry_names, carry))
+        ex.trace_block(sub_block, env2, tctx)
+        return tuple(env2[n] for n in carry_names)
+
+    init = tuple(env[n] for n in carry_names)
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    outs = dict(zip(carry_names, final))
+    # write back into outer env via the declared outputs
+    out_names = ctx.op.output("Out")
+    result = {"Out": [outs.get(n, env.get(n)) for n in out_names]}
+    # also push every carried var back to the outer env (StepScopes parity)
+    for n, v in outs.items():
+        env[n] = v
+    return result
+
+
+@register("conditional_block", no_grad=True)
+def lower_conditional_block(ctx, ins):
+    """Both branches must produce same-shaped outputs; when no else-block is
+    given, the false branch keeps current values (requires outputs to already
+    exist in env)."""
+    import jax
+
+    from ..core import executor as ex
+
+    sub_block = ctx.attr("sub_block")
+    else_block = ctx.attr("else_block", None)
+    cond = ins["Cond"][0].reshape(())
+    env = ctx.env
+    tctx = ctx.executor_ctx
+    out_names = ctx.op.output("Out")
+
+    reads = _read_names(sub_block)
+    if else_block is not None:
+        reads += _read_names(else_block)
+    closure = {n: env[n] for n in set(reads) | set(out_names) if n in env}
+    closure_names = sorted(closure)
+    closure_vals = tuple(closure[n] for n in closure_names)
+
+    def true_fn(vals):
+        env2 = dict(zip(closure_names, vals))
+        ex.trace_block(sub_block, env2, tctx)
+        return tuple(env2[n] for n in out_names)
+
+    def false_fn(vals):
+        env2 = dict(zip(closure_names, vals))
+        if else_block is not None:
+            ex.trace_block(else_block, env2, tctx)
+        return tuple(env2[n] for n in out_names)
+
+    outs = jax.lax.cond(cond, true_fn, false_fn, closure_vals)
+    return {"Out": list(outs)}
+
+
+# ---------------------------------------------------------------------------
+# Tensor arrays: fixed-capacity stacked buffers
+# ---------------------------------------------------------------------------
+
+
+@register("create_array", no_grad=True)
+def lower_create_array(ctx, ins):
+    import jax.numpy as jnp
+
+    capacity = ctx.attr("capacity")
+    shape = tuple(ctx.attr("element_shape"))
+    dtype = ctx.attr("dtype", "float32")
+    target = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    return {"Out": [jnp.zeros((capacity,) + shape, target)]}
+
+
+@register("write_to_array", no_grad=True)
+def lower_write_to_array(ctx, ins):
+    import jax
+
+    arr, x, i = ins["Array"][0], ins["X"][0], ins["I"][0]
+    idx = i.reshape(()).astype("int32")
+    return {
+        "Out": [
+            jax.lax.dynamic_update_slice_in_dim(arr, x[None], idx, axis=0)
+        ]
+    }
+
+
+@register("read_from_array", no_grad=True)
+def lower_read_from_array(ctx, ins):
+    import jax
+
+    arr, i = ins["X"][0], ins["I"][0]
+    idx = i.reshape(()).astype("int32")
+    return {"Out": [jax.lax.dynamic_index_in_dim(arr, idx, axis=0, keepdims=False)]}
+
+
+@register("array_length", no_grad=True)
+def lower_array_length(ctx, ins):
+    import jax.numpy as jnp
+
+    return {"Out": [jnp.asarray([ins["X"][0].shape[0]], jnp.int64)]}

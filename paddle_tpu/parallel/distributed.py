@@ -1,0 +1,110 @@
+"""Multi-host distributed bootstrap + collective helpers.
+
+Capability parity with the reference's distributed runtime (SURVEY.md §2.4,
+§5.8): the nccl2-mode bootstrap (gen_nccl_id_op.cc RPC-broadcasts an
+ncclUniqueId; NCCLContextMap ranks = trainer_id*ngpu+i, nccl_helper.h:86-138)
+and the PADDLE_TRAINING_ROLE/PADDLE_TRAINER_ID/... env protocol
+(benchmark/fluid/README.md:34-44) map to `jax.distributed.initialize` + the
+XLA coordination service; collectives ride ICI within a slice and DCN across
+slices, emitted by XLA SPMD — there is no hand-rolled RPC layer to keep.
+
+The pserver mode (DistributeTranspiler sync/async, listen_and_serv_op.cc) is
+obsolete on TPU: optimizer state shards with parameters (ZeRO-style, see
+sharding.py) and large embeddings shard over the mesh (embedding.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class TrainerEnv:
+    """Parsed cluster env (reference env-var protocol kept verbatim)."""
+
+    def __init__(self):
+        self.training_role = os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER")
+        self.trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.num_trainers = int(os.environ.get("PADDLE_TRAINERS", "1"))
+        # nccl2-parity: comma-separated host:port of all trainers; entry 0 is
+        # the coordinator (role of trainer-0 broadcasting the nccl id)
+        self.trainer_endpoints = [
+            e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+            if e
+        ]
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def coordinator_address(self) -> Optional[str]:
+        if self.trainer_endpoints:
+            return self.trainer_endpoints[0]
+        return None
+
+
+_initialized = False
+
+
+def init_distributed_env(env: Optional[TrainerEnv] = None) -> TrainerEnv:
+    """Initialize the JAX coordination service across hosts (replaces
+    gen_nccl_id + etcd discovery).  Safe to call single-host (no-op)."""
+    global _initialized
+    env = env or TrainerEnv()
+    if _initialized or env.num_trainers <= 1:
+        _initialized = True
+        return env
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=env.coordinator_address,
+        num_processes=env.num_trainers,
+        process_id=env.trainer_id,
+    )
+    _initialized = True
+    return env
+
+
+def global_device_mesh(axis_names=("data",), shape=None):
+    """Build a Mesh over ALL devices (all hosts).  With multi-host pjit,
+    arrays sharded over the 'data' axis ride ICI/DCN automatically."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, axis_names=axis_names)
+
+
+# -- collective ops usable inside shard_map regions -------------------------
+
+
+def all_reduce(x, axis_name="data", op="sum"):
+    import jax
+
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op == "mean":
+        return jax.lax.pmean(x, axis_name)
+    if op == "max":
+        return jax.lax.pmax(x, axis_name)
+    raise ValueError(op)
+
+
+def all_gather(x, axis_name="data", axis=0):
+    import jax
+
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def reduce_scatter(x, axis_name="data", axis=0):
+    import jax
+
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ppermute(x, axis_name, perm):
+    import jax
+
+    return jax.lax.ppermute(x, axis_name, perm)

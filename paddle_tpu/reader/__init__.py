@@ -1,0 +1,11 @@
+from .decorator import (  # noqa: F401
+    map_readers,
+    shuffle,
+    chain,
+    compose,
+    buffered,
+    firstn,
+    batch,
+    xmap_readers,
+    cache,
+)

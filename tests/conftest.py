@@ -4,7 +4,8 @@ import os
 # multi-host behavior simulated via xla_force_host_platform_device_count).
 # PT_TEST_PLATFORM=tpu runs the suite against a real TPU backend (exercises
 # the actual Mosaic kernel paths); default is deterministic CPU.
-os.environ["JAX_PLATFORMS"] = os.environ.get("PT_TEST_PLATFORM", "cpu")
+_platform = os.environ.get("PT_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,6 +16,10 @@ import numpy as np
 import pytest
 
 import jax
+
+# A sitecustomize hook may force jax_platforms past the env var (axon image);
+# the config update is authoritative as long as it runs before device init.
+jax.config.update("jax_platforms", _platform)
 
 # Numeric tests compare against float64 numpy references; use full-precision
 # matmuls (the framework default is device-native fast precision).

@@ -1,0 +1,113 @@
+"""Control flow + LR scheduler + data pipeline tests (reference:
+test_while_op.py, test_learning_rate_scheduler.py, reader tests)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_while_loop_sum():
+    # sum integers 0..9 via a while loop
+    i = layers.fill_constant([1], "float32", 0.0)
+    total = layers.fill_constant([1], "float32", 0.0)
+    limit = layers.fill_constant([1], "float32", 10.0)
+    cond = layers.less_than(i, limit)
+    w = layers.While(cond)
+    with w.block():
+        new_total = layers.elementwise_add(total, i)
+        layers.assign(new_total, output=total)
+        new_i = layers.scale(i, scale=1.0, bias=1.0)
+        layers.assign(new_i, output=i)
+        layers.less_than(i, limit, cond=cond)
+    exe = pt.Executor(pt.CPUPlace())
+    (t,) = exe.run(fetch_list=[total])
+    np.testing.assert_allclose(t, [45.0])
+
+
+def test_tensor_array_write_read():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    arr = layers.create_array("float32", element_shape=[2, 4], capacity=8)
+    i0 = layers.fill_constant([1], "int64", 0)
+    i1 = layers.fill_constant([1], "int64", 1)
+    a1 = layers.array_write(x, i0, array=arr)
+    doubled = layers.scale(x, scale=2.0)
+    a2_name = layers.array_write(doubled, i1, array=a1)
+    r = layers.array_read(a2_name, i1)
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.random.rand(2, 4).astype("float32")
+    (out,) = exe.run(feed={"x": xv}, fetch_list=[r])
+    np.testing.assert_allclose(out, xv * 2, rtol=1e-6)
+
+
+def test_noam_decay_schedule():
+    from paddle_tpu.layers import learning_rate_scheduler as lrs
+
+    lr = lrs.noam_decay(d_model=64, warmup_steps=4)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    got = [float(exe.run(fetch_list=[lr])[0]) for _ in range(6)]
+    expect = [
+        (64 ** -0.5) * min(s ** -0.5, s * 4 ** -1.5) for s in range(1, 7)
+    ]
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    from paddle_tpu.layers import learning_rate_scheduler as lrs
+
+    lr = lrs.piecewise_decay([2, 4], [0.1, 0.01, 0.001])
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    got = [float(exe.run(fetch_list=[lr])[0]) for _ in range(6)]
+    np.testing.assert_allclose(got, [0.1, 0.1, 0.01, 0.01, 0.001, 0.001],
+                               rtol=1e-5)
+
+
+def test_reader_decorators():
+    from paddle_tpu import reader
+
+    def r():
+        return iter(range(10))
+
+    assert list(reader.firstn(r, 3)()) == [0, 1, 2]
+    batches = list(reader.batch(r, 4)())
+    assert batches == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    assert sorted(reader.shuffle(r, 5)()) == list(range(10))
+    assert list(reader.chain(r, r)()) == list(range(10)) * 2
+    assert list(reader.buffered(r, 2)()) == list(range(10))
+    assert list(reader.map_readers(lambda a, b: a + b, r, r)()) == [
+        2 * i for i in range(10)
+    ]
+    got = sorted(reader.xmap_readers(lambda x: x * 3, r, 2, 4)())
+    assert got == [3 * i for i in range(10)]
+    ordered = list(reader.xmap_readers(lambda x: x * 3, r, 2, 4, order=True)())
+    assert ordered == [3 * i for i in range(10)]
+
+
+def test_data_feeder_and_synthetic_mnist():
+    from paddle_tpu.dataset import mnist
+    from paddle_tpu import reader
+
+    img = layers.data(name="img", shape=[784], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    feeder = pt.DataFeeder([img, label])
+    train_reader = reader.batch(mnist.train(synthetic=True), 32)
+    b = next(iter(train_reader()))
+    feed = feeder.feed(b)
+    assert feed["img"].shape == (32, 784)
+    assert feed["label"].shape == (32, 1)
+
+    # end-to-end: one softmax-regression step
+    pred = layers.fc(input=img, size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(input=pred, label=label))
+    pt.optimizer.SGD(learning_rate=0.005).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for i, b in enumerate(train_reader()):
+        (l,) = exe.run(feed=feeder.feed(b), fetch_list=[loss])
+        losses.append(float(np.asarray(l)))
+        if i >= 20:
+            break
+    assert losses[-1] < losses[0]
