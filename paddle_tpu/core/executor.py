@@ -291,10 +291,8 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,
     ):
-        # CompiledProgram support (data-parallel wrapper): delegate
-        from .. import compiler
-
-        if isinstance(program, compiler.CompiledProgram):
+        # CompiledProgram / ShardedProgram delegate via their _run hook
+        if program is not None and hasattr(program, "_run"):
             return program._run(self, feed, fetch_list, scope, return_numpy)
 
         if program is None:

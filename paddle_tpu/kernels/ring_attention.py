@@ -1,0 +1,102 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+New TPU capability beyond the reference (SURVEY.md §5.7: the reference's max
+context is bounded by single-device memory; nothing shards the sequence
+axis).  Design: the sequence axis is sharded over a mesh axis; each device
+holds a Q shard and streams K/V shards around the ring with
+`jax.lax.ppermute` over ICI, combining per-shard partial softmax results with
+the same online-softmax algebra as flash attention (kernels/attention.py).
+Communication overlaps compute: while device d processes K/V shard s, shard
+s+1 is in flight.
+
+Entry point `ring_attention(q, k, v, mesh, axis_name, causal)` is meant to be
+called under `shard_map` (or via ring_attention_sharded which wraps it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def _local_attention_chunk(q, k, v, scale, mask=None):
+    """Partial attention of local q against one k/v chunk.
+    Returns (numerator, denominator, rowmax) in fp32."""
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    m = s.max(axis=-1)  # [b,h,q]
+    p = jnp.exp(s - m[..., None])
+    num = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    den = p.sum(axis=-1)
+    return num, den, m
+
+
+def ring_attention(q, k, v, axis_name, scale=1.0, causal=False):
+    """Runs INSIDE shard_map: q,k,v are the per-device sequence shards
+    [b, h, t_local, d].  Exact softmax attention over the full (sharded)
+    sequence via ring passes of K/V."""
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[2]
+
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def mask_for(kv_idx):
+        if not causal:
+            return None
+        # global positions: q_pos = my_idx*t_local + iq ; k_pos = kv_idx*t_local + ik
+        iq = jnp.arange(t_local)[:, None] + my_idx * t_local
+        ik = jnp.arange(t_local)[None, :] + kv_idx * t_local
+        return (iq >= ik)[None, None]  # [1,1,tq,tk]
+
+    def body(i, carry):
+        k_cur, v_cur, num, den, m = carry
+        kv_idx = (my_idx - i) % n
+        c_num, c_den, c_m = _local_attention_chunk(
+            q, k_cur, v_cur, scale, mask_for(kv_idx)
+        )
+        m_new = jnp.maximum(m, c_m)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(c_m - m_new)
+        num = num * alpha[..., None] + c_num * beta[..., None]
+        den = den * alpha + c_den * beta
+        # rotate K/V around the ring (device i sends to i+1)
+        k_next = jax.lax.ppermute(k_cur, axis_name, fwd_perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, fwd_perm)
+        return k_next, v_next, num, den, m_new
+
+    b, h, t, d = q.shape
+    num0 = jnp.zeros((b, h, t, d), jnp.float32)
+    den0 = jnp.zeros((b, h, t), jnp.float32)
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    carry = (k, v, num0, den0, m0)
+    # static unroll (n is a python int) lets XLA overlap ppermute with compute
+    for i in range(n):
+        carry = body(i, carry)
+    _, _, num, den, _ = carry
+    return (num / den[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=1.0,
+                           causal=False):
+    """Whole-array entry: q,k,v are global [b, h, T, d] arrays; the sequence
+    dim is sharded over `axis_name` of `mesh`; returns global output with the
+    same sharding."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, scale=scale,
+                          causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

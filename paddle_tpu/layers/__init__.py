@@ -11,7 +11,8 @@ from .control_flow import (  # noqa: F401
     array_length,
     create_array,
 )
-from . import nn, tensor, ops, contrib, control_flow  # noqa: F401
+from .sequence import *  # noqa: F401,F403
+from . import nn, tensor, ops, contrib, control_flow, sequence  # noqa: F401
 from . import learning_rate_scheduler  # noqa: F401
 
 from .tensor import data  # noqa: F401

@@ -1,0 +1,198 @@
+"""Sequence + RNN layer fns (reference: layers/nn.py dynamic_lstm:443,
+dynamic_gru:737, sequence_pool, sequence_conv, sequence_softmax,
+sequence_reverse, sequence_mask...)."""
+
+from __future__ import annotations
+
+from ..core import framework as fw
+from ..layer_helper import LayerHelper
+
+
+def sequence_pool(input, pool_type, length=None):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        "sequence_pool",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"pooltype": pool_type.upper()},
+    )
+    if input.shape:
+        out.shape = (input.shape[0],) + tuple(input.shape[2:])
+    return out
+
+
+def sequence_softmax(input, length=None):
+    helper = LayerHelper("sequence_softmax")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("sequence_softmax", inputs=inputs, outputs={"Out": [out]})
+    out.shape = input.shape
+    return out
+
+
+def sequence_reverse(x, length=None):
+    helper = LayerHelper("sequence_reverse")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("sequence_reverse", inputs=inputs, outputs={"Y": [out]})
+    out.shape = x.shape
+    return out
+
+
+def sequence_mask(x, maxlen, dtype="int64"):
+    helper = LayerHelper("sequence_mask")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "sequence_mask",
+        inputs={"X": [x]},
+        outputs={"Y": [out]},
+        attrs={"maxlen": maxlen, "out_dtype": dtype},
+    )
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None):
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act)
+    dtype = input.dtype
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        helper.param_attr(), shape=[filter_size * d, num_filters], dtype=dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "sequence_conv",
+        inputs={"X": [input], "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={
+            "contextStride": filter_stride,
+            "contextStart": -int(filter_size // 2),
+            "contextLength": filter_size,
+        },
+    )
+    out.shape = tuple(input.shape[:-1]) + (num_filters,)
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(pre_act)
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", length=None, name=None):
+    """reference nn.py:443; `input` is [B, T, 4*hidden] pre-projected."""
+    helper = LayerHelper("dynamic_lstm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = size // 4
+    w = helper.create_parameter(helper.param_attr(), shape=[d, 4 * d],
+                                dtype=input.dtype)
+    bias_size = 7 * d if use_peepholes else 4 * d
+    b = helper.create_parameter(helper.bias_attr(), shape=[1, bias_size],
+                                dtype=input.dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(input.dtype)
+    cell = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        "dynamic_lstm",
+        inputs=inputs,
+        outputs={"Hidden": [hidden], "Cell": [cell]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    if input.shape:
+        hidden.shape = (input.shape[0], input.shape[1], d)
+        cell.shape = hidden.shape
+    return hidden, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                length=None):
+    """reference nn.py:737; `input` is [B, T, 3*size] pre-projected."""
+    helper = LayerHelper("dynamic_gru", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    w = helper.create_parameter(helper.param_attr(), shape=[size, 3 * size],
+                                dtype=input.dtype)
+    b = helper.create_parameter(helper.bias_attr(), shape=[1, 3 * size],
+                                dtype=input.dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        "dynamic_gru",
+        inputs=inputs,
+        outputs={"Hidden": [hidden]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+            "origin_mode": origin_mode,
+        },
+    )
+    if input.shape:
+        hidden.shape = (input.shape[0], input.shape[1], size)
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    helper = LayerHelper("gru_unit", param_attr=param_attr, bias_attr=bias_attr)
+    d = size // 3
+    w = helper.create_parameter(helper.param_attr(), shape=[d, 3 * d],
+                                dtype=input.dtype)
+    b = helper.create_parameter(helper.bias_attr(), shape=[1, 3 * d],
+                                dtype=input.dtype, is_bias=True)
+    out_h = helper.create_variable_for_type_inference(input.dtype)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    reset_h = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "gru_unit",
+        inputs={"Input": [input], "HiddenPrev": [hidden], "Weight": [w],
+                "Bias": [b]},
+        outputs={"Hidden": [out_h], "Gate": [gate],
+                 "ResetHiddenPrev": [reset_h]},
+        attrs={"activation": activation, "gate_activation": gate_activation},
+    )
+    return out_h, reset_h, gate
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None):
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    inputs = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        inputs["HypsLength"] = [input_length]
+    if label_length is not None:
+        inputs["RefsLength"] = [label_length]
+    helper.append_op(
+        "edit_distance",
+        inputs=inputs,
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized},
+    )
+    return out, seq_num

@@ -1,0 +1,103 @@
+"""DeepFM CTR model (reference: python/paddle/fluid/tests/unittests/
+dist_ctr.py + dist_ctr_reader.py — the sparse-lookup_table workload of
+BASELINE.md).
+
+Sparse path notes: embeddings use lookup_table with is_sparse=True (row-
+sparse grads; lookup_table_op.h:132 parity).  On TPU the table lives in HBM
+sharded over the mesh (ShardingPlan rule on the embedding param) — the
+pserver-distributed path of the reference (remote_prefetch,
+parameter_prefetch.cc) maps to mesh-sharded gathers, SURVEY.md §2.4."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+# dist_ctr_reader.py: dense 13 continuous + 26 categorical slots hashed to 1e6
+DENSE_DIM = 13
+SPARSE_SLOTS = 26
+HASH_DIM = 10001  # scaled-down default (dist_ctr uses 1000001)
+
+
+def ctr_deepfm(dense_input, sparse_inputs, embedding_size=10,
+               hash_dim=HASH_DIM, is_sparse=True, fm=True,
+               hidden_sizes=(400, 400, 400)):
+    """Returns click probability [B, 2] (softmax)."""
+    # --- embeddings (shared table per slot, reference dist_ctr.py style) ---
+    emb_outs = []
+    first_order = []
+    for i, slot in enumerate(sparse_inputs):
+        emb = layers.embedding(
+            slot,
+            size=[hash_dim, embedding_size],
+            is_sparse=is_sparse,
+            param_attr=ParamAttr(name=f"deepfm_emb_{i}"),
+        )
+        # slot input is [B, 1] ids -> emb [B, emb]
+        emb_outs.append(emb)
+        if fm:
+            w1 = layers.embedding(
+                slot,
+                size=[hash_dim, 1],
+                is_sparse=is_sparse,
+                param_attr=ParamAttr(name=f"deepfm_w1_{i}"),
+            )
+            first_order.append(w1)
+
+    concat_emb = layers.concat(emb_outs, axis=1)  # [B, slots*emb]
+
+    parts = [dense_input, concat_emb]
+
+    if fm:
+        # FM second-order: 0.5 * ((sum v)^2 - sum v^2), fields stacked
+        stacked = layers.stack(emb_outs, axis=1)  # [B, slots, emb]
+        sum_v = layers.reduce_sum(stacked, dim=1)  # [B, emb]
+        sum_sq = layers.square(sum_v)
+        sq = layers.square(stacked)
+        sq_sum = layers.reduce_sum(sq, dim=1)
+        second = layers.scale(
+            layers.elementwise_sub(sum_sq, sq_sum), scale=0.5
+        )
+        first = layers.concat(first_order, axis=1)  # [B, slots]
+        parts += [first, second]
+
+    x = layers.concat(parts, axis=1)
+    for i, h in enumerate(hidden_sizes):
+        x = layers.fc(input=x, size=h, act="relu",
+                      param_attr=ParamAttr(name=f"deepfm_fc{i}_w"),
+                      bias_attr=ParamAttr(name=f"deepfm_fc{i}_b"))
+    return layers.fc(input=x, size=2, act="softmax",
+                     param_attr=ParamAttr(name="deepfm_out_w"),
+                     bias_attr=ParamAttr(name="deepfm_out_b"))
+
+
+def build_train_net(embedding_size=10, hash_dim=HASH_DIM, is_sparse=True,
+                    with_optimizer=True, lr=1e-3):
+    from .. import optimizer as opt_mod
+
+    dense = layers.data(name="dense_input", shape=[DENSE_DIM], dtype="float32")
+    sparse = [
+        layers.data(name=f"C{i}", shape=[1], dtype="int64")
+        for i in range(SPARSE_SLOTS)
+    ]
+    label = layers.data(name="click", shape=[1], dtype="int64")
+    predict = ctr_deepfm(dense, sparse, embedding_size, hash_dim, is_sparse)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(x=cost)
+    auc_var, _ = layers.auc(input=predict, label=label)
+    if with_optimizer:
+        opt_mod.Adam(learning_rate=lr).minimize(avg_cost)
+    feeds = ["dense_input"] + [f"C{i}" for i in range(SPARSE_SLOTS)] + ["click"]
+    return avg_cost, auc_var, predict, feeds
+
+
+def make_batch(batch_size, hash_dim=HASH_DIM, rng=None):
+    import numpy as np
+
+    rng = rng or np.random.RandomState(0)
+    feed = {"dense_input": rng.rand(batch_size, DENSE_DIM).astype("float32")}
+    for i in range(SPARSE_SLOTS):
+        feed[f"C{i}"] = rng.randint(0, hash_dim, (batch_size, 1)).astype("int64")
+    feed["click"] = rng.randint(0, 2, (batch_size, 1)).astype("int64")
+    return feed

@@ -10,4 +10,6 @@ from . import (  # noqa: F401
     metric_ops,
     fused_ops,
     control_flow_ops,
+    sequence_ops,
+    rnn_ops,
 )

@@ -1,0 +1,217 @@
+"""RNN ops via lax.scan (reference: dynamic_lstm (lstm_op.cc),
+dynamic_gru (gru_op.cc), gru_unit_op.cc, lstm_unit_op.cc,
+cudnn_lstm_op.cu.cc; the graph-level RecurrentOp/StepScopes loop of
+recurrent_op.cc:39 is subsumed by while/scan).
+
+TPU-first: time-major lax.scan compiles to one fused loop; variable lengths
+are handled by masking state updates past each row's length (the reference
+sorts by length via lod_rank_table — unnecessary here)."""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _act(name):
+    import jax
+    import jax.numpy as jnp
+
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda x: x,
+    }[name]
+
+
+def _length_mask(ins, x):
+    jnp = _jnp()
+    lens = ins.get("Length", [None])
+    if lens and lens[0] is not None:
+        return lens[0].reshape(-1).astype("int32")
+    return jnp.full((x.shape[0],), x.shape[1], "int32")
+
+
+def _reverse_valid(x, length):
+    """Reverse each row's valid prefix only (padding stays in place) — keeps
+    length-masking correct under is_reverse."""
+    jnp = _jnp()
+    t = x.shape[1]
+    ar = jnp.arange(t)[None, :]
+    idx = jnp.where(ar < length[:, None], length[:, None] - 1 - ar, ar)
+    idx = idx.reshape((x.shape[0], t) + (1,) * (x.ndim - 2)).astype("int32")
+    return jnp.take_along_axis(x, idx, axis=1)
+
+
+@register("dynamic_lstm")
+def lower_dynamic_lstm(ctx, ins):
+    """Input: [B, T, 4D] pre-projected gates input (reference lstm_op.cc
+    expects x already times W_x); Weight [D, 4D] recurrent; Bias [1, 4D]
+    (+ peephole terms if use_peepholes).  Gate column order c,i,f,o —
+    candidate first, matching the reference weight layout
+    (math/detail/lstm_kernel.h; nn.py:397 documents {W_ch, W_ih, W_fh,
+    W_oh}) so reference-trained weights port unchanged."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    b, t, d4 = x.shape
+    d = d4 // 4
+    length = _length_mask(ins, x)
+    use_peep = ctx.attr("use_peepholes", False)
+    gate_act = _act(ctx.attr("gate_activation", "sigmoid"))
+    cell_act = _act(ctx.attr("cell_activation", "tanh"))
+    cand_act = _act(ctx.attr("candidate_activation", "tanh"))
+    is_reverse = ctx.attr("is_reverse", False)
+
+    if bias is not None:
+        x = x + bias.reshape(1, 1, -1)[:, :, : 4 * d]
+        if use_peep:
+            peep = bias.reshape(-1)[4 * d:]
+            w_ic, w_fc, w_oc = peep[:d], peep[d: 2 * d], peep[2 * d: 3 * d]
+        else:
+            w_ic = w_fc = w_oc = None
+    else:
+        w_ic = w_fc = w_oc = None
+
+    xs = _reverse_valid(x, length) if is_reverse else x
+    xs = jnp.swapaxes(xs, 0, 1)  # [T, B, 4D]
+    step_ids = jnp.arange(t)
+
+    h0 = ins.get("H0", [None])[0]
+    c0 = ins.get("C0", [None])[0]
+    h_init = h0 if h0 is not None else jnp.zeros((b, d), x.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((b, d), x.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, tid = inp
+        gates = xt + h_prev @ w  # [B, 4D], columns c,i,f,o
+        gc, gi, gf, go = jnp.split(gates, 4, axis=1)
+        if use_peep and w_ic is not None:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c = f * c_prev + i * cand_act(gc)
+        if use_peep and w_oc is not None:
+            go = go + c * w_oc
+        o = gate_act(go)
+        h = o * cell_act(c)
+        valid = (tid < length)[:, None]
+        h = jnp.where(valid, h, h_prev)
+        c = jnp.where(valid, c, c_prev)
+        return (h, c), (h, c)
+
+    (h_last, c_last), (hs, cs) = jax.lax.scan(step, (h_init, c_init),
+                                              (xs, step_ids))
+    hs = jnp.swapaxes(hs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        hs = _reverse_valid(hs, length)
+        cs = _reverse_valid(cs, length)
+    return {"Hidden": [hs], "Cell": [cs]}
+
+
+@register("dynamic_gru")
+def lower_dynamic_gru(ctx, ins):
+    """Input [B, T, 3D] pre-projected; Weight [D, 3D] laid out as
+    [update|reset (2D), candidate (D)] (reference gru_op.cc)."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    b, t, d3 = x.shape
+    d = d3 // 3
+    length = _length_mask(ins, x)
+    gate_act = _act(ctx.attr("gate_activation", "sigmoid"))
+    cand_act = _act(ctx.attr("activation", "tanh"))
+    is_reverse = ctx.attr("is_reverse", False)
+    origin_mode = ctx.attr("origin_mode", False)
+
+    if bias is not None:
+        x = x + bias.reshape(1, 1, -1)
+
+    w_g = w[:, : 2 * d]  # update+reset recurrent weights
+    w_c = w[:, 2 * d:]  # candidate recurrent weights
+
+    xs = jnp.flip(x, axis=1) if is_reverse else x
+    xs = jnp.swapaxes(xs, 0, 1)
+    step_ids = jnp.arange(t)
+    h0 = ins.get("H0", [None])[0]
+    h_init = h0 if h0 is not None else jnp.zeros((b, d), x.dtype)
+
+    def step(h_prev, inp):
+        xt, tid = inp
+        xu, xr, xc = jnp.split(xt, 3, axis=1)
+        gr = h_prev @ w_g
+        u = gate_act(xu + gr[:, :d])
+        r = gate_act(xr + gr[:, d:])
+        c = cand_act(xc + (r * h_prev) @ w_c)
+        if origin_mode:
+            h = u * h_prev + (1 - u) * c
+        else:
+            h = (1 - u) * h_prev + u * c
+        valid = (tid < length)[:, None]
+        h = jnp.where(valid, h, h_prev)
+        return h, h
+
+    h_last, hs = jax.lax.scan(step, h_init, (xs, step_ids))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        hs = jnp.flip(hs, axis=1)
+    return {"Hidden": [hs]}
+
+
+@register("gru_unit")
+def lower_gru_unit(ctx, ins):
+    import jax
+
+    jnp = _jnp()
+    x = ins["Input"][0]  # [B, 3D]
+    h_prev = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    d = h_prev.shape[1]
+    if bias is not None:
+        x = x + bias.reshape(1, -1)
+    gate_act = _act({1: "sigmoid", 2: "tanh", 0: "identity", 3: "relu"}.get(
+        ctx.attr("gate_activation", 1), "sigmoid") if isinstance(
+        ctx.attr("gate_activation", 1), int) else ctx.attr("gate_activation"))
+    cand_act = _act({1: "sigmoid", 2: "tanh", 0: "identity", 3: "relu"}.get(
+        ctx.attr("activation", 2), "tanh") if isinstance(
+        ctx.attr("activation", 2), int) else ctx.attr("activation"))
+    xu, xr, xc = jnp.split(x, 3, axis=1)
+    gr = h_prev @ w[:, : 2 * d]
+    u = gate_act(xu + gr[:, :d])
+    r = gate_act(xr + gr[:, d:])
+    c = cand_act(xc + (r * h_prev) @ w[:, 2 * d:])
+    h = u * c + (1 - u) * h_prev
+    gate = jnp.concatenate([u, r, c], axis=1)
+    return {"Hidden": [h], "Gate": [gate], "ResetHiddenPrev": [r * h_prev]}
+
+
+@register("lstm_unit")
+def lower_lstm_unit(ctx, ins):
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]  # [B, 4D]
+    c_prev = ins["C_prev"][0]
+    forget_bias = ctx.attr("forget_bias", 0.0)
+    gi, gf, gc, go = jnp.split(x, 4, axis=1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    return {"C": [c], "H": [h]}

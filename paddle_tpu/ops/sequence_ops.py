@@ -1,0 +1,248 @@
+"""Sequence ops over padded batches + lengths/masks.
+
+The reference represents ragged batches as LoD offset tables consumed by ~30
+sequence_* ops (SURVEY.md §5.7, operators/sequence_ops/).  TPU-first these
+become dense [batch, max_len, ...] tensors + a Length vector (static shapes,
+MXU-friendly); each op takes an optional "Length" input where the reference
+read LoD level 0.
+
+Citations: sequence_pool_op.cc, sequence_softmax_op.cc, sequence_conv_op.cc,
+sequence_expand_op.cc, sequence_reverse_op.h, sequence_mask_op.cc,
+sequence_pad_op.cc, edit_distance_op.cc, row_conv_op.cc.
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _mask_from_length(length, max_len, dtype="float32"):
+    jnp = _jnp()
+    ar = jnp.arange(max_len)[None, :]
+    return (ar < length.reshape(-1, 1)).astype(dtype)
+
+
+def _length_or_full(ins, x):
+    jnp = _jnp()
+    lens = ins.get("Length", [None])
+    if lens and lens[0] is not None:
+        # clamp to T so masks and count-denominators stay consistent
+        return jnp.clip(lens[0].reshape(-1).astype("int32"), 0, x.shape[1])
+    return jnp.full((x.shape[0],), x.shape[1], "int32")
+
+
+@register("sequence_mask", no_grad=True)
+def lower_sequence_mask(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0].reshape(-1)
+    maxlen = ctx.attr("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError("sequence_mask needs a static maxlen attr on TPU")
+    dtype = ctx.attr("out_dtype", "int64")
+    return {"Y": [_mask_from_length(x, maxlen, dtype)]}
+
+
+@register("sequence_pool")
+def lower_sequence_pool(ctx, ins):
+    """X: [B, T, D] (+ Length [B]); pooltype sum/average/sqrt/max/last/first
+    (reference sequence_pool_op.cc + math/sequence_pooling.cc)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    length = _length_or_full(ins, x)
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    t = x.shape[1]
+    mask = _mask_from_length(length, t, x.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = (x * mask).sum(axis=1)
+    elif ptype == "AVERAGE":
+        out = (x * mask).sum(axis=1) / jnp.maximum(
+            length.reshape((-1,) + (1,) * (x.ndim - 2)).astype(x.dtype), 1
+        )
+    elif ptype == "SQRT":
+        out = (x * mask).sum(axis=1) / jnp.sqrt(
+            jnp.maximum(
+                length.reshape((-1,) + (1,) * (x.ndim - 2)).astype(x.dtype), 1
+            )
+        )
+    elif ptype == "MAX":
+        neg = jnp.full_like(x, -1e30)
+        out = jnp.where(mask > 0, x, neg).max(axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(length - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype("int32"), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"sequence_pool: unknown pooltype {ptype}")
+    return {"Out": [out]}
+
+
+@register("sequence_softmax")
+def lower_sequence_softmax(ctx, ins):
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]  # [B, T]
+    length = _length_or_full(ins, x)
+    mask = _mask_from_length(length, x.shape[1], "bool")
+    logits = jnp.where(mask, x.astype(jnp.float32), -1e30)
+    out = jax.nn.softmax(logits, axis=-1) * mask.astype(jnp.float32)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register("sequence_reverse")
+def lower_sequence_reverse(ctx, ins):
+    """Reverse each sequence within its valid length (padding stays)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    length = _length_or_full(ins, x)
+    t = x.shape[1]
+    ar = jnp.arange(t)[None, :]
+    idx = jnp.where(ar < length[:, None], length[:, None] - 1 - ar, ar)
+    idx = idx.reshape((x.shape[0], t) + (1,) * (x.ndim - 2)).astype("int32")
+    return {"Y": [jnp.take_along_axis(x, idx, axis=1)]}
+
+
+@register("sequence_expand")
+def lower_sequence_expand(ctx, ins):
+    """Tile X rows per Y's time dim (simplified padded-world semantics:
+    X [B, D] -> [B, T, D] matching Y's T)."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    t = y.shape[1]
+    return {"Out": [jnp.repeat(x[:, None], t, axis=1)]}
+
+
+@register("sequence_conv")
+def lower_sequence_conv(ctx, ins):
+    """Context-window conv over time (reference sequence_conv_op.cc +
+    math/context_project.h): for each t, concat rows [t+start, t+start+len)
+    then project with Filter [len*D, M]."""
+    jnp = _jnp()
+    x = ins["X"][0]  # [B, T, D]
+    w = ins["Filter"][0]
+    ctx_len = ctx.attr("contextLength", 3)
+    ctx_start = ctx.attr("contextStart", -1)
+    b, t, d = x.shape
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        shifted = jnp.roll(x, -off, axis=1)
+        ar = jnp.arange(t)
+        valid = ((ar + off) >= 0) & ((ar + off) < t)
+        shifted = shifted * valid[None, :, None].astype(x.dtype)
+        cols.append(shifted)
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # [B, T, len*D]
+    out = jnp.einsum("btd,dm->btm", ctx_mat, w)
+    return {"Out": [out]}
+
+
+@register("row_conv")
+def lower_row_conv(ctx, ins):
+    """Lookahead row convolution (reference row_conv_op.cc): X [B,T,D],
+    Filter [future_ctx, D]."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    w = ins["Filter"][0]
+    k = w.shape[0]
+    b, t, d = x.shape
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        shifted = jnp.roll(x, -i, axis=1)
+        ar = jnp.arange(t)
+        valid = (ar + i) < t
+        shifted = shifted * valid[None, :, None].astype(x.dtype)
+        out = out + shifted * w[i][None, None, :]
+    return {"Out": [out]}
+
+
+@register("sequence_pad")
+def lower_sequence_pad(ctx, ins):
+    """In the padded world X is already dense; emits X + Length passthrough
+    (reference sequence_pad_op.cc converts LoD->padded)."""
+    x = ins["X"][0]
+    length = _length_or_full(ins, x)
+    return {"Out": [x], "Length": [length.astype("int64")]}
+
+
+@register("sequence_unpad")
+def lower_sequence_unpad(ctx, ins):
+    x = ins["X"][0]
+    return {"Out": [x]}
+
+
+@register("sequence_erase", no_grad=True)
+def lower_sequence_erase(ctx, ins):
+    """Mask out tokens in the erase list (dense variant: zeros them;
+    reference removes them via LoD shrink, sequence_erase_op.cc)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    tokens = ctx.attr("tokens", [])
+    keep = jnp.ones_like(x, dtype=bool)
+    for tok in tokens:
+        keep &= x != tok
+    return {"Out": [jnp.where(keep, x, jnp.zeros_like(x))]}
+
+
+@register("edit_distance", no_grad=True)
+def lower_edit_distance(ctx, ins):
+    """Levenshtein distance via DP over lax.scan (reference
+    edit_distance_op.cc).  Hyps/Refs: [B, T] int + lengths."""
+    import jax
+    import jax.numpy as jnp
+
+    hyp = ins["Hyps"][0].astype("int32")
+    ref = ins["Refs"][0].astype("int32")
+    if hyp.ndim == 3:
+        hyp = hyp.reshape(hyp.shape[0], -1)
+    if ref.ndim == 3:
+        ref = ref.reshape(ref.shape[0], -1)
+    hyp_len = _length_or_full({"Length": ins.get("HypsLength", [None])}, hyp)
+    ref_len = _length_or_full({"Length": ins.get("RefsLength", [None])}, ref)
+    b, th = hyp.shape
+    tr = ref.shape[1]
+
+    def one(h, r, hl, rl):
+        row0 = jnp.arange(tr + 1, dtype=jnp.float32)
+        row0 = jnp.minimum(row0, rl.astype(jnp.float32))
+
+        def step(row, i):
+            # row = distances for hyp prefix i; compute prefix i+1
+            cost_del = row + 1.0
+            sub = jnp.where(r == h[i], 0.0, 1.0)
+            new = jnp.zeros_like(row).at[0].set(
+                jnp.minimum((i + 1).astype(jnp.float32), hl.astype(jnp.float32))
+            )
+
+            def inner(carry, j):
+                val = jnp.minimum(
+                    jnp.minimum(row[j + 1] + 1.0, carry + 1.0),
+                    row[j] + sub[j],
+                )
+                return val, val
+
+            _, vals = jax.lax.scan(inner, new[0], jnp.arange(tr))
+            new = new.at[1:].set(vals)
+            # freeze rows beyond hyp length
+            return jnp.where(i < hl, new, row), None
+
+        final, _ = jax.lax.scan(step, row0, jnp.arange(th))
+        return final[rl]
+
+    dist = jax.vmap(one)(hyp, ref, hyp_len, ref_len)
+    if ctx.attr("normalized", False):
+        dist = dist / jnp.maximum(ref_len.astype(dist.dtype), 1.0)
+    return {
+        "Out": [dist.reshape(-1, 1)],
+        "SequenceNum": [jnp.asarray([b], jnp.int64)],
+    }

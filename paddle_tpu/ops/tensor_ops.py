@@ -88,16 +88,10 @@ def lower_transpose2(ctx, ins):
 
 
 def _concat_infer(ctx):
-    shapes = []
-    i = 0
-    while True:
-        s = ctx.input_shape("X", i)
-        if s is None:
-            break
-        shapes.append(s)
-        i += 1
-    if not shapes:
-        return
+    n = len(ctx.op.input("X"))
+    shapes = [ctx.input_shape("X", i) for i in range(n)]
+    if not shapes or any(s is None for s in shapes):
+        return  # unknown input: leave output shape unset, not wrong
     axis = ctx.attr("axis", 0)
     out = list(shapes[0])
     out[axis] = sum(s[axis] for s in shapes)
@@ -211,7 +205,20 @@ def lower_flatten2(ctx, ins):
     return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
 
 
-@register("stack")
+def _stack_infer(ctx):
+    s = ctx.input_shape("X", 0)
+    if s is None:
+        return
+    n = len(ctx.op.input("X"))
+    axis = ctx.attr("axis", 0)
+    if axis < 0:
+        axis += len(s) + 1
+    out = list(s)
+    out.insert(axis, n)
+    ctx.set_output("Y", out, ctx.input_dtype("X"))
+
+
+@register("stack", infer_shape=_stack_infer)
 def lower_stack(ctx, ins):
     jnp = _jnp()
     return {"Y": [jnp.stack([v for v in ins["X"]], axis=ctx.attr("axis", 0))]}

@@ -53,12 +53,32 @@ class ShardingPlan:
         arr = np.array(devices[:n]).reshape(tuple(self.mesh_axes.values()))
         return Mesh(arr, axis_names=tuple(self.mesh_axes))
 
+    def _spec_fits(self, spec, shape):
+        """A PartitionSpec is usable only if the array has enough dims and
+        every sharded dim divides evenly by its axis size."""
+        if shape is None:
+            return False
+        if len(spec) > len(shape):
+            return False
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            n = 1
+            for a in axes:
+                n *= self.mesh_axes.get(a, 1)
+            if shape[dim] is None or shape[dim] % n != 0:
+                return False
+        return True
+
     def spec_for_param(self, name: str, shape, is_moment=False):
         from jax.sharding import PartitionSpec as P
 
         for pattern, spec in self.param_rules:
             if re.fullmatch(pattern, name) or re.match(pattern + "$", name):
-                return spec
+                if self._spec_fits(spec, shape):
+                    return spec
+                break  # matched but unshardable (e.g. rank-1 accumulator)
         if self.zero_stage >= 2 or (self.zero_stage >= 1 and is_moment):
             # ZeRO: shard dim0 over data axis when divisible
             if shape and shape[0] and shape[0] % self.mesh_axes.get(
