@@ -69,7 +69,9 @@ def test_dynamic_lstm_matches_manual():
     outs = []
     for step in range(t):
         gates = x_np[:, step] + h_prev @ w_np
-        i, f, c_t, o = np.split(gates, 4, axis=1)
+        # Reference gate-buffer layout (math/detail/lstm_cpu_kernel.h:50-53):
+        # offset 0 = candidate (active_node), then input, forget, output gates.
+        c_t, i, f, o = np.split(gates, 4, axis=1)
         c_prev = sig(f) * c_prev + sig(i) * np.tanh(c_t)
         h_prev = sig(o) * np.tanh(c_prev)
         outs.append(h_prev.copy())
