@@ -1,0 +1,153 @@
+"""Automatic mixed precision (bf16) for traced programs.
+
+Capability parity with the reference's float16 support (reference:
+paddle/fluid/platform/float16.h — a software half type that op kernels can
+compute in), redesigned TPU-first:
+
+  * TPU MXU peak throughput is bf16; fp32 matmuls run at a fraction of peak.
+    Instead of per-kernel half-precision variants, we apply an **autocast
+    policy at trace time**: matmul/conv-family ops compute in bf16,
+    numerically sensitive ops (norms, softmax, losses, optimizer updates)
+    compute in fp32.
+  * Parameters remain fp32 **master weights** in HBM; the fp32->bf16 cast of
+    each weight happens inside the compiled step and XLA fuses it into the
+    convolution/matmul (one extra HBM read of the fp32 weight, no extra
+    round-trip).
+  * Gradients: grad ops re-trace the forward lowering under jax.vjp, so a
+    white-listed op's backward also computes in bf16.  Optimizer ops are
+    black-listed, so gradients are cast back to fp32 before moment/param
+    updates — fp32 accumulation, the standard mixed-precision recipe.
+  * bf16 keeps fp32's exponent range, so no loss scaling is required
+    (unlike fp16).
+
+Usage::
+
+    prog = pt.default_main_program()
+    pt.amp.enable(prog)          # all subsequent Executor.run calls use bf16
+    # or: with pt.amp.bf16_guard(prog): exe.run(...)
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+# Ops whose FLOPs dominate and map onto the MXU: compute in bf16.
+WHITE_OPS = frozenset({
+    "conv2d",
+    "depthwise_conv2d",
+    "conv2d_transpose",
+    "conv3d",
+    "mul",
+    "matmul",
+    "fused_attention",
+})
+
+# Numerically sensitive ops: compute in fp32 (reductions over many elements,
+# exponentials, running statistics, parameter updates).
+BLACK_OPS = frozenset({
+    # batch_norm/layer_norm are NOT black-listed: their lowerings accumulate
+    # statistics in fp32 internally while producing outputs in the input
+    # dtype, so bf16 conv/residual chains stay bf16 without precision loss
+    # in the stats.
+    "group_norm",
+    "data_norm",
+    "lrn",
+    "softmax",
+    "log_softmax",
+    "softmax_with_cross_entropy",
+    "cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "bpr_loss",
+    "huber_loss",
+    "log_loss",
+    "hinge_loss",
+    "margin_rank_loss",
+    "mean",
+    "sum",
+    "reduce_sum",
+    "reduce_mean",
+    "reduce_prod",
+    "exp",
+    "log",
+    "cumsum",
+    "accuracy",
+    "auc",
+    "fused_layer_norm_gelu",
+    # optimizer ops: fp32 master-weight updates
+    "sgd",
+    "momentum",
+    "lars_momentum",
+    "adam",
+    "adamax",
+    "adagrad",
+    "decayed_adagrad",
+    "adadelta",
+    "rmsprop",
+    "ftrl",
+    "proximal_gd",
+    "proximal_adagrad",
+})
+
+
+def enable(program=None) -> None:
+    """Mark `program` (default: the default main program) for bf16 autocast."""
+    from .core import framework as fw
+
+    program = program or fw.default_main_program()
+    program._amp_bf16 = True
+    program._mod_count += 1  # invalidate _mod_count-keyed compile caches
+
+
+def disable(program=None) -> None:
+    from .core import framework as fw
+
+    program = program or fw.default_main_program()
+    program._amp_bf16 = False
+    program._mod_count += 1
+
+
+def is_enabled(program) -> bool:
+    return bool(getattr(program, "_amp_bf16", False))
+
+
+@contextlib.contextmanager
+def bf16_guard(program=None):
+    from .core import framework as fw
+
+    program = program or fw.default_main_program()
+    prev = getattr(program, "_amp_bf16", False)
+    program._amp_bf16 = True
+    try:
+        yield
+    finally:
+        program._amp_bf16 = prev
+
+
+def _cast_value(v, dtype):
+    import jax.numpy as jnp
+
+    if v is None or not hasattr(v, "dtype"):
+        return v
+    if v.dtype == jnp.float32 and dtype == jnp.bfloat16:
+        return v.astype(jnp.bfloat16)
+    if v.dtype == jnp.bfloat16 and dtype == jnp.float32:
+        return v.astype(jnp.float32)
+    return v
+
+
+def apply_cast_policy(op_type: str, ins: dict) -> dict:
+    """Cast the float inputs of one op per the autocast policy.  Grad ops
+    (`X_grad`) inherit X's policy so forward and backward agree."""
+    import jax.numpy as jnp
+
+    base = op_type[:-5] if op_type.endswith("_grad") else op_type
+    if base in WHITE_OPS:
+        target = jnp.bfloat16
+    elif base in BLACK_OPS:
+        target = jnp.float32
+    else:
+        return ins
+    return {
+        slot: [_cast_value(v, target) for v in vals]
+        for slot, vals in ins.items()
+    }

@@ -503,9 +503,26 @@ class Program:
 
     def clone(self, for_test: bool = False) -> "Program":
         p = copy.deepcopy(self)
+        # the clone is a distinct (possibly further-mutated) program: drop the
+        # memoized fingerprint and bump the stamp so no cache aliases the
+        # original's executables (e.g. a for_test clone hitting the train
+        # entry would keep dropout live and run optimizer ops during eval)
+        p._fp_cache = None
+        p._mod_count += 1
         if for_test:
             p._is_test = True
             for blk in p.blocks:
+                # reference clone(for_test=True) drops backward/optimize/
+                # lr-sched ops (framework.py Program.clone + _inference_
+                # optimize): an eval program must not update parameters
+                blk.ops = [
+                    op for op in blk.ops
+                    if not (
+                        op.attrs.get(OpRole.ROLE_ATTR_NAME, OpRole.Forward)
+                        in (OpRole.Backward, OpRole.Optimize, OpRole.LRSched)
+                        or op.type.endswith("_grad")
+                    )
+                ]
                 for op in blk.ops:
                     if "is_test" in op.attrs or op.type in ("dropout", "batch_norm"):
                         op.attrs["is_test"] = True
@@ -523,6 +540,8 @@ class Program:
                 kept.append(op)
                 needed.update(op.input_arg_names())
         blk.ops = list(reversed(kept))
+        p._fp_cache = None
+        p._mod_count += 1
         # drop unreferenced non-persistable vars
         referenced = set()
         for op in blk.ops:
@@ -573,7 +592,13 @@ class Program:
     def fingerprint(self) -> str:
         import hashlib
 
-        return hashlib.sha256(self.serialize_to_string()).hexdigest()
+        # memoized on the mutation stamp: cheap enough for executor cache keys
+        cached = getattr(self, "_fp_cache", None)
+        if cached is not None and cached[0] == self._mod_count:
+            return cached[1]
+        fp = hashlib.sha256(self.serialize_to_string()).hexdigest()
+        self._fp_cache = (self._mod_count, fp)
+        return fp
 
     def __repr__(self):
         return "\n".join(repr(b) for b in self.blocks)

@@ -204,10 +204,20 @@ def transformer(
     src_seq_len=None,
     trg_seq_len=None,
     use_flash=False,
+    device_biases=True,
 ):
     """Full encoder-decoder Transformer-base (reference
-    transformer_model.py:396).  Declares padded-sequence data vars + attention
-    bias vars; returns (avg_cost, predict, feed_names)."""
+    transformer_model.py:396).  Declares padded-sequence data vars; returns
+    (avg_cost, predict, feed_names).
+
+    device_biases (TPU-first, default): attention biases are computed ON
+    DEVICE inside the compiled step — padding masks from the word ids
+    (pad id 0) and the causal mask as a program constant.  The reference
+    feeds dense [b, n_head, t, t] bias tensors from the host
+    (transformer_model.py prepare_batch_input), which costs O(b·h·t²)
+    host->HBM bandwidth per step — at (b=32, h=8, t=256) that is ~200 MB
+    per step, orders of magnitude more than the token ids themselves.
+    Set device_biases=False for reference-parity feeding."""
     src_seq_len = src_seq_len or max_length
     trg_seq_len = trg_seq_len or max_length
 
@@ -215,18 +225,48 @@ def transformer(
     src_pos = layers.data(name="src_pos", shape=[src_seq_len, 1], dtype="int64")
     trg_word = layers.data(name="trg_word", shape=[trg_seq_len, 1], dtype="int64")
     trg_pos = layers.data(name="trg_pos", shape=[trg_seq_len, 1], dtype="int64")
-    src_slf_attn_bias = layers.data(
-        name="src_slf_attn_bias", shape=[n_head, src_seq_len, src_seq_len],
-        dtype="float32",
-    )
-    trg_slf_attn_bias = layers.data(
-        name="trg_slf_attn_bias", shape=[n_head, trg_seq_len, trg_seq_len],
-        dtype="float32",
-    )
-    trg_src_attn_bias = layers.data(
-        name="trg_src_attn_bias", shape=[n_head, trg_seq_len, src_seq_len],
-        dtype="float32",
-    )
+    if device_biases:
+        neg_inf = -1e9
+
+        def pad_bias(word, t):
+            # [b, t, 1] ids -> [b, 1, 1, t] additive bias (-inf at pad id 0)
+            zero = layers.fill_constant([1], "int64", 0)
+            is_pad = layers.cast(layers.equal(word, zero), "float32")
+            bias = layers.scale(is_pad, scale=neg_inf)
+            bias = layers.reshape(bias, [-1, 1, 1, t])
+            bias.stop_gradient = True
+            return bias
+
+        src_pad = pad_bias(src_word, src_seq_len)
+        # causal mask from the (already fed) position ids: bias[q, k] = -inf
+        # where k_pos > q_pos — computed on device, no O(t^2) IR constant
+        qpos = layers.reshape(trg_pos, [-1, trg_seq_len, 1])
+        kpos = layers.reshape(trg_pos, [-1, 1, trg_seq_len])
+        future = layers.cast(layers.less_than(qpos, kpos), "float32")
+        causal = layers.reshape(
+            layers.scale(future, scale=neg_inf),
+            [-1, 1, trg_seq_len, trg_seq_len],
+        )
+        causal.stop_gradient = True
+        src_slf_attn_bias = src_pad
+        trg_slf_attn_bias = layers.elementwise_add(
+            causal, pad_bias(trg_word, trg_seq_len)
+        )
+        trg_slf_attn_bias.stop_gradient = True
+        trg_src_attn_bias = src_pad
+    else:
+        src_slf_attn_bias = layers.data(
+            name="src_slf_attn_bias", shape=[n_head, src_seq_len, src_seq_len],
+            dtype="float32",
+        )
+        trg_slf_attn_bias = layers.data(
+            name="trg_slf_attn_bias", shape=[n_head, trg_seq_len, trg_seq_len],
+            dtype="float32",
+        )
+        trg_src_attn_bias = layers.data(
+            name="trg_src_attn_bias", shape=[n_head, trg_seq_len, src_seq_len],
+            dtype="float32",
+        )
     gold = layers.data(name="lbl_word", shape=[trg_seq_len, 1], dtype="int64")
     weights = layers.data(name="lbl_weight", shape=[trg_seq_len, 1], dtype="float32")
 
@@ -263,17 +303,20 @@ def transformer(
     token_count = layers.reduce_sum(w2d)
     avg_cost = layers.elementwise_div(sum_cost, token_count)
 
-    feed_names = [
-        "src_word", "src_pos", "trg_word", "trg_pos",
-        "src_slf_attn_bias", "trg_slf_attn_bias", "trg_src_attn_bias",
-        "lbl_word", "lbl_weight",
-    ]
+    feed_names = ["src_word", "src_pos", "trg_word", "trg_pos",
+                  "lbl_word", "lbl_weight"]
+    if not device_biases:
+        feed_names[4:4] = [
+            "src_slf_attn_bias", "trg_slf_attn_bias", "trg_src_attn_bias"
+        ]
     return avg_cost, predict, feed_names
 
 
 def make_batch(batch_size, src_len, trg_len, n_head, src_vocab, trg_vocab,
-               rng=None):
-    """Synthetic padded batch with proper attention biases."""
+               rng=None, device_biases=True):
+    """Synthetic padded batch.  With device_biases (default) only token
+    streams are produced — the model builds attention biases on device; pass
+    device_biases=False for the reference-parity dense-bias feed."""
     rng = rng or np.random.RandomState(0)
     neg_inf = -1e9
 
@@ -283,19 +326,21 @@ def make_batch(batch_size, src_len, trg_len, n_head, src_vocab, trg_vocab,
     src_word = rng.randint(1, src_vocab, (batch_size, src_len, 1)).astype("int64")
     trg_word = rng.randint(1, trg_vocab, (batch_size, trg_len, 1)).astype("int64")
     lbl_word = rng.randint(1, trg_vocab, (batch_size, trg_len, 1)).astype("int64")
-    src_bias = np.zeros((batch_size, n_head, src_len, src_len), "float32")
-    causal = np.triu(np.full((trg_len, trg_len), neg_inf, "float32"), 1)
-    trg_bias = np.tile(causal[None, None], (batch_size, n_head, 1, 1))
-    cross_bias = np.zeros((batch_size, n_head, trg_len, src_len), "float32")
     lbl_weight = np.ones((batch_size, trg_len, 1), "float32")
-    return {
+    batch = {
         "src_word": src_word,
         "src_pos": pos(batch_size, src_len),
         "trg_word": trg_word,
         "trg_pos": pos(batch_size, trg_len),
-        "src_slf_attn_bias": src_bias,
-        "trg_slf_attn_bias": trg_bias,
-        "trg_src_attn_bias": cross_bias,
         "lbl_word": lbl_word,
         "lbl_weight": lbl_weight,
     }
+    if not device_biases:
+        causal = np.triu(np.full((trg_len, trg_len), neg_inf, "float32"), 1)
+        batch["src_slf_attn_bias"] = np.zeros(
+            (batch_size, n_head, src_len, src_len), "float32")
+        batch["trg_slf_attn_bias"] = np.tile(
+            causal[None, None], (batch_size, n_head, 1, 1))
+        batch["trg_src_attn_bias"] = np.zeros(
+            (batch_size, n_head, trg_len, src_len), "float32")
+    return batch

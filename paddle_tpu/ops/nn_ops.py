@@ -234,23 +234,30 @@ def lower_batch_norm(ctx, ins):
     bshape = [1] * x.ndim
     bshape[1 if layout == "NCHW" else -1] = x.shape[1 if layout == "NCHW" else -1]
 
+    # Mixed precision: statistics accumulate in fp32 even when x is bf16
+    # (bf16's 8-bit mantissa loses too much in large reductions); the
+    # normalization itself is folded to a per-channel scale/shift applied in
+    # x's dtype, so a bf16 conv->bn->relu chain stays bf16 and XLA fuses it.
+    stat_dtype = jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
+
     if use_global:
         mean, var = mean_in, var_in
         mean_out, var_out = mean_in, var_in
         saved_mean, saved_var = mean_in, var_in
     else:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+        xs = x.astype(stat_dtype)
+        mean = jnp.mean(xs, axis=axes)
+        var = jnp.mean(jnp.square(xs), axis=axes) - jnp.square(mean)
         m = jax.lax.stop_gradient(mean)
         v = jax.lax.stop_gradient(var)
         mean_out = mean_in * momentum + m * (1 - momentum)
         var_out = var_in * momentum + v * (1 - momentum)
         saved_mean, saved_var = m, v
 
-    inv_std = jax.lax.rsqrt(var.reshape(bshape) + eps)
-    y = (x - mean.reshape(bshape)) * inv_std * scale.reshape(bshape) + bias.reshape(
-        bshape
-    )
+    inv_std = jax.lax.rsqrt(var.astype(stat_dtype) + eps)
+    w = scale.astype(stat_dtype) * inv_std                    # [C]
+    b = bias.astype(stat_dtype) - mean.astype(stat_dtype) * w  # [C]
+    y = x * w.astype(x.dtype).reshape(bshape) + b.astype(x.dtype).reshape(bshape)
     return {
         "Y": [y],
         "MeanOut": [mean_out],
@@ -261,20 +268,26 @@ def lower_batch_norm(ctx, ins):
 
 
 def layer_norm_core(x, scale, bias, axis, eps):
-    """Shared layer-norm math (also used by fused_layer_norm_gelu)."""
+    """Shared layer-norm math (also used by fused_layer_norm_gelu).
+
+    Mixed precision: statistics in fp32 even for bf16 inputs (mantissa loss
+    in the row reductions otherwise); the result is cast back to x's dtype so
+    bf16 residual streams stay bf16 end to end."""
     import jax
 
     jnp = _jnp()
+    stat_dtype = jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
+    xs = x.astype(stat_dtype)
     axes = tuple(range(axis, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
-    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    mean = jnp.mean(xs, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xs - mean), axis=axes, keepdims=True)
+    y = (xs - mean) * jax.lax.rsqrt(var + eps)
     norm_shape = (1,) * axis + x.shape[axis:]
     if scale is not None:
-        y = y * scale.reshape(norm_shape)
+        y = y * scale.astype(stat_dtype).reshape(norm_shape)
     if bias is not None:
-        y = y + bias.reshape(norm_shape)
-    return y, mean, var
+        y = y + bias.astype(stat_dtype).reshape(norm_shape)
+    return y.astype(x.dtype), mean, var
 
 
 @register("layer_norm", infer_shape=_bn_infer)

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..core import executor as exec_mod
 from ..core import framework as fw
+from ..core.executor import prng_key as _prng_key
 
 
 class ShardingPlan:
@@ -123,7 +124,10 @@ class ShardedProgram:
         block = program.global_block()
 
         key = (
-            id(program), program._mod_count, tuple(feed_names),
+            program.fingerprint(),
+            bool(getattr(program, "_amp_bf16", False)),
+            bool(getattr(program, "_is_test", False)),
+            tuple(feed_names),
             tuple(
                 (tuple(np.asarray(feed[n]).shape), str(np.asarray(feed[n]).dtype))
                 for n in feed_names
@@ -157,7 +161,7 @@ class ShardedProgram:
         self._run_counter += 1
         if needs_key:
             k = jax.random.fold_in(
-                jax.random.PRNGKey(program.random_seed or 0), self._run_counter
+                _prng_key(program.random_seed or 0), self._run_counter
             )
             fetches, new_state = jitted(feed_vals, rw_vals, ro_vals, k)
         else:
@@ -197,7 +201,7 @@ class ShardedProgram:
 
         def run_fn(feed_vals, rw_vals, ro_vals, key=None):
             if key is None:
-                key = jax.random.PRNGKey(program.random_seed or 0)
+                key = _prng_key(program.random_seed or 0)
             tctx = exec_mod.TraceContext(
                 program, key, is_test=getattr(program, "_is_test", False),
                 mesh=mesh,
