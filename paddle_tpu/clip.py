@@ -131,30 +131,31 @@ class GradientClipByGlobalNorm(GradientClipBase):
         return out
 
 
-_global_clip = None
-
-
 def set_gradient_clip(clip, param_list=None, program=None):
-    global _global_clip
-    _global_clip = clip
+    """Attach a clip strategy to `program` (default: the default main
+    program) — scoped to the program, not process-global, so building a
+    second model does not inherit the first one's clipping."""
+    program = program or fw.default_main_program()
+    program._gradient_clip = clip
     if param_list:
         for p in param_list:
             if isinstance(p, str):
-                p = fw.default_main_program().global_block().var(p)
+                p = program.global_block().var(p)
             p.gradient_clip_attr = clip
 
 
-def append_gradient_clip_ops(param_grads):
-    global _global_clip
-    if _global_clip is None and not any(
+def append_gradient_clip_ops(param_grads, program=None):
+    program = program or fw.default_main_program()
+    prog_clip = getattr(program, "_gradient_clip", None)
+    if prog_clip is None and not any(
         getattr(p, "gradient_clip_attr", None) for p, g in param_grads
     ):
         return param_grads
-    if isinstance(_global_clip, GradientClipByGlobalNorm):
-        return _global_clip._process_all(param_grads)
+    if isinstance(prog_clip, GradientClipByGlobalNorm):
+        return prog_clip._process_all(param_grads)
     out = []
     for p, g in param_grads:
-        clip = getattr(p, "gradient_clip_attr", None) or _global_clip
+        clip = getattr(p, "gradient_clip_attr", None) or prog_clip
         if g is None or clip is None or isinstance(clip, GradientClipByGlobalNorm):
             out.append((p, g))
             continue

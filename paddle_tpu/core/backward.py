@@ -35,7 +35,10 @@ def _collect_no_grad(
     want = want_grads or set()
     no_grad = set(extra or ()) - want
     for v in block.vars.values():
-        if (v.stop_gradient or v.is_data) and v.name not in want:
+        # data vars default stop_gradient=True (layers/tensor.py data());
+        # explicitly setting stop_gradient=False on one requests its grad
+        # (e.g. host-offloaded embedding rows, parallel/embedding.py)
+        if v.stop_gradient and v.name not in want:
             no_grad.add(v.name)
     for op in block.ops:
         opdef = registry.lookup(op.type)

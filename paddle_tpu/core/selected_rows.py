@@ -1,0 +1,126 @@
+"""SelectedRows: the row-sparse tensor for embedding gradients.
+
+Capability parity with the reference's SelectedRows
+(reference: paddle/fluid/framework/selected_rows.h:32 — a {rows, value,
+height} triple carrying only the touched rows of a tall tensor;
+math/selected_rows_functor.h MergeAdd/SelectedRowsAddToTensor), redesigned
+TPU-first:
+
+  * XLA needs static shapes, so a SelectedRows here is a pytree of
+    `ids [K] int32` + `rows [K, ...]` with K = the (static) number of
+    lookups, duplicates allowed — no dynamic-size unique().
+  * Deduplication (reference MergeAdd) is `merged()`: argsort + segment-sum
+    at static size K, with out-of-range sentinel ids (= height) padding the
+    unused tail.  JAX scatters DROP out-of-bounds indices and gathers CLIP
+    them, which makes sentinel-padded updates exact no-ops.
+  * The payoff: optimizer updates touch O(K·D) HBM instead of O(vocab·D) —
+    scatter-add on a donated buffer updates the table in place.  This is
+    what makes hash_dim=1e6 x 26-slot CTR training (dist_ctr.py) viable.
+
+Registered as a jax pytree so it flows through jit/scan/vjp boundaries.
+"""
+
+from __future__ import annotations
+
+
+class SelectedRows:
+    """rows [K, ...] + ids [K] + height (static vocab size)."""
+
+    __slots__ = ("ids", "rows", "height")
+
+    def __init__(self, ids, rows, height: int):
+        self.ids = ids
+        self.rows = rows
+        self.height = int(height)
+
+    # -- array-like surface (lets amp cast policies treat it uniformly) ----
+    @property
+    def dtype(self):
+        return self.rows.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.rows.shape[1:])
+
+    def astype(self, dtype):
+        if self.rows.dtype == dtype:
+            return self
+        return SelectedRows(self.ids, self.rows.astype(dtype), self.height)
+
+    def __repr__(self):
+        return (
+            f"SelectedRows(ids={getattr(self.ids, 'shape', None)}, "
+            f"rows={getattr(self.rows, 'shape', None)}, height={self.height})"
+        )
+
+    # -- reference-functor equivalents -------------------------------------
+    def merged(self):
+        """MergeAdd (selected_rows_functor.h): combine duplicate ids.
+
+        Returns (uids [K], mrows [K, ...]) where each unique id appears once
+        with its row-summed value; unused tail slots have uid == height
+        (out of range — dropped by scatter, clipped by gather)."""
+        import jax
+        import jax.numpy as jnp
+
+        ids = self.ids.reshape(-1).astype("int32")
+        k = ids.shape[0]
+        order = jnp.argsort(ids)
+        sids = ids[order]
+        srows = self.rows[order]
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), bool), sids[1:] != sids[:-1]]
+        )
+        seg = jnp.cumsum(is_start.astype("int32")) - 1  # [K] in [0, K)
+        mrows = jax.ops.segment_sum(srows, seg, num_segments=k)
+        uids = jnp.full((k,), self.height, "int32").at[seg].set(sids)
+        return uids, mrows
+
+    def to_dense(self, like=None):
+        """SelectedRowsAddToTensor: scatter-add into a dense zero tensor."""
+        import jax.numpy as jnp
+
+        if like is not None:
+            base = jnp.zeros_like(like)
+        else:
+            base = jnp.zeros(self.shape, self.rows.dtype)
+        ids = self.ids.reshape(-1).astype("int32")
+        return base.at[ids].add(
+            self.rows.astype(base.dtype), mode="drop"
+        )
+
+    def add_to(self, dense):
+        """dense + this (used by the sum op for mixed dense/sparse)."""
+        ids = self.ids.reshape(-1).astype("int32")
+        return dense.at[ids].add(self.rows.astype(dense.dtype), mode="drop")
+
+    @staticmethod
+    def concat(items):
+        """Sum of SelectedRows = concatenation (duplicates are fine)."""
+        import jax.numpy as jnp
+
+        assert items, "empty SelectedRows concat"
+        h = items[0].height
+        ids = jnp.concatenate([s.ids.reshape(-1) for s in items])
+        rows = jnp.concatenate([s.rows for s in items], axis=0)
+        return SelectedRows(ids, rows, h)
+
+
+def _sr_flatten(sr):
+    return (sr.ids, sr.rows), sr.height
+
+
+def _sr_unflatten(height, children):
+    ids, rows = children
+    return SelectedRows(ids, rows, height)
+
+
+def _register_pytree():
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        SelectedRows, _sr_flatten, _sr_unflatten
+    )
+
+
+_register_pytree()

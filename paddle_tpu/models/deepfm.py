@@ -73,7 +73,10 @@ def ctr_deepfm(dense_input, sparse_inputs, embedding_size=10,
 
 
 def build_train_net(embedding_size=10, hash_dim=HASH_DIM, is_sparse=True,
-                    with_optimizer=True, lr=1e-3):
+                    with_optimizer=True, lr=1e-3, optimizer="adam"):
+    """optimizer: "sgd" (reference dist_ctr.py:107 parity — fully row-sparse
+    updates, per-step cost O(touched rows)) or "adam" (lazy_mode is enabled
+    so the sparse tables keep row-sparse moment updates, adam_op.h:233)."""
     from .. import optimizer as opt_mod
 
     dense = layers.data(name="dense_input", shape=[DENSE_DIM], dtype="float32")
@@ -87,7 +90,10 @@ def build_train_net(embedding_size=10, hash_dim=HASH_DIM, is_sparse=True,
     avg_cost = layers.mean(x=cost)
     auc_var, _ = layers.auc(input=predict, label=label)
     if with_optimizer:
-        opt_mod.Adam(learning_rate=lr).minimize(avg_cost)
+        if optimizer == "sgd":
+            opt_mod.SGD(learning_rate=lr).minimize(avg_cost)
+        else:
+            opt_mod.Adam(learning_rate=lr, lazy_mode=True).minimize(avg_cost)
     feeds = ["dense_input"] + [f"C{i}" for i in range(SPARSE_SLOTS)] + ["click"]
     return avg_cost, auc_var, predict, feeds
 

@@ -46,9 +46,23 @@ def _ew_infer(ctx):
 
 
 def _register_elementwise(name, fn):
-    def lower(ctx, ins, _fn=fn):
+    def lower(ctx, ins, _fn=fn, _name=name):
+        from ..core.selected_rows import SelectedRows
+
         x = ins["X"][0]
         y = ins["Y"][0]
+        if isinstance(x, SelectedRows):
+            # row-sparse grad x scalar (e.g. global-norm clip scale): apply
+            # to the rows; any non-scalar rhs would touch untouched rows
+            if _name in ("elementwise_mul", "elementwise_div") and (
+                not hasattr(y, "shape") or int(np.prod(y.shape)) == 1
+            ):
+                ys = y.reshape(()) if hasattr(y, "reshape") else y
+                return {"Out": [SelectedRows(x.ids, _fn(x.rows, ys), x.height)]}
+            raise TypeError(
+                f"{_name} on SelectedRows supports only scalar rhs; got "
+                f"shape {getattr(y, 'shape', None)}"
+            )
         yb = _broadcast_y(x, y, ctx.attr("axis", -1))
         return {"Out": [_fn(x, yb)]}
 
@@ -239,10 +253,18 @@ def lower_mul(ctx, ins):
 
 @register("scale", infer_shape=_ew_infer)
 def lower_scale(ctx, ins):
-    """out = scale * (x + bias) or scale * x + bias (reference: scale_op.cc)."""
+    """out = scale * (x + bias) or scale * x + bias (reference: scale_op.cc;
+    also accepts SelectedRows like the reference kernel — bias must be 0,
+    otherwise untouched rows would change)."""
+    from ..core.selected_rows import SelectedRows
+
     x = ins["X"][0]
     scale = ctx.attr("scale", 1.0)
     bias = ctx.attr("bias", 0.0)
+    if isinstance(x, SelectedRows):
+        if bias != 0.0:
+            raise TypeError("scale(SelectedRows) requires bias == 0")
+        return {"Out": [SelectedRows(x.ids, x.rows * scale, x.height)]}
     if ctx.attr("bias_after_scale", True):
         return {"Out": [x * scale + bias]}
     return {"Out": [(x + bias) * scale]}
@@ -256,28 +278,62 @@ def _sum_infer(ctx):
 
 @register("sum", infer_shape=_sum_infer)
 def lower_sum(ctx, ins):
-    """Add N tensors (reference: sum_op.cc; also sums SelectedRows grads —
-    here sparse grads arrive pre-densified or as IndexedSlices)."""
+    """Add N tensors (reference: sum_op.cc).  SelectedRows operands follow
+    math/selected_rows_functor.h: all-sparse sums concatenate (duplicates
+    are legal and merged lazily at the consumer); mixed dense+sparse sums
+    scatter-add the sparse parts into the dense sum."""
+    from ..core.selected_rows import SelectedRows
+
     vals = [v for v in ins["X"] if v is not None]
-    out = vals[0]
-    for v in vals[1:]:
+    sparse = [v for v in vals if isinstance(v, SelectedRows)]
+    dense = [v for v in vals if not isinstance(v, SelectedRows)]
+    if sparse and not dense:
+        return {"Out": [SelectedRows.concat(sparse)]}
+    if not dense:
+        raise ValueError("sum op with no inputs")
+    out = dense[0]
+    for v in dense[1:]:
         out = out + v
+    for s in sparse:
+        out = s.add_to(out)
     return {"Out": [out]}
+
+
+def _merged_sr(x):
+    """Reference clip kernels merge duplicate SelectedRows rows before any
+    nonlinear elementwise op (clip.py merge_selected_rows): (a+b) must be
+    clipped once, not clip(a)+clip(b)."""
+    from ..core.selected_rows import SelectedRows
+
+    uids, mrows = x.merged()
+    return SelectedRows(uids, mrows, x.height)
 
 
 @register("clip", infer_shape=_ew_infer)
 def lower_clip(ctx, ins):
+    from ..core.selected_rows import SelectedRows
+
     jnp = _jnp()
-    return {
-        "Out": [jnp.clip(ins["X"][0], ctx.attr("min", -1.0), ctx.attr("max", 1.0))]
-    }
+    x = ins["X"][0]
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    if isinstance(x, SelectedRows):
+        m = _merged_sr(x)
+        return {"Out": [SelectedRows(m.ids, jnp.clip(m.rows, lo, hi), m.height)]}
+    return {"Out": [jnp.clip(x, lo, hi)]}
 
 
 @register("clip_by_norm", infer_shape=_ew_infer)
 def lower_clip_by_norm(ctx, ins):
+    from ..core.selected_rows import SelectedRows
+
     jnp = _jnp()
     x = ins["X"][0]
     max_norm = ctx.attr("max_norm", 1.0)
+    if isinstance(x, SelectedRows):
+        m = _merged_sr(x)
+        norm = jnp.sqrt(jnp.sum(jnp.square(m.rows)))
+        s = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return {"Out": [SelectedRows(m.ids, m.rows * s, m.height)]}
     norm = jnp.sqrt(jnp.sum(jnp.square(x)))
     scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
     return {"Out": [x * scale]}
@@ -285,8 +341,14 @@ def lower_clip_by_norm(ctx, ins):
 
 @register("squared_l2_norm")
 def lower_squared_l2_norm(ctx, ins):
+    from ..core.selected_rows import SelectedRows
+
     jnp = _jnp()
-    return {"Out": [jnp.sum(jnp.square(ins["X"][0])).reshape((1,))]}
+    x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        _, mrows = x.merged()
+        return {"Out": [jnp.sum(jnp.square(mrows)).reshape((1,))]}
+    return {"Out": [jnp.sum(jnp.square(x)).reshape((1,))]}
 
 
 def _install():

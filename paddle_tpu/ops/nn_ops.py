@@ -546,9 +546,16 @@ def lower_lookup_table(ctx, ins):
 
 @register("lookup_table_grad", no_grad=True)
 def lower_lookup_table_grad(ctx, ins):
-    """Scatter-add of output grads into a dense row gradient.  XLA lowers
-    segment-sum/scatter efficiently on TPU; true SelectedRows materialization
-    is kept for the host-offloaded embedding path (parallel/embedding)."""
+    """Embedding gradient (reference lookup_table_op.h:132).
+
+    is_sparse=True: returns a SelectedRows (ids, rows) pair — O(batch)
+    memory, consumed by the sparse variants of the optimizer ops, parity
+    with the reference's SelectedRows grad + SparseAdagrad/SparseAdam
+    functors (adagrad_op.h:24).
+    is_sparse=False: dense scatter-add into a zeros_like(W) tensor
+    (O(vocab) — fine for small tables)."""
+    from ..core.selected_rows import SelectedRows
+
     w = ins["W"][0]
     ids = ins["Ids"][0].reshape(-1).astype("int32")
     gout = ins["Out@GRAD"][0]
@@ -557,6 +564,8 @@ def lower_lookup_table_grad(ctx, ins):
     padding_idx = ctx.attr("padding_idx", -1)
     if padding_idx is not None and padding_idx >= 0:
         gout2 = gout2 * (ids != padding_idx)[:, None].astype(gout2.dtype)
+    if ctx.attr("is_sparse", False):
+        return {"W@GRAD": [SelectedRows(ids, gout2.astype(w.dtype), w.shape[0])]}
     gw = jnp.zeros_like(w).at[ids].add(gout2.astype(w.dtype))
     return {"W@GRAD": [gw]}
 

@@ -22,17 +22,45 @@ def _lr(ins):
     return ins["LearningRate"][0].reshape(())
 
 
+def _is_sparse(g):
+    from ..core.selected_rows import SelectedRows
+
+    return isinstance(g, SelectedRows)
+
+
 @register("sgd", no_grad=True)
 def lower_sgd(ctx, ins):
+    """reference sgd_op.h: dense kernel + SelectedRows kernel.  The sparse
+    branch scatter-adds into the donated param buffer: O(touched rows) HBM
+    traffic, duplicates need no merge (addition commutes)."""
     p, g = ins["Param"][0], ins["Grad"][0]
+    if _is_sparse(g):
+        ids = g.ids.reshape(-1).astype("int32")
+        upd = (-_lr(ins) * g.rows).astype(p.dtype)
+        return {"ParamOut": [p.at[ids].add(upd, mode="drop")]}
     return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
 
 
 @register("momentum", no_grad=True)
 def lower_momentum(ctx, ins):
+    """Sparse branch = lazy momentum on merged rows (reference
+    momentum_op.h SelectedRows kernel): only touched velocity rows decay."""
+    jnp = _jnp()
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = ctx.attr("mu", 0.9)
     lr = _lr(ins)
+    if _is_sparse(g):
+        uids, grows = g.merged()
+        grows = grows.astype(p.dtype)
+        vr = mu * jnp.take(v, uids, axis=0, mode="clip") + grows
+        if ctx.attr("use_nesterov", False):
+            step = (grows + mu * vr) * lr
+        else:
+            step = lr * vr
+        return {
+            "ParamOut": [p.at[uids].add(-step, mode="drop")],
+            "VelocityOut": [v.at[uids].set(vr, mode="drop")],
+        }
     v_out = mu * v + g
     if ctx.attr("use_nesterov", False):
         p_out = p - (g + mu * v_out) * lr
@@ -58,6 +86,9 @@ def lower_lars_momentum(ctx, ins):
 
 @register("adam", no_grad=True)
 def lower_adam(ctx, ins):
+    """reference adam_op.h: dense + SparseAdamFunctor.  The sparse branch is
+    lazy adam (reference `lazy_mode`): moments update only on touched rows
+    (merged first — duplicate ids must contribute one moment update)."""
     jnp = _jnp()
     p, g = ins["Param"][0], ins["Grad"][0]
     m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
@@ -66,10 +97,30 @@ def lower_adam(ctx, ins):
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     lr = _lr(ins)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    if _is_sparse(g) and not ctx.attr("lazy_mode", False):
+        # non-lazy (the reference default, adam_op.h SparseAdamFunctor
+        # non-lazy mode): every row's moments decay each step, so the
+        # sparse grad densifies — O(vocab), exact dense-adam semantics.
+        g = g.to_dense()
+    if _is_sparse(g):
+        uids, grows = g.merged()
+        grows = grows.astype(p.dtype)
+        m1r = b1 * jnp.take(m1, uids, axis=0, mode="clip") + (1 - b1) * grows
+        m2r = b2 * jnp.take(m2, uids, axis=0, mode="clip") + (
+            1 - b2
+        ) * jnp.square(grows)
+        step = lr_t * m1r / (jnp.sqrt(m2r) + eps)
+        return {
+            "ParamOut": [p.at[uids].add(-step, mode="drop")],
+            "Moment1Out": [m1.at[uids].set(m1r, mode="drop")],
+            "Moment2Out": [m2.at[uids].set(m2r, mode="drop")],
+            "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2],
+        }
     g = g.astype(p.dtype)
     m1o = b1 * m1 + (1 - b1) * g
     m2o = b2 * m2 + (1 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     p_out = p - lr_t * m1o / (jnp.sqrt(m2o) + eps)
     return {
         "ParamOut": [p_out],
@@ -99,9 +150,20 @@ def lower_adamax(ctx, ins):
 
 @register("adagrad", no_grad=True)
 def lower_adagrad(ctx, ins):
+    """reference adagrad_op.h:24 SparseAdagradFunctor: merge duplicate rows,
+    accumulate squared grads on touched rows only, update those rows."""
     jnp = _jnp()
     p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     eps = ctx.attr("epsilon", 1e-6)
+    if _is_sparse(g):
+        uids, grows = g.merged()
+        grows = grows.astype(p.dtype)
+        mr = jnp.take(m, uids, axis=0, mode="clip") + jnp.square(grows)
+        step = _lr(ins) * grows / (jnp.sqrt(mr) + eps)
+        return {
+            "ParamOut": [p.at[uids].add(-step, mode="drop")],
+            "MomentOut": [m.at[uids].set(mr, mode="drop")],
+        }
     m_out = m + jnp.square(g)
     p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
     return {"ParamOut": [p_out], "MomentOut": [m_out]}

@@ -258,6 +258,7 @@ class AdamOptimizer(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -298,6 +299,7 @@ class AdamOptimizer(Optimizer):
                 "beta1": self._beta1,
                 "beta2": self._beta2,
                 "epsilon": self._epsilon,
+                "lazy_mode": self._lazy_mode,
                 fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Optimize,
             },
         )
@@ -342,6 +344,7 @@ class AdamaxOptimizer(Optimizer):
                 "beta1": self._beta1,
                 "beta2": self._beta2,
                 "epsilon": self._epsilon,
+                "lazy_mode": self._lazy_mode,
                 fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Optimize,
             },
         )

@@ -1,0 +1,104 @@
+"""Sharded / host-offloaded embedding tables.
+
+Capability parity with the reference's distributed lookup_table path
+(reference: operators/lookup_table_op.cc:92 `remote_prefetch`,
+operators/distributed/parameter_prefetch.cc — ids split across pservers,
+rows pulled over RPC, grads pushed as SelectedRows;
+transpiler/distribute_transpiler.py:1334 distributed lookup table),
+redesigned TPU-first:
+
+  * **Mesh-sharded table (the default)**: the table lives in HBM,
+    vocab-sharded over a mesh axis (`P(axis, None)`).  The in-step gather
+    w[ids] on a sharded operand compiles to XLA GSPMD collective gathers
+    over ICI — the pserver RPC round-trip becomes compiler-scheduled
+    all-to-all traffic.  Use `vocab_sharded_rules()` to produce the
+    ShardingPlan param_rules; nothing else changes (same `layers.embedding`
+    call, same sparse optimizer path).
+  * **Host-offloaded table** (`HostEmbeddingTable`): for tables larger than
+    HBM (the reference's pserver-resident case).  The table lives in host
+    RAM; each step the caller looks rows up on host, feeds them as a dense
+    [K, D] input, and applies the fetched row gradients back on host —
+    mirroring the Downpour-style pull/push split
+    (python/paddle/fluid/distributed/downpour.py:25) without an RPC layer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def vocab_sharded_rules(
+    patterns, axis: str = "model"
+) -> List[Tuple[str, object]]:
+    """ShardingPlan param_rules entries that shard embedding tables' vocab
+    dim over `axis`.  `patterns`: iterable of param-name regexes."""
+    from jax.sharding import PartitionSpec as P
+
+    return [(pat, P(axis, None)) for pat in patterns]
+
+
+class HostEmbeddingTable:
+    """Host-RAM embedding table with sparse lookup/update.
+
+    Usage per step (see tests/test_sparse_embedding.py):
+        rows = table.lookup(ids)            # host gather -> feed
+        ... run program with a dense [K, D] input var, fetch rows_grad ...
+        table.apply_grad(ids, rows_grad)    # host sparse update
+    """
+
+    def __init__(self, vocab_size: int, dim: int, *, optimizer: str = "sgd",
+                 lr: float = 0.01, seed: int = 0, init_scale: float = 0.01,
+                 dtype: str = "float32"):
+        rng = np.random.RandomState(seed)
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.lr = float(lr)
+        self.optimizer = optimizer
+        self.table = (
+            rng.uniform(-init_scale, init_scale, (vocab_size, dim))
+            .astype(dtype)
+        )
+        if optimizer == "adagrad":
+            self._moment = np.zeros((vocab_size, dim), dtype)
+        elif optimizer != "sgd":
+            raise ValueError(f"unsupported host optimizer {optimizer!r}")
+
+    def lookup(self, ids) -> np.ndarray:
+        """Gather rows for a batch of ids (any shape; returns
+        [..., dim])."""
+        ids = np.asarray(ids)
+        flat = ids.reshape(-1).astype(np.int64)
+        rows = self.table[flat]
+        return rows.reshape(ids.shape + (self.dim,))
+
+    def apply_grad(self, ids, rows_grad) -> None:
+        """Sparse update from the fetched gradient of the looked-up rows.
+        Duplicate ids accumulate (np.add.at), matching SelectedRows
+        merge semantics."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        g = np.asarray(rows_grad, dtype=self.table.dtype)
+        g = g.reshape(len(ids), self.dim)
+        if self.optimizer == "sgd":
+            np.add.at(self.table, ids, -self.lr * g)
+        else:  # adagrad (merged like SparseAdagradFunctor, adagrad_op.h:24)
+            uids, inv = np.unique(ids, return_inverse=True)
+            merged = np.zeros((len(uids), self.dim), self.table.dtype)
+            np.add.at(merged, inv, g)
+            self._moment[uids] += np.square(merged)
+            self.table[uids] -= (
+                self.lr * merged / (np.sqrt(self._moment[uids]) + 1e-6)
+            )
+
+    def save(self, path: str) -> None:
+        state = {"table": self.table}
+        if self.optimizer == "adagrad":
+            state["moment"] = self._moment
+        np.savez(path, **state)
+
+    def load(self, path: str) -> None:
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        self.table = data["table"]
+        if self.optimizer == "adagrad" and "moment" in data:
+            self._moment = data["moment"]
