@@ -1,0 +1,428 @@
+"""Miscellaneous op lowerings — losses, similarity, shape utilities.
+
+Closes the op-coverage gap vs the reference operator library (SURVEY.md
+§2.3).  Each lowering cites its reference kernel; gradients come from the
+generic vjp grad maker unless noted.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# Pairwise / ranking losses
+# ---------------------------------------------------------------------------
+
+
+@register("rank_loss")
+def lower_rank_loss(ctx, ins):
+    """out = log(1 + exp(left-right)) - label*(left-right)
+    (reference rank_loss_op.h RankLossKernel)."""
+    jnp = _jnp()
+    left, right, label = ins["Left"][0], ins["Right"][0], ins["Label"][0]
+    d = left - right
+    return {"Out": [jnp.log1p(jnp.exp(d)) - label * d]}
+
+
+@register("modified_huber_loss")
+def lower_modified_huber_loss(ctx, ins):
+    """reference modified_huber_loss_op.h: y in {0,1} -> z = 2y-1;
+    val = x*z; loss = -4val if val<-1; (1-val)^2 if val<1; else 0."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    val = x * (2.0 * y - 1.0)
+    loss = jnp.where(
+        val < -1.0, -4.0 * val,
+        jnp.where(val < 1.0, jnp.square(1.0 - val), 0.0),
+    )
+    return {"IntermediateVal": [val], "Out": [loss]}
+
+
+@register("teacher_student_sigmoid_loss")
+def lower_teacher_student_sigmoid_loss(ctx, ins):
+    """reference teacher_student_sigmoid_loss_op.h:44-63: label encodes
+    {click-only: -1, noclick+teacher: [0,1), click+teacher: [1,2)}."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    label = ins["Label"][0].astype(x.dtype)
+    base = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    y_m2 = base                                  # label < -1
+    y_m1 = base - x                              # -1 <= label < 0
+    y_01 = base + base - x * label               # 0 <= label < 1
+    y_12 = base - x + base - x * (label - 1.0)   # label >= 1
+    y = jnp.where(
+        label < -1.0, y_m2,
+        jnp.where(label < 0.0, y_m1, jnp.where(label < 1.0, y_01, y_12)),
+    )
+    return {"Y": [y]}
+
+
+@register("smooth_l1_loss")
+def lower_smooth_l1_loss(ctx, ins):
+    """reference smooth_l1_loss_op.h: d = inside_w*(x-y);
+    per-elem: 0.5*(sigma*d)^2 if |d|<1/sigma^2 else |d|-0.5/sigma^2;
+    Out = outside_w * row-sum."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = ctx.attr("sigma", 1.0)
+    iw = ins.get("InsideWeight", [None])[0]
+    ow = ins.get("OutsideWeight", [None])[0]
+    d = x - y
+    if iw is not None:
+        d = d * iw
+    s2 = sigma * sigma
+    ad = jnp.abs(d)
+    elem = jnp.where(ad < 1.0 / s2, 0.5 * s2 * jnp.square(d), ad - 0.5 / s2)
+    diff = elem.reshape(x.shape[0], -1)
+    out = jnp.sum(diff, axis=1, keepdims=True)
+    if ow is not None:
+        out = out * ow.reshape(out.shape)
+    return {"Diff": [d], "Out": [out]}
+
+
+@register("squared_l2_distance")
+def lower_squared_l2_distance(ctx, ins):
+    """reference squared_l2_distance_op.h: sub = x - y (y row-broadcast);
+    Out[i] = sum_j sub[i,j]^2."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    return {
+        "sub_result": [sub],
+        "Out": [jnp.sum(jnp.square(sub), axis=1, keepdims=True)],
+    }
+
+
+@register("cos_sim")
+def lower_cos_sim(ctx, ins):
+    """reference cos_sim_op.h: row-wise cosine similarity; Y may have one
+    row (broadcast)."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=1, keepdims=True))
+    prod = jnp.sum(x * y, axis=1, keepdims=True)
+    return {"Out": [prod / (xn * yn)], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register("l1_norm")
+def lower_l1_norm(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape((1,))]}
+
+
+# ---------------------------------------------------------------------------
+# Elementwise / activation extras
+# ---------------------------------------------------------------------------
+
+
+@register("selu")
+def lower_selu(ctx, ins):
+    """reference selu_op.cc (scale/alpha attrs, Klambauer et al. defaults)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    scale = ctx.attr("scale", 1.0507009873554805)
+    alpha = ctx.attr("alpha", 1.6732632423543772)
+    return {"Out": [scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))]}
+
+
+@register("sign")
+def lower_sign(ctx, ins):
+    jnp = _jnp()
+    return {"Out": [jnp.sign(ins["X"][0])]}
+
+
+@register("minus")
+def lower_minus(ctx, ins):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register("label_smooth")
+def lower_label_smooth(ctx, ins):
+    """reference label_smooth_op.h: out = (1-eps)*x + eps*prior (prior
+    defaults to uniform 1/num_classes)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    eps = ctx.attr("epsilon", 0.0)
+    prior = ins.get("PriorDist", [None])[0]
+    if prior is None:
+        out = (1.0 - eps) * x + eps / x.shape[-1]
+    else:
+        out = (1.0 - eps) * x + eps * prior.reshape((1,) * (x.ndim - 1) + (-1,))
+    return {"Out": [out]}
+
+
+@register("multiplex", no_grad=True)
+def lower_multiplex(ctx, ins):
+    """reference multiplex_op.cc: Out[i] = X[Ids[i]][i] — per-row select
+    among the N candidate tensors."""
+    jnp = _jnp()
+    ids = ins["Ids"][0].reshape(-1).astype("int32")
+    xs = jnp.stack(ins["X"], axis=0)  # [N, B, ...]
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": [xs[ids, rows]]}
+
+
+@register("affine_channel")
+def lower_affine_channel(ctx, ins):
+    """reference detection/affine_channel_op.cc: x*scale+bias per channel."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    layout = ctx.attr("data_layout", "NCHW")
+    shape = (
+        (1, -1) + (1,) * (x.ndim - 2) if layout == "NCHW" else
+        (1,) * (x.ndim - 1) + (-1,)
+    )
+    return {"Out": [x * scale.reshape(shape) + bias.reshape(shape)]}
+
+
+@register("data_norm")
+def lower_data_norm(ctx, ins):
+    """reference data_norm_op.cc: normalize with accumulated batch
+    statistics (size/sum/square-sum); outputs updated accumulators —
+    the executor writes them back like batch_norm's running stats."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    bsize = ins["BatchSize"][0]
+    bsum = ins["BatchSum"][0]
+    bsq = ins["BatchSquareSum"][0]
+    eps = ctx.attr("epsilon", 1e-4)
+    mean = bsum / bsize
+    scale = jnp.sqrt(bsize / (bsq - bsum * mean + eps * bsize))
+    y = (x - mean.reshape(1, -1)) * scale.reshape(1, -1)
+    import jax
+
+    n = x.shape[0]
+    xs = jax.lax.stop_gradient(x)
+    return {
+        "Y": [y],
+        "Means": [mean],
+        "Scales": [scale],
+        "BatchSizeOut": [bsize + n],
+        "BatchSumOut": [bsum + jnp.sum(xs, axis=0)],
+        "BatchSquareSumOut": [bsq + jnp.sum(jnp.square(xs), axis=0)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Tensor/shape utilities
+# ---------------------------------------------------------------------------
+
+
+@register("fill", no_grad=True)
+def lower_fill(ctx, ins):
+    jnp = _jnp()
+    shape = ctx.attr("shape")
+    value = np.asarray(ctx.attr("value"), dtype="float32")
+    dtype = ctx.attr("dtype", "float32")
+    return {"Out": [jnp.asarray(value.reshape(shape)).astype(dtype)]}
+
+
+@register("fill_constant_batch_size_like", no_grad=True)
+def lower_fill_constant_batch_size_like(ctx, ins):
+    """reference fill_constant_batch_size_like_op.cc: like fill_constant but
+    one dim copies the batch size of Input."""
+    jnp = _jnp()
+    x = ins["Input"][0]
+    shape = list(ctx.attr("shape"))
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    dtype = ctx.attr("dtype", "float32")
+    val = ctx.attr("value", 0.0)
+    return {"Out": [jnp.full(tuple(shape), val, dtype)]}
+
+
+@register("crop")
+def lower_crop(ctx, ins):
+    """reference crop_op.cc: crop X to `shape` starting at `offsets`
+    (offsets via attr or input tensor — static attr form here)."""
+    import jax
+
+    x = ins["X"][0]
+    y = ins.get("Y", [None])[0]
+    shape = tuple(ctx.attr("shape") or y.shape)
+    offs = ins.get("Offsets", [None])[0]
+    if offs is not None:
+        offsets = tuple(int(v) for v in np.asarray(offs).reshape(-1))
+    else:
+        offsets = tuple(ctx.attr("offsets") or (0,) * x.ndim)
+    return {"Out": [jax.lax.dynamic_slice(x, offsets, shape)]}
+
+
+@register("is_empty", no_grad=True)
+def lower_is_empty(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    return {"Out": [jnp.asarray(int(np.prod(x.shape)) == 0).reshape((1,))]}
+
+
+@register("mean_iou", no_grad=True)
+def lower_mean_iou(ctx, ins):
+    """reference mean_iou_op.h: mean IoU over classes via confusion
+    counts."""
+    jnp = _jnp()
+    pred = ins["Predictions"][0].reshape(-1).astype("int32")
+    label = ins["Labels"][0].reshape(-1).astype("int32")
+    n = ctx.attr("num_classes")
+    idx = label * n + pred
+    cm = jnp.zeros((n * n,), "int64").at[idx].add(1).reshape(n, n)
+    inter = jnp.diagonal(cm).astype("float32")
+    union = (
+        jnp.sum(cm, axis=0) + jnp.sum(cm, axis=1)
+    ).astype("float32") - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype("float32")), 1.0)
+    return {
+        "OutMeanIou": [mean.reshape(())],
+        "OutWrong": [(jnp.sum(cm, axis=1).astype("int32") - inter.astype("int32"))],
+        "OutCorrect": [inter.astype("int32")],
+    }
+
+
+@register("fsp")
+def lower_fsp(ctx, ins):
+    """reference fsp_op.cc (distillation): G = (1/HW) * X_flat @ Y_flat^T
+    per sample — [N, C1, C2]."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    n, c1, h, w = x.shape
+    c2 = y.shape[1]
+    xf = x.reshape(n, c1, h * w)
+    yf = y.reshape(n, c2, h * w)
+    return {"Out": [xf @ yf.transpose(0, 2, 1) / (h * w)]}
+
+
+@register("conv_shift")
+def lower_conv_shift(ctx, ins):
+    """reference conv_shift_op.cc: circular correlation
+    out[i, j] = sum_k x[i, (j+k-M/2) mod W] * y[i, k]."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    b, w = x.shape
+    m = y.shape[1]
+    half = m // 2
+    js = jnp.arange(w)[:, None]
+    ks = jnp.arange(m)[None, :]
+    idx = (js + ks - half) % w  # [W, M]
+    gathered = x[:, idx]  # [B, W, M]
+    return {"Out": [jnp.einsum("bwm,bm->bw", gathered, y)]}
+
+
+@register("bilinear_tensor_product")
+def lower_bilinear_tensor_product(ctx, ins):
+    """reference bilinear_tensor_product_op.h:
+    out[:, k] = sum_ij x_i W[k]_ij y_j (+ bias)."""
+    jnp = _jnp()
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    bias = ins.get("Bias", [None])[0]
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return {"Out": [out]}
+
+
+@register("add_position_encoding")
+def lower_add_position_encoding(ctx, ins):
+    """reference add_position_encoding_op.h: out = alpha*x + beta*sinusoid
+    position table."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    alpha = ctx.attr("alpha", 1.0)
+    beta = ctx.attr("beta", 1.0)
+    b, t, d = x.shape
+    pos = np.arange(t, dtype="float32")[:, None]
+    dim = np.arange(d // 2, dtype="float32")[None, :]
+    div = np.power(10000.0, 2.0 * dim / d)
+    enc = np.zeros((t, d), "float32")
+    enc[:, 0::2] = np.sin(pos / div)
+    enc[:, 1::2] = np.cos(pos / div)
+    return {"Out": [alpha * x + beta * jnp.asarray(enc)[None]]}
+
+
+@register("similarity_focus", no_grad=True)
+def lower_similarity_focus(ctx, ins):
+    """reference similarity_focus_op.h: for each (indexed channel), build a
+    binary mask marking max positions row/col-wise; union over indices."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    axis = ctx.attr("axis", 1)
+    indexes = ctx.attr("indexes")
+    n, c, h, w = x.shape
+    assert axis == 1, "similarity_focus: only axis=1 (channel) supported"
+    mask = jnp.zeros_like(x)
+    for idx in indexes:
+        ch = x[:, idx]  # [N, H, W]
+        row_max = (ch == jnp.max(ch, axis=2, keepdims=True))
+        col_max = (ch == jnp.max(ch, axis=1, keepdims=True))
+        m = (row_max | col_max).astype(x.dtype)[:, None]  # [N,1,H,W]
+        mask = jnp.maximum(mask, jnp.broadcast_to(m, mask.shape))
+    return {"Out": [mask]}
+
+
+@register("get_tensor_from_selected_rows", no_grad=True)
+def lower_get_tensor_from_selected_rows(ctx, ins):
+    """reference get_tensor_from_selected_rows_op.cc: rows as a dense
+    [K, D] tensor."""
+    x = ins["X"][0]
+    from ..core.selected_rows import SelectedRows
+
+    if isinstance(x, SelectedRows):
+        return {"Out": [x.rows]}
+    return {"Out": [x]}
+
+
+@register("merge_selected_rows", no_grad=True)
+def lower_merge_selected_rows(ctx, ins):
+    """reference merge_selected_rows_op.cc (MergeAdd)."""
+    from ..core.selected_rows import SelectedRows
+
+    x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        uids, mrows = x.merged()
+        return {"Out": [SelectedRows(uids, mrows, x.height)]}
+    return {"Out": [x]}
+
+
+@register("shard_index", no_grad=True)
+def lower_shard_index(ctx, ins):
+    """shard_index_op: map global ids to shard-local (or ignore value)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    index_num = ctx.attr("index_num")
+    nshards = ctx.attr("nshards")
+    shard_id = ctx.attr("shard_id")
+    ignore_value = ctx.attr("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return {"Out": [jnp.where(in_shard, x % shard_size, ignore_value)]}
+
+
+@register("unpool")
+def lower_unpool(ctx, ins):
+    """reference unpool_op.cc: max-unpool using saved indices (flat within
+    each [H*W] map)."""
+    jnp = _jnp()
+    x, idx = ins["X"][0], ins["Indices"][0]
+    n, c, h, w = x.shape
+    oh, ow = ctx.attr("ksize")[0] * h, ctx.attr("ksize")[1] * w
+    # output size from attrs if the layer recorded it
+    if ctx.attr("output_size"):
+        oh, ow = ctx.attr("output_size")
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx2 = idx.reshape(n, c, h * w).astype("int32")
+    vals = x.reshape(n, c, h * w)
+    out = flat.at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None], idx2
+    ].add(vals)
+    return {"Out": [out.reshape(n, c, oh, ow)]}
