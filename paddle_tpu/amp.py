@@ -135,6 +135,20 @@ def _cast_value(v, dtype):
     return v
 
 
+# Multi-input elementwise ops follow their activations: if any float input is
+# already bf16, cast the rest down instead of promoting the bf16 side to fp32
+# (an fp32 bias would otherwise drag every post-matmul activation back to
+# fp32, forfeiting the bf16 memory/fusion win on matmul-heavy chains).
+GRAY_FOLLOW_OPS = frozenset({
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+})
+
+
 def apply_cast_policy(op_type: str, ins: dict) -> dict:
     """Cast the float inputs of one op per the autocast policy.  Grad ops
     (`X_grad`) inherit X's policy so forward and backward agree."""
@@ -145,6 +159,15 @@ def apply_cast_policy(op_type: str, ins: dict) -> dict:
         target = jnp.bfloat16
     elif base in BLACK_OPS:
         target = jnp.float32
+    elif base in GRAY_FOLLOW_OPS:
+        if any(
+            getattr(v, "dtype", None) == jnp.bfloat16
+            for vals in ins.values()
+            for v in vals
+        ):
+            target = jnp.bfloat16
+        else:
+            return ins
     else:
         return ins
     return {

@@ -164,7 +164,7 @@ class TraceContext:
     """Per-trace state handed to lowerings via LowerContext."""
 
     def __init__(self, program: fw.Program, base_key, is_test: bool = False,
-                 mesh=None):
+                 mesh=None, check_nan_inf: bool = False):
         self.program = program
         self.base_key = base_key  # traced jax PRNG key (runtime arg)
         self.is_test = is_test
@@ -172,6 +172,11 @@ class TraceContext:
         self._rng_counter = 0
         self.has_random = False
         self.amp_bf16 = bool(getattr(program, "_amp_bf16", False))
+        # debug mode (reference FLAGS_check_nan_inf, operator.cc:943): record
+        # one all-finite flag per op; the executor checks them on the host
+        # after the step and names the first offending op
+        self.check_nan_inf = check_nan_inf
+        self.nan_checks: List[Tuple[str, Any]] = []
 
     def next_rng_key(self, op=None):
         import jax
@@ -214,7 +219,32 @@ def trace_block(block: fw.Block, env: Dict[str, Any], tctx: TraceContext):
             for name, val in zip(names, vals):
                 if name and val is not None:
                     env[name] = val
+        if tctx.check_nan_inf and outs:
+            flag = _all_finite_flag(outs)
+            if flag is not None:
+                tctx.nan_checks.append((repr(op), flag))
     return env
+
+
+def _all_finite_flag(outs):
+    """Scalar bool: every inexact-float leaf in an op's outputs is finite."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [
+        leaf
+        for vals in outs.values()
+        for v in vals
+        if v is not None
+        for leaf in jax.tree_util.tree_leaves(v)
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact)
+    ]
+    if not leaves:
+        return None
+    flag = jnp.bool_(True)
+    for leaf in leaves:
+        flag = jnp.logical_and(flag, jnp.isfinite(leaf).all())
+    return flag
 
 
 # ---------------------------------------------------------------------------
@@ -286,21 +316,37 @@ class _CompiledEntry:
       state_writes — all written names, in output order
     """
 
-    __slots__ = ("fn", "rw_state", "ro_state", "state_writes", "needs_key")
+    __slots__ = ("fn", "rw_state", "ro_state", "state_writes", "needs_key",
+                 "nan_check_ops")
 
-    def __init__(self, fn, rw_state, ro_state, state_writes, needs_key):
+    def __init__(self, fn, rw_state, ro_state, state_writes, needs_key,
+                 nan_check_ops=None):
         self.fn = fn
         self.rw_state = rw_state
         self.ro_state = ro_state
         self.state_writes = state_writes
         self.needs_key = needs_key
+        # op descriptions for check_nan_inf mode (parallel to the extra flag
+        # outputs of fn); None when the mode is off.  The list is filled in
+        # during the first trace of fn.
+        self.nan_check_ops = nan_check_ops
 
 
 class Executor:
-    def __init__(self, place: Optional[Place] = None):
+    def __init__(self, place: Optional[Place] = None,
+                 check_nan_inf: Optional[bool] = None):
+        import os
+
         self.place = place or default_place()
         self._cache: Dict[Any, _CompiledEntry] = {}
         self._run_counter = 0
+        # debug mode, parity with the reference's FLAGS_check_nan_inf
+        # (operator.cc:943): validate every op's outputs are finite
+        if check_nan_inf is None:
+            check_nan_inf = os.environ.get("FLAGS_check_nan_inf", "") in (
+                "1", "true", "True",
+            )
+        self.check_nan_inf = check_nan_inf
 
     def close(self):
         self._cache.clear()
@@ -335,6 +381,8 @@ class Executor:
             program.fingerprint(),
             bool(getattr(program, "_amp_bf16", False)),
             bool(getattr(program, "_is_test", False)),
+            bool(self.check_nan_inf),
+            self._scope_signature(program, feed_names, scope),
             tuple(feed_names),
             tuple(
                 (np.asarray(feed[n]).shape, str(np.asarray(feed[n]).dtype))
@@ -361,9 +409,23 @@ class Executor:
         if entry.needs_key:
             seed = program.random_seed or 0
             key_arr = jax.random.fold_in(prng_key(seed), self._run_counter)
-            fetches, new_state = entry.fn(feed_vals, rw_vals, ro_vals, key_arr)
+            result = entry.fn(feed_vals, rw_vals, ro_vals, key_arr)
         else:
-            fetches, new_state = entry.fn(feed_vals, rw_vals, ro_vals)
+            result = entry.fn(feed_vals, rw_vals, ro_vals)
+        if entry.nan_check_ops is not None:
+            fetches, new_state, nan_flags = result
+            bad = [
+                desc
+                for desc, ok in zip(entry.nan_check_ops, np.asarray(nan_flags))
+                if not ok
+            ]
+            if bad:
+                raise FloatingPointError(
+                    "check_nan_inf: non-finite output from op(s):\n  "
+                    + "\n  ".join(bad)
+                )
+        else:
+            fetches, new_state = result
 
         for n, v in zip(entry.state_writes, new_state):
             scope.set_var(n, v)
@@ -420,6 +482,8 @@ class Executor:
             "run_steps",
             program.fingerprint(),
             bool(getattr(program, "_amp_bf16", False)),
+            bool(getattr(program, "_is_test", False)),
+            self._scope_signature(program, feed_names, scope),
             steps,
             tuple(feed_names),
             tuple(
@@ -512,6 +576,23 @@ class Executor:
         )
 
     # -- internals -------------------------------------------------------
+    def _scope_signature(self, program, feed_names, scope) -> frozenset:
+        """Which program-referenced names resolve to a live scope var.
+
+        analyze_block_io's rw/ro state split depends on scope contents at
+        compile time, so the cache key must too — otherwise running the same
+        program against a differently-populated scope reuses an executable
+        with the wrong state split."""
+        feed_set = set(feed_names)
+        sig = set()
+        for blk in program.blocks:
+            for op in blk.ops:
+                for n in op.input_arg_names() + op.output_arg_names():
+                    if n and n not in feed_set and n not in sig:
+                        if scope.has_var(n) and scope.find_var(n) is not None:
+                            sig.add(n)
+        return frozenset(sig)
+
     def _to_device_array(self, program, name, value):
         import jax
         import jax.numpy as jnp
@@ -543,11 +624,15 @@ class Executor:
         rw_state = [n for n in state_reads if n in write_set]
         ro_state = [n for n in state_reads if n not in write_set]
 
+        check = self.check_nan_inf
+        nan_check_ops: List[str] = []
+
         def run_fn(feed_vals, rw_vals, ro_vals, key=None):
             if key is None:
                 key = prng_key(program.random_seed or 0)
             tctx = TraceContext(
-                program, key, is_test=getattr(program, "_is_test", False)
+                program, key, is_test=getattr(program, "_is_test", False),
+                check_nan_inf=check,
             )
             env: Dict[str, Any] = {}
             for n, v in zip(feed_names, feed_vals):
@@ -565,6 +650,15 @@ class Executor:
                     )
                 fetches.append(env[n])
             new_state = [env.get(n) for n in state_writes]
+            if check:
+                nan_check_ops.clear()
+                nan_check_ops.extend(d for d, _ in tctx.nan_checks)
+                import jax.numpy as jnp
+
+                flags = jnp.stack(
+                    [f for _, f in tctx.nan_checks]
+                ) if tctx.nan_checks else jnp.ones((0,), bool)
+                return fetches, new_state, flags
             return fetches, new_state
 
         if probe_random:
@@ -573,7 +667,10 @@ class Executor:
             jitted = jax.jit(
                 lambda f, rw, ro: run_fn(f, rw, ro), donate_argnums=(1,)
             )
-        return _CompiledEntry(jitted, rw_state, ro_state, state_writes, probe_random)
+        return _CompiledEntry(
+            jitted, rw_state, ro_state, state_writes, probe_random,
+            nan_check_ops=nan_check_ops if check else None,
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -253,10 +253,28 @@ class Operator:
         if opdef is not None and opdef.infer_shape is not None:
             try:
                 opdef.infer_shape(InferShapeContext(self))
-            except Exception:
-                # Runtime lowering will catch real shape errors with good
-                # messages; build-time inference is best-effort.
-                pass
+            except Exception as e:
+                # Best-effort when shapes are genuinely unknown (a None input
+                # shape legitimately trips inference); a failure with fully
+                # known input shapes is a real graph bug — surface it at the
+                # build site with op context instead of as a late XLA trace
+                # error (reference: operator.cc RuntimeInferShape ENFORCE).
+                shapes = {}
+                all_known = True
+                for slot, names in self.inputs.items():
+                    for n in names:
+                        if not n:
+                            continue
+                        v = block._find_var_recursive(n)
+                        s = v.shape if v is not None else None
+                        shapes[n] = s
+                        if s is None:
+                            all_known = False
+                if all_known and shapes:
+                    raise ValueError(
+                        f"infer_shape failed for op {type!r} "
+                        f"(input shapes: {shapes}): {e}"
+                    ) from e
 
     # -- slot access -----------------------------------------------------
     def input(self, slot: str) -> List[str]:
@@ -515,11 +533,15 @@ class Program:
                 # reference clone(for_test=True) drops backward/optimize/
                 # lr-sched ops (framework.py Program.clone + _inference_
                 # optimize): an eval program must not update parameters
+                # roles are bit flags (a loss-grad fill_constant is
+                # Backward|Loss): mask-test like the reference's
+                # op_role & (Backward|Optimize) checks, don't compare exactly
+                drop_mask = OpRole.Backward | OpRole.Optimize | OpRole.LRSched
                 blk.ops = [
                     op for op in blk.ops
                     if not (
-                        op.attrs.get(OpRole.ROLE_ATTR_NAME, OpRole.Forward)
-                        in (OpRole.Backward, OpRole.Optimize, OpRole.LRSched)
+                        int(op.attrs.get(OpRole.ROLE_ATTR_NAME, OpRole.Forward))
+                        & drop_mask
                         or op.type.endswith("_grad")
                     )
                 ]
@@ -534,19 +556,37 @@ class Program:
         p = self.clone()
         blk = p.global_block()
         needed = set(targets)
+
+        def _sub_block_reads(op, seen=None):
+            """All names read anywhere inside an op's sub-blocks (while /
+            conditional_block bodies) — those vars must survive the slice
+            even though the parent op doesn't list them as inputs."""
+            seen = seen if seen is not None else set()
+            reads = set()
+            for a in op.attrs.values():
+                if isinstance(a, Block) and a.idx not in seen:
+                    seen.add(a.idx)
+                    for sub_op in a.ops:
+                        reads.update(sub_op.input_arg_names())
+                        reads.update(_sub_block_reads(sub_op, seen))
+            return reads
+
         kept = []
         for op in reversed(blk.ops):
             if any(o in needed for o in op.output_arg_names()):
                 kept.append(op)
                 needed.update(op.input_arg_names())
+                needed.update(_sub_block_reads(op))
         blk.ops = list(reversed(kept))
         p._fp_cache = None
         p._mod_count += 1
-        # drop unreferenced non-persistable vars
+        # drop unreferenced non-persistable vars (sub-block reads count:
+        # a global-block var consumed only inside a while body stays)
         referenced = set()
         for op in blk.ops:
             referenced.update(op.input_arg_names())
             referenced.update(op.output_arg_names())
+            referenced.update(_sub_block_reads(op))
         blk.vars = collections.OrderedDict(
             (n, v)
             for n, v in blk.vars.items()

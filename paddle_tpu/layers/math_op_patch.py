@@ -1,0 +1,101 @@
+"""Arithmetic operator overloads on Variable.
+
+Capability parity with the reference's math_op_patch
+(python/paddle/fluid/layers/math_op_patch.py:25 monkey_patch_variable):
+`a + b`, `a - 2.0`, `-a`, `a < b` ... on graph Variables build the
+corresponding elementwise / scale / compare ops.  Scalars fold into a
+`scale` op (one fused XLA op) rather than materializing a constant tensor.
+"""
+
+from __future__ import annotations
+
+from ..core import framework as fw
+from ..layer_helper import LayerHelper
+
+
+def _create_tensor_from_scalar(block, value, dtype, shape):
+    helper = LayerHelper("fill_constant")
+    out = helper.create_tmp_variable(dtype=dtype)
+    block.append_op(
+        "fill_constant",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": dtype, "value": float(value)},
+    )
+    out.shape = tuple(shape)
+    return out
+
+
+def _elementwise(op_type, x, y, reverse=False):
+    block = x.block
+    if isinstance(y, (int, float)):
+        # scalar fast paths that fold into ONE scale op
+        if not reverse and op_type == "elementwise_add":
+            return _scale(x, 1.0, float(y))
+        if not reverse and op_type == "elementwise_sub":
+            return _scale(x, 1.0, -float(y))
+        if reverse and op_type == "elementwise_sub":
+            return _scale(x, -1.0, float(y))
+        if op_type == "elementwise_mul":
+            return _scale(x, float(y), 0.0)
+        if not reverse and op_type == "elementwise_div":
+            return _scale(x, 1.0 / float(y), 0.0)
+        y = _create_tensor_from_scalar(block, y, x.dtype, (1,))
+    if reverse:
+        x, y = y, x
+    helper = LayerHelper(op_type)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    block.append_op(
+        op_type,
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"axis": -1},
+    )
+    return out
+
+
+def _scale(x, scale, bias):
+    helper = LayerHelper("scale")
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    x.block.append_op(
+        "scale",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale), "bias": float(bias),
+               "bias_after_scale": True},
+    )
+    return out
+
+
+def _compare(op_type, x, y):
+    block = x.block
+    if isinstance(y, (int, float)):
+        y = _create_tensor_from_scalar(block, y, x.dtype, (1,))
+    helper = LayerHelper(op_type)
+    out = helper.create_tmp_variable(dtype="bool")
+    block.append_op(
+        op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def monkey_patch_variable():
+    V = fw.Variable
+    V.__add__ = lambda s, o: _elementwise("elementwise_add", s, o)
+    V.__radd__ = lambda s, o: _elementwise("elementwise_add", s, o)
+    V.__sub__ = lambda s, o: _elementwise("elementwise_sub", s, o)
+    V.__rsub__ = lambda s, o: _elementwise("elementwise_sub", s, o, reverse=True)
+    V.__mul__ = lambda s, o: _elementwise("elementwise_mul", s, o)
+    V.__rmul__ = lambda s, o: _elementwise("elementwise_mul", s, o)
+    V.__truediv__ = lambda s, o: _elementwise("elementwise_div", s, o)
+    V.__rtruediv__ = lambda s, o: _elementwise("elementwise_div", s, o, reverse=True)
+    V.__pow__ = lambda s, o: _elementwise("elementwise_pow", s, o)
+    V.__neg__ = lambda s: _scale(s, -1.0, 0.0)
+    V.__lt__ = lambda s, o: _compare("less_than", s, o)
+    V.__le__ = lambda s, o: _compare("less_equal", s, o)
+    V.__gt__ = lambda s, o: _compare("greater_than", s, o)
+    V.__ge__ = lambda s, o: _compare("greater_equal", s, o)
+    # NB: __eq__/__ne__ stay identity-based — Variables are dict keys
+    # throughout the framework (same trade-off as the reference).
+
+
+monkey_patch_variable()

@@ -202,6 +202,10 @@ def _matmul_infer(ctx):
         xs[-1], xs[-2] = xs[-2], xs[-1]
     if ty:
         ys[-1], ys[-2] = ys[-2], ys[-1]
+    if xs[-1] != ys[-2]:
+        raise ValueError(
+            f"matmul contraction dims mismatch: X{tuple(xs)} @ Y{tuple(ys)}"
+        )
     batch = xs[:-2] if len(xs) > len(ys) else ys[:-2]
     ctx.set_output("Out", tuple(batch) + (xs[-2], ys[-1]), ctx.input_dtype("X"))
 

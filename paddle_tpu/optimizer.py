@@ -344,7 +344,6 @@ class AdamaxOptimizer(Optimizer):
                 "beta1": self._beta1,
                 "beta2": self._beta2,
                 "epsilon": self._epsilon,
-                "lazy_mode": self._lazy_mode,
                 fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Optimize,
             },
         )
