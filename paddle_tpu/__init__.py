@@ -36,6 +36,7 @@ from .core.executor import (  # noqa: F401
     Executor,
     Scope,
     global_scope,
+    scope_guard,
     CPUPlace,
     TPUPlace,
     Place,

@@ -136,6 +136,22 @@ def global_scope() -> Scope:
     return _global_scope
 
 
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """Swap the global scope within a `with` block (reference:
+    python/paddle/fluid/executor.py scope_guard) — lets user code isolate
+    parameter state, e.g. train vs. loaded-inference scopes."""
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield scope
+    finally:
+        _global_scope = prev
+
+
 # ---------------------------------------------------------------------------
 # PRNG keys
 # ---------------------------------------------------------------------------
@@ -339,6 +355,7 @@ class Executor:
 
         self.place = place or default_place()
         self._cache: Dict[Any, _CompiledEntry] = {}
+        self._ref_names_cache: Dict[Any, tuple] = {}
         self._run_counter = 0
         # debug mode, parity with the reference's FLAGS_check_nan_inf
         # (operator.cc:943): validate every op's outputs are finite
@@ -414,6 +431,17 @@ class Executor:
             result = entry.fn(feed_vals, rw_vals, ro_vals)
         if entry.nan_check_ops is not None:
             fetches, new_state, nan_flags = result
+        else:
+            fetches, new_state = result
+            nan_flags = None
+
+        # Write state back BEFORE any nan/inf raise: the rw buffers were
+        # donated to the executable, so skipping this would leave the scope
+        # holding deleted arrays and poison every subsequent run.
+        for n, v in zip(entry.state_writes, new_state):
+            scope.set_var(n, v)
+
+        if nan_flags is not None:
             bad = [
                 desc
                 for desc, ok in zip(entry.nan_check_ops, np.asarray(nan_flags))
@@ -424,11 +452,6 @@ class Executor:
                     "check_nan_inf: non-finite output from op(s):\n  "
                     + "\n  ".join(bad)
                 )
-        else:
-            fetches, new_state = result
-
-        for n, v in zip(entry.state_writes, new_state):
-            scope.set_var(n, v)
 
         if return_numpy:
             return [np.asarray(v) for v in fetches]
@@ -483,6 +506,7 @@ class Executor:
             program.fingerprint(),
             bool(getattr(program, "_amp_bf16", False)),
             bool(getattr(program, "_is_test", False)),
+            bool(self.check_nan_inf),
             self._scope_signature(program, feed_names, scope),
             steps,
             tuple(feed_names),
@@ -510,9 +534,29 @@ class Executor:
         base_key = jax.random.fold_in(
             prng_key(seed), self._run_counter
         )
-        fetches, new_state = entry.fn(feed_vals, rw_vals, ro_vals, base_key)
+        result = entry.fn(feed_vals, rw_vals, ro_vals, base_key)
+        if entry.nan_check_ops is not None:
+            fetches, new_state, nan_flags = result
+        else:
+            fetches, new_state = result
+            nan_flags = None
+        # state write-back must precede any nan/inf raise (donated buffers)
         for n, v in zip(entry.state_writes, new_state):
             scope.set_var(n, v)
+        if nan_flags is not None:
+            per_op = np.asarray(nan_flags)
+            if per_op.ndim == 2:  # [steps, n_ops] -> op is bad if ANY step was
+                per_op = per_op.all(axis=0)
+            bad = [
+                desc
+                for desc, ok in zip(entry.nan_check_ops, per_op)
+                if not ok
+            ]
+            if bad:
+                raise FloatingPointError(
+                    "check_nan_inf: non-finite output from op(s):\n  "
+                    + "\n  ".join(bad)
+                )
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
@@ -530,6 +574,9 @@ class Executor:
         # step's outputs rather than carried through the scan
         wo_state = [n for n in state_writes if n not in set(rw_state)]
 
+        check = self.check_nan_inf
+        nan_check_ops: List[str] = []
+
         def scan_fn(feed_vals, rw_vals, ro_vals, base_key):
             def body(carry, xs):
                 rw, i = carry, xs[0]
@@ -538,6 +585,7 @@ class Executor:
                     program,
                     jax.random.fold_in(base_key, i),
                     is_test=getattr(program, "_is_test", False),
+                    check_nan_inf=check,
                 )
                 env: Dict[str, Any] = {}
                 env.update(zip(feed_names, per_step))
@@ -553,12 +601,23 @@ class Executor:
                         )
                     fetches.append(env[n])
                 wo = [env.get(n) for n in wo_state]
+                if check:
+                    nan_check_ops.clear()
+                    nan_check_ops.extend(d for d, _ in tctx.nan_checks)
+                    flags = (
+                        jnp.stack([f for _, f in tctx.nan_checks])
+                        if tctx.nan_checks
+                        else jnp.ones((0,), bool)
+                    )
+                    return new_rw, (fetches, wo, flags)
                 return new_rw, (fetches, wo)
 
             xs = (jnp.arange(steps), feed_vals)
-            final_rw, (stacked, wo_stacked) = jax.lax.scan(
-                body, list(rw_vals), xs
-            )
+            final_rw, step_outs = jax.lax.scan(body, list(rw_vals), xs)
+            if check:
+                stacked, wo_stacked, flag_stack = step_outs
+            else:
+                stacked, wo_stacked = step_outs
             # state ordering matches state_writes: rw carries final values,
             # write-only vars take their last-step value
             by_name = dict(zip(rw_state, final_rw))
@@ -567,12 +626,15 @@ class Executor:
                  for n, v in zip(wo_state, wo_stacked)}
             )
             new_state = [by_name.get(n) for n in state_writes]
+            if check:
+                return stacked, new_state, flag_stack
             return stacked, new_state
 
         jitted = jax.jit(scan_fn, donate_argnums=(1,))
         return _CompiledEntry(
             lambda f, rw, ro, key: jitted(f, rw, ro, key),
             rw_state, ro_state, state_writes, True,
+            nan_check_ops=nan_check_ops if check else None,
         )
 
     # -- internals -------------------------------------------------------
@@ -583,15 +645,26 @@ class Executor:
         compile time, so the cache key must too — otherwise running the same
         program against a differently-populated scope reuses an executable
         with the wrong state split."""
+        # The referenced-name walk is O(program size); memoize it on the
+        # program fingerprint so the per-step cost is one scope probe per
+        # distinct name, not a full block/op traversal.
+        fp = program.fingerprint()
+        names = self._ref_names_cache.get(fp)
+        if names is None:
+            seen = set()
+            for blk in program.blocks:
+                for op in blk.ops:
+                    for n in op.input_arg_names() + op.output_arg_names():
+                        if n:
+                            seen.add(n)
+            names = tuple(seen)
+            self._ref_names_cache[fp] = names
         feed_set = set(feed_names)
-        sig = set()
-        for blk in program.blocks:
-            for op in blk.ops:
-                for n in op.input_arg_names() + op.output_arg_names():
-                    if n and n not in feed_set and n not in sig:
-                        if scope.has_var(n) and scope.find_var(n) is not None:
-                            sig.add(n)
-        return frozenset(sig)
+        return frozenset(
+            n
+            for n in names
+            if n not in feed_set and scope.find_var(n) is not None
+        )
 
     def _to_device_array(self, program, name, value):
         import jax

@@ -572,11 +572,14 @@ class Program:
             return reads
 
         kept = []
+        sub_reads_cache = {}
         for op in reversed(blk.ops):
             if any(o in needed for o in op.output_arg_names()):
                 kept.append(op)
                 needed.update(op.input_arg_names())
-                needed.update(_sub_block_reads(op))
+                reads = _sub_block_reads(op)
+                sub_reads_cache[id(op)] = reads
+                needed.update(reads)
         blk.ops = list(reversed(kept))
         p._fp_cache = None
         p._mod_count += 1
@@ -586,7 +589,7 @@ class Program:
         for op in blk.ops:
             referenced.update(op.input_arg_names())
             referenced.update(op.output_arg_names())
-            referenced.update(_sub_block_reads(op))
+            referenced.update(sub_reads_cache[id(op)])
         blk.vars = collections.OrderedDict(
             (n, v)
             for n, v in blk.vars.items()

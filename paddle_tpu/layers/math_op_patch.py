@@ -5,45 +5,83 @@ Capability parity with the reference's math_op_patch
 `a + b`, `a - 2.0`, `-a`, `a < b` ... on graph Variables build the
 corresponding elementwise / scale / compare ops.  Scalars fold into a
 `scale` op (one fused XLA op) rather than materializing a constant tensor.
+
+Ops are appended to the *current* block of the variable's program (not the
+variable's defining block): arithmetic on an outer-block var inside a
+While/conditional body must land in the body block, exactly as LayerHelper
+does for every other layer.
 """
 
 from __future__ import annotations
 
 from ..core import framework as fw
-from ..layer_helper import LayerHelper
+
+
+def _current_block(x):
+    return x.block.program.current_block()
+
+
+def _tmp_var(block, dtype, shape=None):
+    v = block.create_var(
+        name=fw.unique_name("_math_op.tmp"), dtype=dtype
+    )
+    if shape is not None:
+        v.shape = tuple(shape)
+    return v
 
 
 def _create_tensor_from_scalar(block, value, dtype, shape):
-    helper = LayerHelper("fill_constant")
-    out = helper.create_tmp_variable(dtype=dtype)
+    out = _tmp_var(block, dtype, shape)
     block.append_op(
         "fill_constant",
         outputs={"Out": [out]},
         attrs={"shape": list(shape), "dtype": dtype, "value": float(value)},
     )
-    out.shape = tuple(shape)
     return out
 
 
 def _elementwise(op_type, x, y, reverse=False):
-    block = x.block
+    block = _current_block(x)
     if isinstance(y, (int, float)):
-        # scalar fast paths that fold into ONE scale op
-        if not reverse and op_type == "elementwise_add":
+        # scalar fast paths that fold into ONE scale op (shape == x.shape,
+        # so build-time shape inference stays exact even on the reverse
+        # paths — no (1,)-shaped constant ever takes the X slot)
+        if op_type == "elementwise_add":
             return _scale(x, 1.0, float(y))
-        if not reverse and op_type == "elementwise_sub":
+        if op_type == "elementwise_sub":
+            if reverse:
+                return _scale(x, -1.0, float(y))
             return _scale(x, 1.0, -float(y))
-        if reverse and op_type == "elementwise_sub":
-            return _scale(x, -1.0, float(y))
         if op_type == "elementwise_mul":
             return _scale(x, float(y), 0.0)
-        if not reverse and op_type == "elementwise_div":
+        if op_type == "elementwise_div":
+            if reverse:
+                # y / x = y * reciprocal(x)
+                rec = _tmp_var(block, x.dtype, x.shape)
+                block.append_op(
+                    "reciprocal", inputs={"X": [x]}, outputs={"Out": [rec]}
+                )
+                return _scale(rec, float(y), 0.0)
             return _scale(x, 1.0 / float(y), 0.0)
+        if reverse and op_type == "elementwise_pow":
+            # scalar ** x = exp(x * ln(scalar)); keeps x's shape exact and
+            # avoids a (1,)-shaped constant in the X slot
+            import math
+
+            if y <= 0:
+                raise ValueError(
+                    f"scalar ** Variable requires a positive base, got {y}"
+                )
+            scaled = _scale(x, math.log(float(y)), 0.0)
+            out = _tmp_var(block, x.dtype, x.shape)
+            block.append_op(
+                "exp", inputs={"X": [scaled]}, outputs={"Out": [out]}
+            )
+            return out
         y = _create_tensor_from_scalar(block, y, x.dtype, (1,))
     if reverse:
         x, y = y, x
-    helper = LayerHelper(op_type)
-    out = helper.create_tmp_variable(dtype=x.dtype)
+    out = _tmp_var(block, x.dtype)
     block.append_op(
         op_type,
         inputs={"X": [x], "Y": [y]},
@@ -54,9 +92,9 @@ def _elementwise(op_type, x, y, reverse=False):
 
 
 def _scale(x, scale, bias):
-    helper = LayerHelper("scale")
-    out = helper.create_tmp_variable(dtype=x.dtype)
-    x.block.append_op(
+    block = _current_block(x)
+    out = _tmp_var(block, x.dtype, x.shape)
+    block.append_op(
         "scale",
         inputs={"X": [x]},
         outputs={"Out": [out]},
@@ -67,11 +105,20 @@ def _scale(x, scale, bias):
 
 
 def _compare(op_type, x, y):
-    block = x.block
+    block = _current_block(x)
     if isinstance(y, (int, float)):
-        y = _create_tensor_from_scalar(block, y, x.dtype, (1,))
-    helper = LayerHelper(op_type)
-    out = helper.create_tmp_variable(dtype="bool")
+        dtype = x.dtype
+        # a fractional threshold against an integer tensor must not be
+        # truncated into the int dtype (ids < 0.5 is NOT ids < 0); the
+        # compare lowering promotes mixed dtypes like jnp does
+        if (
+            isinstance(y, float)
+            and not float(y).is_integer()
+            and ("int" in str(dtype) or dtype == "bool")
+        ):
+            dtype = "float32"
+        y = _create_tensor_from_scalar(block, y, dtype, (1,))
+    out = _tmp_var(block, "bool")
     block.append_op(
         op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}
     )
@@ -89,6 +136,7 @@ def monkey_patch_variable():
     V.__truediv__ = lambda s, o: _elementwise("elementwise_div", s, o)
     V.__rtruediv__ = lambda s, o: _elementwise("elementwise_div", s, o, reverse=True)
     V.__pow__ = lambda s, o: _elementwise("elementwise_pow", s, o)
+    V.__rpow__ = lambda s, o: _elementwise("elementwise_pow", s, o, reverse=True)
     V.__neg__ = lambda s: _scale(s, -1.0, 0.0)
     V.__lt__ = lambda s, o: _compare("less_than", s, o)
     V.__le__ = lambda s, o: _compare("less_equal", s, o)

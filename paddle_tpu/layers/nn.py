@@ -551,3 +551,193 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
         attrs={"groups": groups, "epsilon": epsilon},
     )
     return helper.append_activation(out)
+
+
+# ---------------------------------------------------------------------------
+# Misc losses / similarity / utility layers (reference layers/nn.py:
+# cos_sim:1190, multiplex:5559, smooth_l1:5700, label_smooth:6334,
+# selu:7047, mean_iou:7087, crop:7141, rank_loss:7358, affine_channel:9040,
+# similarity_focus:9081, add_position_encoding:9438,
+# bilinear_tensor_product:9488, fsp_matrix:9900)
+# ---------------------------------------------------------------------------
+
+
+def cos_sim(X, Y, name=None):
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xnorm = helper.create_variable_for_type_inference(X.dtype)
+    ynorm = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(
+        "cos_sim",
+        inputs={"X": [X], "Y": [Y]},
+        outputs={"Out": [out], "XNorm": [xnorm], "YNorm": [ynorm]},
+    )
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    helper = LayerHelper("multiplex", name=name)
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(
+        "multiplex",
+        inputs={"X": list(inputs), "Ids": [index]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None,
+              name=None):
+    helper = LayerHelper("smooth_l1_loss", name=name)
+    diff = helper.create_variable_for_type_inference(x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        ins["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        ins["OutsideWeight"] = [outside_weight]
+    helper.append_op(
+        "smooth_l1_loss",
+        inputs=ins,
+        outputs={"Diff": [diff], "Out": [out]},
+        attrs={"sigma": 1.0 if sigma is None else sigma},
+    )
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    ins = {"X": [label]}
+    if prior_dist is not None:
+        ins["PriorDist"] = [prior_dist]
+    helper.append_op(
+        "label_smooth",
+        inputs=ins,
+        outputs={"Out": [out]},
+        attrs={"epsilon": float(epsilon)},
+    )
+    return out
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    helper = LayerHelper("selu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    if alpha is not None:
+        attrs["alpha"] = float(alpha)
+    helper.append_op("selu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    iou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "mean_iou",
+        inputs={"Predictions": [input], "Labels": [label]},
+        outputs={
+            "OutMeanIou": [iou], "OutWrong": [wrong], "OutCorrect": [correct]
+        },
+        attrs={"num_classes": int(num_classes)},
+    )
+    return iou, wrong, correct
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = list(shape)
+    elif shape is not None:
+        ins["Y"] = [shape]
+    if isinstance(offsets, (list, tuple)):
+        attrs["offsets"] = list(offsets)
+    elif offsets is not None:
+        ins["Offsets"] = [offsets]
+    helper.append_op("crop", inputs=ins, outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(
+        "rank_loss",
+        inputs={"Label": [label], "Left": [left], "Right": [right]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "affine_channel",
+        inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
+        outputs={"Out": [out]},
+        attrs={"data_layout": data_layout},
+    )
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "similarity_focus",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"axis": int(axis), "indexes": list(indexes)},
+    )
+    return out
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    helper = LayerHelper("add_position_encoding", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "add_position_encoding",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"alpha": float(alpha), "beta": float(beta)},
+    )
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = x.dtype
+    w = helper.create_parameter(
+        helper.param_attr(), shape=[size, x.shape[1], y.shape[1]], dtype=dtype
+    )
+    ins = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        bias = helper.create_parameter(
+            helper.bias_attr(), shape=[1, size], dtype=dtype, is_bias=True
+        )
+        ins["Bias"] = [bias]
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "bilinear_tensor_product", inputs=ins, outputs={"Out": [out]}
+    )
+    return helper.append_activation(out)
+
+
+def fsp_matrix(x, y):
+    helper = LayerHelper("fsp")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fsp", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out

@@ -12,4 +12,5 @@ from . import (  # noqa: F401
     control_flow_ops,
     sequence_ops,
     rnn_ops,
+    misc_ops,
 )

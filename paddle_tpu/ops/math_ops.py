@@ -202,7 +202,9 @@ def _matmul_infer(ctx):
         xs[-1], xs[-2] = xs[-2], xs[-1]
     if ty:
         ys[-1], ys[-2] = ys[-2], ys[-1]
-    if xs[-1] != ys[-2]:
+    # -1 is the dynamic-dim placeholder — only flag a mismatch when both
+    # contraction dims are statically known
+    if xs[-1] != ys[-2] and xs[-1] >= 0 and ys[-2] >= 0:
         raise ValueError(
             f"matmul contraction dims mismatch: X{tuple(xs)} @ Y{tuple(ys)}"
         )

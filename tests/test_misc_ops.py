@@ -1,0 +1,496 @@
+"""Op tests for the misc op family (ops/misc_ops.py): output parity with
+numpy references + numeric grad checks for the differentiable ones.
+Mirrors the reference's per-op unittests (tests/unittests/test_rank_loss_op.py,
+test_smooth_l1_loss_op.py, test_cos_sim_op.py, ...)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core import registry
+
+from op_test import OpTest
+
+
+rng = np.random.RandomState(7)
+
+
+def f32(*shape):
+    return rng.uniform(-1, 1, shape).astype("float32")
+
+
+class TestRankLoss(OpTest):
+    op_type = "rank_loss"
+
+    def test_output_and_grad(self):
+        left, right = f32(5, 1), f32(5, 1)
+        label = (rng.rand(5, 1) > 0.5).astype("float32")
+        d = left - right
+        expected = np.log1p(np.exp(d)) - label * d
+        self.check_output(
+            {"Left": left, "Right": right, "Label": label}, {"Out": expected}
+        )
+        self.check_grad(
+            {"Left": left, "Right": right, "Label": label},
+            {"Out": ["out"]},
+            ["Left", "Right"],
+        )
+
+
+class TestModifiedHuberLoss(OpTest):
+    op_type = "modified_huber_loss"
+
+    def test_output(self):
+        x = f32(6, 1) * 2
+        y = (rng.rand(6, 1) > 0.5).astype("float32")
+        val = x * (2 * y - 1)
+        expected = np.where(
+            val < -1, -4.0 * val, np.where(val < 1, (1 - val) ** 2, 0.0)
+        ).astype("float32")
+        self.check_output(
+            {"X": x, "Y": y},
+            {"Out": [("out", expected)], "IntermediateVal": [("ival", val)]},
+        )
+
+
+class TestTeacherStudentSigmoidLoss(OpTest):
+    op_type = "teacher_student_sigmoid_loss"
+
+    def test_output(self):
+        x = f32(8, 1)
+        label = np.array(
+            [[-2.0], [-0.5], [0.3], [0.9], [1.2], [1.8], [0.0], [1.0]],
+            dtype="float32",
+        )
+        base = np.maximum(x, 0) + np.log1p(np.exp(-np.abs(x)))
+        expected = np.where(
+            label < -1.0, base,
+            np.where(
+                label < 0.0, base - x,
+                np.where(
+                    label < 1.0, 2 * base - x * label,
+                    2 * base - x - x * (label - 1.0),
+                ),
+            ),
+        )
+        self.check_output({"X": x, "Label": label}, {"Y": expected})
+
+
+class TestSmoothL1Loss(OpTest):
+    op_type = "smooth_l1_loss"
+    attrs = {"sigma": 2.0}
+
+    def test_output_and_grad(self):
+        x, y = f32(4, 6), f32(4, 6)
+        d = x - y
+        s2 = 4.0
+        ad = np.abs(d)
+        elem = np.where(ad < 1 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+        expected = elem.reshape(4, -1).sum(axis=1, keepdims=True)
+        self.check_output(
+            {"X": x, "Y": y},
+            {"Out": [("out", expected)], "Diff": [("diff", d)]},
+        )
+        self.check_grad(
+            {"X": x, "Y": y},
+            {"Out": ["out"], "Diff": ["diff"]},
+            ["X"],
+            loss_slot="Out",
+        )
+
+
+class TestSquaredL2Distance(OpTest):
+    op_type = "squared_l2_distance"
+
+    def test_output(self):
+        x, y = f32(5, 4), f32(5, 4)
+        sub = x - y
+        self.check_output(
+            {"X": x, "Y": y},
+            {
+                "Out": [("out", (sub ** 2).sum(axis=1, keepdims=True))],
+                "sub_result": [("sub", sub)],
+            },
+        )
+
+
+class TestCosSim(OpTest):
+    op_type = "cos_sim"
+
+    def test_output_and_grad(self):
+        x, y = f32(4, 5) + 1.5, f32(4, 5) + 1.5
+        xn = np.sqrt((x ** 2).sum(axis=1, keepdims=True))
+        yn = np.sqrt((y ** 2).sum(axis=1, keepdims=True))
+        expected = (x * y).sum(axis=1, keepdims=True) / (xn * yn)
+        self.check_output(
+            {"X": x, "Y": y},
+            {"Out": [("out", expected)], "XNorm": [("xn", xn)],
+             "YNorm": [("yn", yn)]},
+        )
+        self.check_grad(
+            {"X": x, "Y": y},
+            {"Out": ["out"], "XNorm": ["xn"], "YNorm": ["yn"]},
+            ["X", "Y"],
+            loss_slot="Out",
+        )
+
+
+class TestL1Norm(OpTest):
+    op_type = "l1_norm"
+
+    def test_output_and_grad(self):
+        x = f32(3, 4)
+        self.check_output({"X": x}, {"Out": np.abs(x).sum().reshape(1)})
+        self.check_grad({"X": x + 0.3}, {"Out": ["out"]}, ["X"])
+
+
+class TestSelu(OpTest):
+    op_type = "selu"
+
+    def test_output_and_grad(self):
+        x = f32(4, 5) * 2
+        scale, alpha = 1.0507009873554805, 1.6732632423543772
+        expected = scale * np.where(x > 0, x, alpha * (np.exp(x) - 1))
+        self.check_output({"X": x}, {"Out": expected})
+        # keep x away from the kink at 0 for finite differences
+        x2 = np.where(np.abs(x) < 0.05, 0.2, x).astype("float32")
+        self.check_grad({"X": x2}, {"Out": ["out"]}, ["X"])
+
+
+class TestSignMinus(OpTest):
+    def test_sign(self):
+        self.op_type = "sign"
+        x = f32(3, 4)
+        self.check_output({"X": x}, {"Out": np.sign(x)})
+
+    def test_minus(self):
+        self.op_type = "minus"
+        x, y = f32(3, 4), f32(3, 4)
+        self.check_output({"X": x, "Y": y}, {"Out": x - y})
+        self.check_grad({"X": x, "Y": y}, {"Out": ["out"]}, ["X", "Y"])
+
+
+class TestLabelSmooth(OpTest):
+    op_type = "label_smooth"
+    attrs = {"epsilon": 0.1}
+
+    def test_uniform_prior(self):
+        x = np.eye(4, dtype="float32")[[0, 2, 3]]
+        expected = 0.9 * x + 0.1 / 4
+        self.check_output({"X": x}, {"Out": expected})
+
+    def test_explicit_prior(self):
+        x = np.eye(4, dtype="float32")[[1, 3]]
+        prior = np.array([0.1, 0.2, 0.3, 0.4], dtype="float32")
+        expected = 0.9 * x + 0.1 * prior[None]
+        self.check_output(
+            {"X": x, "PriorDist": prior}, {"Out": expected}
+        )
+
+
+class TestMultiplex(OpTest):
+    op_type = "multiplex"
+
+    def test_output(self):
+        a, b, c = f32(4, 3), f32(4, 3), f32(4, 3)
+        ids = np.array([[0], [2], [1], [0]], dtype="int32")
+        expected = np.stack([a[0], c[1], b[2], a[3]])
+        self.check_output(
+            {"X": [("a", a), ("b", b), ("c", c)], "Ids": ids},
+            {"Out": expected},
+        )
+
+
+class TestAffineChannel(OpTest):
+    op_type = "affine_channel"
+
+    def test_nchw(self):
+        x = f32(2, 3, 4, 4)
+        scale, bias = f32(3), f32(3)
+        expected = x * scale[None, :, None, None] + bias[None, :, None, None]
+        self.check_output(
+            {"X": x, "Scale": scale, "Bias": bias}, {"Out": expected},
+            attrs={"data_layout": "NCHW"},
+        )
+
+    def test_nhwc(self):
+        x = f32(2, 4, 4, 3)
+        scale, bias = f32(3), f32(3)
+        expected = x * scale[None, None, None, :] + bias[None, None, None, :]
+        self.check_output(
+            {"X": x, "Scale": scale, "Bias": bias}, {"Out": expected},
+            attrs={"data_layout": "NHWC"},
+        )
+
+
+class TestDataNorm(OpTest):
+    op_type = "data_norm"
+    attrs = {"epsilon": 1e-4}
+
+    def test_output(self):
+        x = f32(6, 3)
+        bsize = np.full(3, 10.0, dtype="float32")
+        bsum = f32(3) * 5
+        bsq = np.abs(f32(3)) * 20 + 10
+        mean = bsum / bsize
+        scale = np.sqrt(bsize / (bsq - bsum * mean + 1e-4 * bsize))
+        expected = (x - mean[None]) * scale[None]
+        self.check_output(
+            {"X": x, "BatchSize": bsize, "BatchSum": bsum,
+             "BatchSquareSum": bsq},
+            {
+                "Y": [("y", expected)],
+                "Means": [("m", mean)],
+                "Scales": [("s", scale)],
+                "BatchSizeOut": [("bso", bsize + 6)],
+                "BatchSumOut": [("bsumo", bsum + x.sum(axis=0))],
+                "BatchSquareSumOut": [("bsqo", bsq + (x ** 2).sum(axis=0))],
+            },
+            rtol=1e-4,
+        )
+
+
+class TestFillOps(OpTest):
+    def test_fill(self):
+        self.op_type = "fill"
+        expected = np.arange(6, dtype="float32").reshape(2, 3)
+        self.check_output(
+            {},
+            {"Out": expected},
+            attrs={"shape": [2, 3], "value": list(range(6)),
+                   "dtype": "float32"},
+        )
+
+    def test_fill_constant_batch_size_like(self):
+        self.op_type = "fill_constant_batch_size_like"
+        x = f32(5, 2)
+        self.check_output(
+            {"Input": x},
+            {"Out": np.full((5, 7), 3.5, dtype="float32")},
+            attrs={"shape": [-1, 7], "value": 3.5, "dtype": "float32",
+                   "input_dim_idx": 0, "output_dim_idx": 0},
+        )
+
+
+class TestCrop(OpTest):
+    op_type = "crop"
+
+    def test_static_attrs(self):
+        x = f32(4, 5)
+        self.check_output(
+            {"X": x},
+            {"Out": x[1:3, 2:5]},
+            attrs={"shape": [2, 3], "offsets": [1, 2]},
+        )
+
+
+class TestIsEmpty(OpTest):
+    op_type = "is_empty"
+
+    def test_nonempty(self):
+        self.check_output({"X": f32(2, 2)}, {"Out": np.array([False])})
+
+
+class TestMeanIou(OpTest):
+    op_type = "mean_iou"
+    attrs = {"num_classes": 3}
+
+    def test_output(self):
+        pred = np.array([0, 1, 2, 1, 0, 2], dtype="int32")
+        label = np.array([0, 1, 1, 1, 2, 2], dtype="int32")
+        n = 3
+        cm = np.zeros((n, n), dtype=np.int64)
+        for p, l in zip(pred, label):
+            cm[l, p] += 1
+        inter = np.diag(cm).astype("float64")
+        union = cm.sum(0) + cm.sum(1) - inter
+        valid = union > 0
+        miou = np.where(valid, inter / np.maximum(union, 1), 0).sum() / valid.sum()
+        self.check_output(
+            {"Predictions": pred, "Labels": label},
+            {"OutMeanIou": [("iou", np.float32(miou))],
+             "OutWrong": [("w", (cm.sum(1) - np.diag(cm)).astype("int32"))],
+             "OutCorrect": [("c", np.diag(cm).astype("int32"))]},
+        )
+
+
+class TestFsp(OpTest):
+    op_type = "fsp"
+
+    def test_output_and_grad(self):
+        x, y = f32(2, 3, 2, 2), f32(2, 4, 2, 2)
+        xf = x.reshape(2, 3, 4)
+        yf = y.reshape(2, 4, 4)
+        expected = np.einsum("nch,ndh->ncd", xf, yf) / 4.0
+        self.check_output({"X": x, "Y": y}, {"Out": expected})
+        self.check_grad({"X": x, "Y": y}, {"Out": ["out"]}, ["X", "Y"])
+
+
+class TestConvShift(OpTest):
+    op_type = "conv_shift"
+
+    def test_output_and_grad(self):
+        x, y = f32(2, 5), f32(2, 3)
+        b, w = x.shape
+        m = y.shape[1]
+        expected = np.zeros_like(x)
+        for i in range(b):
+            for j in range(w):
+                for k in range(m):
+                    expected[i, j] += x[i, (j + k - m // 2) % w] * y[i, k]
+        self.check_output({"X": x, "Y": y}, {"Out": expected})
+        self.check_grad({"X": x, "Y": y}, {"Out": ["out"]}, ["X", "Y"])
+
+
+class TestBilinearTensorProduct(OpTest):
+    op_type = "bilinear_tensor_product"
+
+    def test_output_and_grad(self):
+        x, y, w = f32(3, 2), f32(3, 4), f32(5, 2, 4)
+        bias = f32(1, 5)
+        expected = np.einsum("bi,kij,bj->bk", x, w, y) + bias
+        self.check_output(
+            {"X": x, "Y": y, "Weight": w, "Bias": bias}, {"Out": expected}
+        )
+        self.check_grad(
+            {"X": x, "Y": y, "Weight": w, "Bias": bias},
+            {"Out": ["out"]},
+            ["X", "Weight"],
+        )
+
+
+class TestAddPositionEncoding(OpTest):
+    op_type = "add_position_encoding"
+    attrs = {"alpha": 0.5, "beta": 2.0}
+
+    def test_output(self):
+        x = f32(2, 3, 4)
+        t, d = 3, 4
+        pos = np.arange(t, dtype="float64")[:, None]
+        dim = np.arange(d // 2, dtype="float64")[None, :]
+        div = np.power(10000.0, 2.0 * dim / d)
+        enc = np.zeros((t, d))
+        enc[:, 0::2] = np.sin(pos / div)
+        enc[:, 1::2] = np.cos(pos / div)
+        expected = 0.5 * x + 2.0 * enc[None].astype("float32")
+        self.check_output({"X": x}, {"Out": expected}, rtol=1e-4)
+
+
+class TestSimilarityFocus(OpTest):
+    op_type = "similarity_focus"
+    attrs = {"axis": 1, "indexes": [0]}
+
+    def test_output(self):
+        x = f32(1, 2, 3, 3)
+        ch = x[0, 0]
+        row_max = ch == ch.max(axis=1, keepdims=True)
+        col_max = ch == ch.max(axis=0, keepdims=True)
+        m = (row_max | col_max).astype("float32")
+        expected = np.broadcast_to(m[None, None], x.shape).copy()
+        self.check_output({"X": x}, {"Out": expected})
+
+
+class TestShardIndex(OpTest):
+    op_type = "shard_index"
+    attrs = {"index_num": 20, "nshards": 2, "shard_id": 0,
+             "ignore_value": -1}
+
+    def test_output(self):
+        x = np.array([[1], [9], [10], [19]], dtype="int64")
+        expected = np.array([[1], [9], [-1], [-1]], dtype="int64")
+        self.check_output({"X": x}, {"Out": expected})
+
+
+class TestUnpool(OpTest):
+    op_type = "unpool"
+    attrs = {"ksize": [2, 2]}
+
+    def test_output(self):
+        x = np.array([[[[1.0, 2.0], [3.0, 4.0]]]], dtype="float32")
+        idx = np.array([[[[0, 7], [9, 15]]]], dtype="int32")
+        expected = np.zeros((1, 1, 4, 4), dtype="float32")
+        for v, i in zip([1, 2, 3, 4], [0, 7, 9, 15]):
+            expected[0, 0, i // 4, i % 4] = v
+        self.check_output({"X": x, "Indices": idx}, {"Out": expected})
+
+
+def test_selected_rows_ops_direct():
+    """get_tensor_from_selected_rows / merge_selected_rows operate on
+    SelectedRows values — exercised at the lowering level (the feed path is
+    dense-only, matching the reference where these appear mid-graph)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    ids = jnp.array([3, 1, 3], dtype=jnp.int32)
+    rows = jnp.array([[1.0, 1.0], [2.0, 2.0], [4.0, 4.0]])
+    sr = SelectedRows(ids, rows, height=6)
+
+    out = registry.get("get_tensor_from_selected_rows").lower(
+        _ctx(), {"X": [sr]}
+    )["Out"][0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(rows))
+
+    merged = registry.get("merge_selected_rows").lower(_ctx(), {"X": [sr]})[
+        "Out"
+    ][0]
+    assert isinstance(merged, SelectedRows)
+    got = {int(i): np.asarray(r) for i, r in zip(merged.ids, merged.rows)
+           if int(i) >= 0}
+    np.testing.assert_allclose(got[3], [5.0, 5.0])
+    np.testing.assert_allclose(got[1], [2.0, 2.0])
+
+
+def _ctx():
+    class _C:
+        attrs = {}
+
+        def attr(self, name, default=None):
+            return default
+
+    return _C()
+
+
+def test_misc_ops_all_registered():
+    """Every op in misc_ops is importable through the package registry
+    (regression for the round-2 dead-code finding)."""
+    for op in [
+        "rank_loss", "modified_huber_loss", "teacher_student_sigmoid_loss",
+        "smooth_l1_loss", "squared_l2_distance", "cos_sim", "l1_norm",
+        "selu", "sign", "minus", "label_smooth", "multiplex",
+        "affine_channel", "data_norm", "fill",
+        "fill_constant_batch_size_like", "crop", "is_empty", "mean_iou",
+        "fsp", "conv_shift", "bilinear_tensor_product",
+        "add_position_encoding", "similarity_focus",
+        "get_tensor_from_selected_rows", "merge_selected_rows",
+        "shard_index", "unpool",
+    ]:
+        assert registry.lookup(op) is not None, op
+
+
+def test_misc_layer_wrappers():
+    """Layer-level smoke: the nn.py wrappers build and run."""
+    import paddle_tpu.layers as layers
+    from paddle_tpu.core import framework as fw
+
+    prog, startup = fw.Program(), fw.Program()
+    with fw.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[4], dtype="float32")
+        sim = layers.cos_sim(x, y)
+        sl1 = layers.smooth_l1(x, y)
+        act = layers.selu(x)
+        pe_in = layers.data(name="p", shape=[3, 4], dtype="float32")
+        pe = layers.add_position_encoding(pe_in, alpha=1.0, beta=1.0)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    res = exe.run(
+        prog,
+        feed={
+            "x": f32(2, 4) + 1.2,
+            "y": f32(2, 4) + 1.2,
+            "p": f32(2, 3, 4),
+        },
+        fetch_list=[sim, sl1, act, pe],
+    )
+    assert all(np.asarray(r).size for r in res)
