@@ -1,16 +1,30 @@
-"""Flash attention — Pallas TPU kernel with online softmax.
+"""Flash attention — Pallas TPU kernels with online softmax, forward AND
+backward.
 
 Replaces the reference's unfused matmul+softmax+matmul attention chain
 (tests/unittests/transformer_model.py:44 builds it op-by-op; the reference
 has no fused attention kernel at all — this is the TPU capability upgrade
 called out in SURVEY.md §7.6).
 
-Design (per pallas_guide.md): grid over (batch*heads, q_blocks); K/V stream
-through VMEM in kv_blocks of the inner loop with running max/sum
-(online softmax), accumulating in fp32.  Falls back to a pure-XLA
-implementation off-TPU or for unaligned shapes.  Causal masking is
-bottom-right aligned (same as the XLA fallback) so tq != tk is consistent
-across paths.
+Design (per pallas_guide.md):
+  * forward: grid (batch*heads, q_blocks); K/V stream through VMEM in
+    kv-blocks with running max/sum (online softmax), fp32 accumulation; the
+    per-row logsumexp is saved as a residual.
+  * backward: FlashAttention-2 style split — one kernel computes dK/dV on a
+    (batch*heads, kv_blocks) grid, one computes dQ on (batch*heads,
+    q_blocks); both recompute the probability blocks from Q/K and the saved
+    logsumexp, so no O(T^2) softmax matrix is ever materialized in either
+    pass.  delta = rowsum(dO * O) is a cheap XLA prologue.
+  * causal masking is bottom-right aligned; fully-masked blocks are skipped
+    via dynamic fori_loop bounds (halves causal FLOPs).
+  * additive bias is indexed per-block with broadcast-aware index maps
+    ([B,1,1,Tk] padding masks and [B,1,Tq,Tk] causal+padding masks are read
+    as-is — never broadcast-materialized to [B,H,Tq,Tk] in HBM).
+
+Falls back to a pure-XLA implementation off-TPU or for unaligned shapes.
+The bias gradient (trainable-bias case, e.g. relative-position biases) is
+computed by an XLA recompute expression outside the kernels; when the bias
+is a stop-gradient mask (the usual case) XLA dead-code-eliminates it.
 """
 
 from __future__ import annotations
@@ -34,8 +48,27 @@ def reference_attention(q, k, v, bias=None, scale=1.0, causal=False):
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale, block_k,
-                  causal, seq_k, block_q, causal_offset):
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+
+def _read_bias(bias_ref, q_lo, block_q, k_lo, block_k, bias_q1):
+    """Slice a [block_q, block_k] (or [1, block_k]) bias tile from the
+    kernel-local bias block.  `q_lo`/`k_lo` are offsets into the local block
+    (already 0 when the BlockSpec pinned that dim)."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if bias_q1:
+        b = bias_ref[0, 0, :, pl.ds(k_lo, block_k)]  # [1, block_k]
+    else:
+        b = bias_ref[0, 0, pl.ds(q_lo, block_q), pl.ds(k_lo, block_k)]
+    return b.astype(jnp.float32)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, scale,
+                block_q, block_k, causal, seq_k, causal_offset, bias_q1):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -49,6 +82,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale, block_k,
     acc = jnp.zeros((block_q, d), jnp.float32)
 
     n_kv = seq_k // block_k
+    if causal:
+        # highest k position visible to this q block, bottom-right aligned
+        hi = qi * block_q + block_q - 1 + causal_offset
+        n_kv = jnp.minimum(n_kv, (hi // block_k) + 1)
 
     def body(j, carry):
         m, l, acc = carry
@@ -56,10 +93,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale, block_k,
         v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = q @ k.T  # [block_q, block_k]
         if bias_ref is not None:
-            b = bias_ref[0, :, pl.ds(j * block_k, block_k)].astype(jnp.float32)
-            s = s + b
+            s = s + _read_bias(bias_ref, 0, block_q, j * block_k, block_k,
+                               bias_q1)
         if causal:
-            # bottom-right aligned: allow k_pos <= q_pos + (seq_k - seq_q)
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -76,90 +112,188 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale, block_k,
 
     m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m, l, acc))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
 
 
-def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
-                    block_q=512, block_k=512, interpret=None):
-    """q,k,v: [B, H, T, D]; bias: broadcastable [B, H, Tq, Tk] or None.
-    Returns [B, H, Tq, D].
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, block_q, block_k, causal, seq_k,
+                   causal_offset, bias_q1):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
 
-    Differentiable: forward runs the Pallas kernel; backward is the XLA vjp
-    of the reference formulation (activation-recompute style — no softmax
-    matrix is materialized in fwd residuals)."""
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]      # [block_q] f32
+    delta = delta_ref[0]  # [block_q] f32
+    d = q.shape[-1]
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    n_kv = seq_k // block_k
+    if causal:
+        hi = qi * block_q + block_q - 1 + causal_offset
+        n_kv = jnp.minimum(n_kv, (hi // block_k) + 1)
+
+    def body(j, acc):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k.T) * scale
+        if bias_ref is not None:
+            s = s + _read_bias(bias_ref, 0, block_q, j * block_k, block_k,
+                               bias_q1)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(q_pos + causal_offset >= k_pos, p, 0.0)
+        dp = do @ v.T  # [block_q, block_k]
+        ds = p * (dp - delta[:, None]) * scale
+        return acc + ds @ k
+
+    acc = jax.lax.fori_loop(0, n_kv, body, acc)
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, scale, block_q, block_k,
+                    causal, seq_q, causal_offset, bias_q1):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+
+    k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+
+    n_q = seq_q // block_q
+    lo = 0
+    if causal:
+        # first q position that can see this kv block
+        lo_pos = ki * block_k - causal_offset
+        lo = jnp.maximum(lo_pos // block_q, 0)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = (q @ k.T) * scale  # [block_q, block_k]
+        if bias_ref is not None:
+            s = s + _read_bias(bias_ref, i * block_q, block_q, 0, block_k,
+                               bias_q1)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(q_pos + causal_offset >= k_pos, p, 0.0)
+        dv = dv + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + ds.T @ q
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(lo, n_q, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Host-side plumbing
+# ---------------------------------------------------------------------------
+
+
+def _plan(q, k, block_q, block_k, interpret):
+    """Static feasibility check; returns (ok, block_q, block_k, interpret)."""
     import jax
 
-    if bias is None:
-        @jax.custom_vjp
-        def _attn3(q, k, v):
-            return _flash_forward(q, k, v, None, scale, causal, block_q,
-                                  block_k, interpret)
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if on_tpu and not interpret:
+        # Mosaic: lane-dim (last-dim) dynamic-slice offsets must be
+        # 128-aligned; sublane offsets 8-aligned.  The backward kernels
+        # slice the lse/delta lane dim by block_q, so it needs 128 too.
+        if block_k % 128:
+            block_k = 128 if tk % 128 == 0 else 0
+        if block_q % 128:
+            block_q = 128 if tq % 128 == 0 else 0
+    ok = (
+        block_q
+        and block_k
+        and tq % block_q == 0
+        and tk % block_k == 0
+        and d % 64 == 0  # 64 runs at half-lane MXU occupancy but still wins
+        and (on_tpu or interpret)
+    )
+    return ok, block_q, block_k, interpret
 
-        def _fwd3(q, k, v):
-            return _attn3(q, k, v), (q, k, v)
 
-        def _bwd3(res, g):
-            q, k, v = res
-            _, vjp = jax.vjp(
-                lambda q, k, v: reference_attention(q, k, v, None, scale, causal),
-                q, k, v,
-            )
-            return vjp(g)
+def _bias_spec_and_arg(bias, b, h, tq, tk, block_q, block_k, for_dkv):
+    """BlockSpec + argument for the (unbroadcast) bias.
 
-        _attn3.defvjp(_fwd3, _bwd3)
-        return _attn3(q, k, v)
+    bias is [Bb, Hb, Tqb, Tk] with Bb in {1, b}, Hb in {1, h}, Tqb in
+    {1, tq}.  The grid's first axis is i = batch*h + head; index maps pin
+    broadcast dims to 0.  Returns (spec, arg, bias_q1)."""
+    from jax.experimental import pallas as pl
 
-    @jax.custom_vjp
-    def _attn(q, k, v, bias):
-        return _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
-                              interpret)
+    bb, hb, tqb, tkb = bias.shape
+    bias_q1 = tqb == 1
 
-    def _fwd(q, k, v, bias):
-        return _attn(q, k, v, bias), (q, k, v, bias)
+    def ib(i):
+        return i // h if bb > 1 else 0
 
-    def _bwd(res, g):
-        q, k, v, bias = res
-        _, vjp = jax.vjp(
-            lambda q, k, v, bias: reference_attention(q, k, v, bias, scale, causal),
-            q, k, v, bias,
+    def ih(i):
+        return i % h if hb > 1 else 0
+
+    if for_dkv:
+        # kv-block grid: full q extent, one kv block
+        qdim = 1 if bias_q1 else tqb
+        spec = pl.BlockSpec(
+            (1, 1, qdim, block_k),
+            lambda i, j: (ib(i), ih(i), 0, j),
         )
-        return vjp(g)
+    else:
+        # q-block grid: one q block, full k extent
+        if bias_q1:
+            spec = pl.BlockSpec(
+                (1, 1, 1, tkb), lambda i, j: (ib(i), ih(i), 0, 0)
+            )
+        else:
+            spec = pl.BlockSpec(
+                (1, 1, block_q, tkb), lambda i, j: (ib(i), ih(i), j, 0)
+            )
+    return spec, bias, bias_q1
 
-    _attn.defvjp(_fwd, _bwd)
-    return _attn(q, k, v, bias)
 
-
-def _flash_forward(q, k, v, bias=None, scale=1.0, causal=False,
-                   block_q=512, block_k=512, interpret=None):
+def _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
+                   interpret):
+    """Returns (out, lse) via the Pallas kernel.  Caller has checked
+    feasibility with _plan."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     b, h, tq, d = q.shape
     tk = k.shape[2]
-
-    on_tpu = jax.default_backend() == "tpu"
-    if interpret is None:
-        interpret = not on_tpu
-
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
-    # Mosaic constraint: lane-dim (last-dim) slice offsets must be 128-aligned
-    # on real TPU, so block_k must be a multiple of 128 there.
-    if on_tpu and not interpret:
-        if block_k % 128:
-            block_k = 128 if tk % 128 == 0 else 0
-        if block_q % 8:
-            block_q = 0
-    if (
-        not block_q
-        or not block_k
-        or tq % block_q
-        or tk % block_k
-        or d % 128
-        or (not on_tpu and not interpret)
-    ):
-        return reference_attention(q, k, v, bias, scale, causal)
-
     bh = b * h
     q3 = q.reshape(bh, tq, d)
     k3 = k.reshape(bh, tk, d)
@@ -172,25 +306,239 @@ def _flash_forward(q, k, v, bias=None, scale=1.0, causal=False,
         pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
     ]
     args = [q3, k3, v3]
-    kern = functools.partial(
-        _flash_kernel, scale=scale, block_k=block_k, causal=causal,
-        seq_k=tk, block_q=block_q, causal_offset=tk - tq,
-    )
+    bias_q1 = False
     if bias is not None:
-        bias_full = jnp.broadcast_to(bias, (b, h, tq, tk)).reshape(bh, tq, tk)
-        in_specs.append(pl.BlockSpec((1, block_q, tk), lambda i, j: (i, j, 0)))
-        args.append(bias_full)
-        kernel = kern
-    else:
-        def kernel(q_ref, k_ref, v_ref, o_ref):
-            return kern(q_ref, k_ref, v_ref, None, o_ref)
+        spec, barg, bias_q1 = _bias_spec_and_arg(
+            bias, b, h, tq, tk, block_q, block_k, for_dkv=False
+        )
+        in_specs.append(spec)
+        args.append(barg)
 
-    out = pl.pallas_call(
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, seq_k=tk, causal_offset=tk - tq, bias_q1=bias_q1,
+    )
+    if bias is None:
+        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+            return kern(q_ref, k_ref, v_ref, None, o_ref, lse_ref)
+    else:
+        kernel = kern
+
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
+
+
+def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
+                    block_k, interpret):
+    """Returns (dq, dk, dv) via the two backward kernels."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, tq, d)
+    k3 = k.reshape(bh, tk, d)
+    v3 = v.reshape(bh, tk, d)
+    do3 = g.reshape(bh, tq, d)
+    lse3 = lse.reshape(bh, tq)
+    # delta[i] = rowsum(dO * O): the only forward residual besides lse
+    delta3 = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).reshape(bh, tq)
+    causal_offset = tk - tq
+
+    # ---- dQ: grid over q blocks -----------------------------------------
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),   # q
+        pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),        # k
+        pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),        # v
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),   # do
+        pl.BlockSpec((1, block_q), lambda i, j: (i, j)),         # lse
+        pl.BlockSpec((1, block_q), lambda i, j: (i, j)),         # delta
+    ]
+    args = [q3, k3, v3, do3, lse3, delta3]
+    bias_q1 = False
+    if bias is not None:
+        spec, barg, bias_q1 = _bias_spec_and_arg(
+            bias, b, h, tq, tk, block_q, block_k, for_dkv=False
+        )
+        in_specs.insert(3, spec)
+        args.insert(3, barg)
+
+    dq_kern = functools.partial(
+        _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, seq_k=tk, causal_offset=causal_offset,
+        bias_q1=bias_q1,
+    )
+    if bias is None:
+        def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref):
+            return dq_kern(q_ref, k_ref, v_ref, None, do_ref, lse_ref,
+                           delta_ref, dq_ref)
+    else:
+        dq_kernel = dq_kern
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, tq // block_q),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         interpret=interpret,
     )(*args)
-    return out.reshape(b, h, tq, d)
+
+    # ---- dK/dV: grid over kv blocks -------------------------------------
+    in_specs = [
+        pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),        # q
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),   # v
+        pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),        # do
+        pl.BlockSpec((1, tq), lambda i, j: (i, 0)),              # lse
+        pl.BlockSpec((1, tq), lambda i, j: (i, 0)),              # delta
+    ]
+    args = [q3, k3, v3, do3, lse3, delta3]
+    bias_q1 = False
+    if bias is not None:
+        spec, barg, bias_q1 = _bias_spec_and_arg(
+            bias, b, h, tq, tk, block_q, block_k, for_dkv=True
+        )
+        in_specs.insert(3, spec)
+        args.insert(3, barg)
+
+    dkv_kern = functools.partial(
+        _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, seq_q=tq, causal_offset=causal_offset,
+        bias_q1=bias_q1,
+    )
+    if bias is None:
+        def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref):
+            return dkv_kern(q_ref, k_ref, v_ref, None, do_ref, lse_ref,
+                            delta_ref, dk_ref, dv_ref)
+    else:
+        dkv_kernel = dkv_kern
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, tk // block_k),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(*args)
+
+    return (
+        dq.reshape(b, h, tq, d),
+        dk.reshape(b, h, tk, d),
+        dv.reshape(b, h, tk, d),
+    )
+
+
+def _dbias_xla(q, k, bias, lse, g, v, o, scale, causal):
+    """Bias cotangent via plain-XLA recompute (dS reduced over broadcast
+    dims).  O(T^2) memory — but attention biases are almost always
+    stop-gradient masks, and then XLA dead-code-eliminates this whole
+    expression; it only materializes for genuinely trainable biases."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = logits + bias.astype(jnp.float32)
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        logits = jnp.where(mask, logits, -1e30)
+    p = jnp.exp(logits - lse[..., None])
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g.astype(jnp.float32),
+                    v.astype(jnp.float32))
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    ds = p * (dp - delta[..., None])
+    # reduce over dims the bias broadcast along
+    axes = tuple(
+        i for i, (bd, fd) in enumerate(zip(bias.shape, ds.shape)) if bd != fd
+    )
+    if axes:
+        ds = jnp.sum(ds, axis=axes, keepdims=True)
+    return ds.astype(bias.dtype)
+
+
+def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
+                    block_q=512, block_k=512, interpret=None):
+    """q,k,v: [B, H, T, D]; bias: broadcastable [B, H, Tq, Tk] or None.
+    Returns [B, H, Tq, D].
+
+    Fully differentiable with Pallas kernels on BOTH passes: forward saves
+    only (out, logsumexp); backward recomputes probability blocks in-kernel
+    (FlashAttention-2), so neither pass materializes the [Tq, Tk] matrix."""
+    import jax
+    import jax.numpy as jnp
+
+    ok, bq, bk, interp = _plan(q, k, block_q, block_k, interpret)
+    if not ok:
+        return reference_attention(q, k, v, bias, scale, causal)
+
+    if bias is None:
+        @jax.custom_vjp
+        def _attn(q, k, v):
+            out, _ = _flash_forward(q, k, v, None, scale, causal, bq, bk,
+                                    interp)
+            return out
+
+        def _fwd(q, k, v):
+            out, lse = _flash_forward(q, k, v, None, scale, causal, bq, bk,
+                                      interp)
+            return out, (q, k, v, out, lse)
+
+        def _bwd(res, g):
+            q, k, v, out, lse = res
+            return _flash_backward(q, k, v, None, out, lse, g, scale,
+                                   causal, bq, bk, interp)
+
+        _attn.defvjp(_fwd, _bwd)
+        return _attn(q, k, v)
+
+    # normalize bias to 4D [Bb, Hb, Tqb, Tk]
+    while bias.ndim < 4:
+        bias = bias[None]
+
+    @jax.custom_vjp
+    def _attn(q, k, v, bias):
+        out, _ = _flash_forward(q, k, v, bias, scale, causal, bq, bk,
+                                interp)
+        return out
+
+    def _fwd(q, k, v, bias):
+        out, lse = _flash_forward(q, k, v, bias, scale, causal, bq, bk,
+                                  interp)
+        return out, (q, k, v, bias, out, lse)
+
+    def _bwd(res, g):
+        q, k, v, bias, out, lse = res
+        dq, dk, dv = _flash_backward(q, k, v, bias, out, lse, g, scale,
+                                     causal, bq, bk, interp)
+        dbias = _dbias_xla(q, k, bias, lse, g, v, out, scale, causal)
+        return dq, dk, dv, dbias
+
+    _attn.defvjp(_fwd, _bwd)
+    return _attn(q, k, v, bias)
