@@ -33,7 +33,12 @@ import functools
 
 
 def reference_attention(q, k, v, bias=None, scale=1.0, causal=False):
-    """Pure-XLA fallback (and numerics reference for tests)."""
+    """Pure-XLA fallback (and numerics reference for tests).
+
+    Rows with no causally-visible key (only possible when Tq > Tk under
+    bottom-right-aligned causal masking) produce zero output and zero
+    gradients — the standard flash-attention convention, and what the
+    Pallas path implements."""
     import jax
     import jax.numpy as jnp
 
@@ -45,12 +50,25 @@ def reference_attention(q, k, v, bias=None, scale=1.0, causal=False):
         mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
         logits = jnp.where(mask, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+    if causal and q.shape[2] > k.shape[2]:
+        tq, tk = q.shape[2], k.shape[2]
+        visible = jnp.tril(jnp.ones((tq, tk), bool), tk - tq).any(axis=-1)
+        out = jnp.where(visible[:, None], out, jnp.zeros_like(out))
+    return out
 
 
 # ---------------------------------------------------------------------------
 # Kernels
 # ---------------------------------------------------------------------------
+
+
+# lse/delta are per-q-row f32 vectors.  Mosaic's min-tile rule ((8, 128)
+# for f32) forbids (1, block_q) blocks of a (bh, tq) array once bh > 1, so
+# they live in HBM as (bh, 8, tq): q on the lane dim, replicated across 8
+# sublanes (the same trick splash_attention uses, with lanes/sublanes
+# swapped because our kernels want q as a column).
+LSE_SUBLANES = 8
 
 
 def _read_bias(bias_ref, q_lo, block_q, k_lo, block_k, bias_q1):
@@ -111,8 +129,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, scale,
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m, l, acc))
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    # Rows with no visible key (Tq > Tk causal: the dynamic bound can be 0,
+    # or every visited entry was causally masked to -1e30): output 0, and
+    # lse=+inf so the backward recompute p = exp(s - lse) is exactly 0.
+    masked = (l == 0.0) | (m <= -1e29)
+    l_safe = jnp.where(masked, 1.0, l)
+    o_ref[0] = jnp.where(
+        masked[:, None], 0.0, acc / l_safe[:, None]
+    ).astype(o_ref.dtype)
+    lse = jnp.where(masked, jnp.inf, m + jnp.log(l_safe))
+    lse_ref[0] = jnp.broadcast_to(lse[None, :], (LSE_SUBLANES, block_q))
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
@@ -126,8 +152,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]      # [block_q] f32
-    delta = delta_ref[0]  # [block_q] f32
+    lse = lse_ref[0, 0, :]      # [block_q] f32 (sublane-replicated tile)
+    delta = delta_ref[0, 0, :]  # [block_q] f32
     d = q.shape[-1]
     acc = jnp.zeros((block_q, d), jnp.float32)
 
@@ -186,8 +212,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
         s = (q @ k.T) * scale  # [block_q, block_k]
         if bias_ref is not None:
             s = s + _read_bias(bias_ref, i * block_q, block_q, 0, block_k,
@@ -330,15 +356,15 @@ def _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, LSE_SUBLANES, tq), jnp.float32),
         ],
         interpret=interpret,
     )(*args)
-    return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
+    return out.reshape(b, h, tq, d), lse[:, 0, :].reshape(b, h, tq)
 
 
 def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
@@ -355,21 +381,28 @@ def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
     k3 = k.reshape(bh, tk, d)
     v3 = v.reshape(bh, tk, d)
     do3 = g.reshape(bh, tq, d)
-    lse3 = lse.reshape(bh, tq)
+    # lse/delta ride in sublane-replicated (bh, 8, tq) tiles (see above)
+    lse3 = jnp.broadcast_to(
+        lse.reshape(bh, 1, tq), (bh, LSE_SUBLANES, tq)
+    )
     # delta[i] = rowsum(dO * O): the only forward residual besides lse
-    delta3 = jnp.sum(
+    delta = jnp.sum(
         g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    ).reshape(bh, tq)
+    ).reshape(bh, 1, tq)
+    delta3 = jnp.broadcast_to(delta, (bh, LSE_SUBLANES, tq))
     causal_offset = tk - tq
 
+    _lse_spec_q = pl.BlockSpec(
+        (1, LSE_SUBLANES, block_q), lambda i, j: (i, 0, j)
+    )
     # ---- dQ: grid over q blocks -----------------------------------------
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),   # q
         pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),        # k
         pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),        # v
         pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),   # do
-        pl.BlockSpec((1, block_q), lambda i, j: (i, j)),         # lse
-        pl.BlockSpec((1, block_q), lambda i, j: (i, j)),         # delta
+        _lse_spec_q,                                             # lse
+        _lse_spec_q,                                             # delta
     ]
     args = [q3, k3, v3, do3, lse3, delta3]
     bias_q1 = False
@@ -408,8 +441,8 @@ def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
         pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),   # k
         pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),   # v
         pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),        # do
-        pl.BlockSpec((1, tq), lambda i, j: (i, 0)),              # lse
-        pl.BlockSpec((1, tq), lambda i, j: (i, 0)),              # delta
+        pl.BlockSpec((1, LSE_SUBLANES, tq), lambda i, j: (i, 0, 0)),  # lse
+        pl.BlockSpec((1, LSE_SUBLANES, tq), lambda i, j: (i, 0, 0)),  # delta
     ]
     args = [q3, k3, v3, do3, lse3, delta3]
     bias_q1 = False
@@ -518,9 +551,20 @@ def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
         _attn.defvjp(_fwd, _bwd)
         return _attn(q, k, v)
 
-    # normalize bias to 4D [Bb, Hb, Tqb, Tk]
+    # normalize bias to 4D [Bb, Hb, Tqb, Tkb]; each dim must be 1 or full
+    bias = jnp.asarray(bias)
     while bias.ndim < 4:
         bias = bias[None]
+    bb, hb, tqb, tkb = bias.shape
+    _b, _h, _tq = q.shape[0], q.shape[1], q.shape[2]
+    _tk = k.shape[2]
+    if (bb not in (1, _b) or hb not in (1, _h)
+            or tqb not in (1, _tq) or tkb not in (1, _tk)):
+        return reference_attention(q, k, v, bias, scale, causal)
+    if tkb == 1:
+        # key-broadcast biases can't be block-sliced along Tk; materialize
+        # the (cheap, [.., .., 1]-thin) broadcast up front
+        bias = jnp.broadcast_to(bias, (bb, hb, tqb, _tk))
 
     @jax.custom_vjp
     def _attn(q, k, v, bias):
