@@ -281,11 +281,22 @@ _RANDOM_OPS = frozenset(
 )
 
 
+def _iter_ops_recursive(block: fw.Block):
+    """Yield the block's ops, descending into sub_block attrs (while /
+    conditional_block bodies)."""
+    for op in block.ops:
+        yield op
+        sub = op.attrs.get("sub_block")
+        if sub is not None:
+            yield from _iter_ops_recursive(sub)
+
+
 def program_uses_random(block: fw.Block) -> bool:
     """Whether lowering may draw PRNG bits (then the compiled fn takes a key
     argument).  Grad ops count: the generic vjp re-traces forward lowerings."""
     return any(
-        op.type in _RANDOM_OPS or op.type.endswith("_grad") for op in block.ops
+        op.type in _RANDOM_OPS or op.type.endswith("_grad")
+        for op in _iter_ops_recursive(block)
     )
 
 
@@ -299,7 +310,18 @@ def analyze_block_io(
     writes: List[str] = []
     seen_r, seen_w = set(), set()
     for op in block.ops:
-        for n in op.input_arg_names():
+        in_names = list(op.input_arg_names())
+        sub = op.attrs.get("sub_block")
+        if sub is not None:
+            # while/conditional bodies read outer state (params!) from inside
+            # the sub-block; those are reads of the outer op.  Names only
+            # live inside the sub-block are filtered by the scope check.
+            in_names += [
+                n
+                for inner in _iter_ops_recursive(sub)
+                for n in inner.input_arg_names()
+            ]
+        for n in in_names:
             if n and n not in defined and n not in seen_r:
                 if scope.has_var(n) and scope.find_var(n) is not None:
                     reads.append(n)

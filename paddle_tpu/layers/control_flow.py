@@ -81,13 +81,15 @@ def array_write(x, i, array=None, capacity=64):
                 "dtype": x.dtype,
             },
         )
-    out = helper.create_variable_for_type_inference(x.dtype)
+    # Out rebinds the array var itself (reference array_write mutates the
+    # LoDTensorArray in place) — so writes inside a While body make the
+    # array a loop-carried var instead of orphaning the update in a temp.
     helper.append_op(
         "write_to_array",
         inputs={"Array": [array], "X": [x], "I": [i]},
-        outputs={"Out": [out]},
+        outputs={"Out": [array]},
     )
-    return out
+    return array
 
 
 def create_array(dtype, element_shape, capacity=64):

@@ -741,3 +741,76 @@ def fsp_matrix(x, y):
     helper.append_op("fsp", inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]})
     return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, name=None, return_parent_idx=True):
+    """One beam-search step (reference: python/paddle/fluid/layers/nn.py:3833,
+    operators/beam_search_op.cc:1).
+
+    Dense TPU form: `scores` is the full [batch, beam, vocab] next-token
+    log-prob tensor (the reference takes pre-top-k'd ragged (ids, scores)
+    LoD pairs; on TPU the single fused top-k over beam*vocab is cheaper than
+    host-side pruning).  `ids` is accepted for signature parity and ignored;
+    `level` is meaningless without LoD.
+
+    For the first step feed pre_scores as [0, -inf, -inf, ...] per sentence
+    so identical beams don't fill the whole top-k.
+
+    Returns (selected_ids, selected_scores[, parent_idx]) — each
+    [batch, beam_size].
+    """
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference("int64")
+    sel_scores = helper.create_variable_for_type_inference("float32")
+    parent_idx = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "beam_search",
+        inputs={
+            "PreIds": [pre_ids],
+            "PreScores": [pre_scores],
+            "Scores": [scores],
+        },
+        outputs={
+            "SelectedIds": [sel_ids],
+            "SelectedScores": [sel_scores],
+            "ParentIdx": [parent_idx],
+        },
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level},
+    )
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent_idx
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, parents=None,
+                       num_steps=None, name=None):
+    """Backtrack beam-search steps into full hypotheses (reference:
+    python/paddle/fluid/layers/nn.py:3946, beam_search_decode_op.cc:1).
+
+    `ids` is the stacked tensor-array of selected ids [T, batch, beam] and
+    `parents` the matching stacked ParentIdx steps (the reference encodes
+    parents implicitly in LoD; dense beams need them explicit).  `scores`
+    is the FINAL [batch, beam] cumulative score tensor.  `num_steps`
+    (optional [1] int) masks unused array slack.
+
+    Returns (sentence_ids [batch, beam, T] int64 end_id-padded,
+    sentence_scores [batch, beam]).
+    """
+    if parents is None:
+        raise ValueError(
+            "beam_search_decode: dense beams need `parents` (the stacked "
+            "ParentIdx array from beam_search)")
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_variable_for_type_inference("int64")
+    sent_scores = helper.create_variable_for_type_inference("float32")
+    inputs = {"Ids": [ids], "Parents": [parents], "Scores": [scores]}
+    if num_steps is not None:
+        inputs["NumSteps"] = [num_steps]
+    helper.append_op(
+        "beam_search_decode",
+        inputs=inputs,
+        outputs={"SentenceIds": [sent_ids], "SentenceScores": [sent_scores]},
+        attrs={"beam_size": beam_size, "end_id": end_id},
+    )
+    return sent_ids, sent_scores

@@ -448,3 +448,17 @@ def less_than(x, y, cond=None):
         cond = helper.create_variable_for_type_inference("bool")
     helper.append_op("less_than", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]})
     return cond
+
+
+def take_along_axis(input, index, axis=0):
+    """Batched gather along `axis` (numpy semantics); see
+    ops/tensor_ops.py take_along_axis."""
+    helper = LayerHelper("take_along_axis")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "take_along_axis",
+        inputs={"X": [input], "Index": [index]},
+        outputs={"Out": [out]},
+        attrs={"axis": axis},
+    )
+    return out

@@ -292,7 +292,9 @@ def transformer(
     )
 
     predict = layers.fc(input=dec_output, size=trg_vocab_size,
-                        num_flatten_dims=2)
+                        num_flatten_dims=2,
+                        param_attr=ParamAttr(name="predict_w"),
+                        bias_attr=ParamAttr(name="predict_b"))
     b, t, v = predict.shape
     predict_2d = layers.reshape(predict, [-1, v])
     gold_2d = layers.reshape(gold, [-1, 1])
@@ -344,3 +346,176 @@ def make_batch(batch_size, src_len, trg_len, n_head, src_vocab, trg_vocab,
         batch["trg_src_attn_bias"] = np.zeros(
             (batch_size, n_head, trg_len, src_len), "float32")
     return batch
+
+
+def _log_softmax(x, axis_dim):
+    """logits [.., V] -> log-probs, numerically stable, built from layer ops."""
+    m = layers.reduce_max(x, dim=axis_dim, keep_dim=True)
+    shifted = layers.elementwise_sub(x, m)
+    lse = layers.log(
+        layers.reduce_sum(layers.exp(shifted), dim=axis_dim, keep_dim=True))
+    return layers.elementwise_sub(shifted, lse)
+
+
+def build_decoder(
+    src_vocab_size=10000,
+    trg_vocab_size=10000,
+    max_length=256,
+    n_layer=6,
+    n_head=8,
+    d_key=64,
+    d_value=64,
+    d_model=512,
+    d_inner_hid=2048,
+    batch_size=4,
+    src_seq_len=None,
+    max_out_len=16,
+    beam_size=4,
+    bos_id=0,
+    eos_id=1,
+    use_flash=False,
+):
+    """Beam-search inference net (reference:
+    tests/book/test_machine_translation.py decode + layers.beam_search
+    nn.py:3833).  Shares parameter names with `transformer(...)` so a scope
+    trained with the train net decodes directly.
+
+    TPU-first shape: beams are a static [batch, beam] lane; the While loop
+    compiles to one XLA while_loop; each step re-runs the causal decoder
+    over the static [T+1]-padded prefix (no KV cache — at book-test scale
+    recompute is cheaper than carrying cache state through the loop; the
+    serving path amortizes via Predictor AOT caching).
+
+    Returns (sentence_ids [b, beam, T], sentence_scores [b, beam],
+    feed_names).
+    """
+    src_seq_len = src_seq_len or max_length
+    t_buf = max_out_len + 1  # position 0 is BOS
+    b, k = batch_size, beam_size
+    bk = b * k
+
+    src_word = layers.data(name="src_word", shape=[src_seq_len, 1],
+                           dtype="int64")
+    src_pos = layers.data(name="src_pos", shape=[src_seq_len, 1],
+                          dtype="int64")
+
+    # ---- encoder (runs once, before the loop) ---------------------------
+    neg_inf = -1e9
+    zero = layers.fill_constant([1], "int64", 0)
+    is_pad = layers.cast(layers.equal(src_word, zero), "float32")
+    src_bias = layers.reshape(layers.scale(is_pad, scale=neg_inf),
+                              [-1, 1, 1, src_seq_len])
+    src_bias.stop_gradient = True
+    enc_input = prepare_encoder(
+        src_word, src_pos, src_vocab_size, d_model, max_length,
+        word_emb_param_name="src_word_emb_table",
+        pos_enc_param_name="src_pos_enc_table",
+    )
+    enc_output = encoder(
+        enc_input, src_bias, n_layer, n_head, d_key, d_value, d_model,
+        d_inner_hid, use_flash=use_flash,
+    )
+    # tile per beam: [b, Ts, d] -> [b*k, Ts, d] (beam-major within batch)
+    enc_output = layers.reshape(
+        layers.expand(
+            layers.reshape(enc_output, [b, 1, src_seq_len, d_model]),
+            [1, k, 1, 1],
+        ),
+        [bk, src_seq_len, d_model],
+    )
+    src_bias_bk = layers.reshape(
+        layers.expand(layers.reshape(src_bias, [b, 1, 1, 1, src_seq_len]),
+                      [1, k, 1, 1, 1]),
+        [bk, 1, 1, src_seq_len],
+    )
+
+    # causal self-attention bias over the prefix buffer: [1, 1, T, T]
+    ones_t = layers.fill_constant([t_buf, 1], "float32", 1.0)
+    arange_t = layers.elementwise_sub(
+        layers.cumsum(ones_t, axis=0), ones_t)  # [T,1] = 0..T-1
+    qpos = layers.reshape(arange_t, [1, t_buf, 1])
+    kpos = layers.reshape(arange_t, [1, 1, t_buf])
+    future = layers.cast(layers.less_than(qpos, kpos), "float32")
+    causal_bias = layers.reshape(layers.scale(future, scale=neg_inf),
+                                 [1, 1, t_buf, t_buf])
+    causal_bias.stop_gradient = True
+
+    trg_pos_ids = layers.cast(
+        layers.expand(layers.reshape(arange_t, [1, t_buf, 1]), [bk, 1, 1]),
+        "int64")
+
+    # ---- loop state -----------------------------------------------------
+    t = layers.fill_constant([1], "int64", 0)
+    limit = layers.fill_constant([1], "int64", max_out_len)
+    cond = layers.less_than(t, limit)
+
+    pre_ids = layers.fill_constant([b, k], "int64", bos_id)
+    beam0 = layers.one_hot(layers.fill_constant([1], "int64", 0), k)  # [k]
+    pre_scores = layers.expand(
+        layers.reshape(layers.scale(beam0, scale=1e9, bias=neg_inf),
+                       [1, k]),
+        [b, 1],
+    )  # beam 0 -> 0, others -> -1e9
+    prefix = layers.fill_constant([b, k, t_buf], "int64", bos_id)
+
+    ids_arr = layers.create_array("int64", element_shape=[b, k],
+                                  capacity=max_out_len)
+    parents_arr = layers.create_array("int64", element_shape=[b, k],
+                                      capacity=max_out_len)
+
+    w = layers.While(cond)
+    with w.block():
+        dec_input = prepare_encoder(
+            layers.reshape(prefix, [bk, t_buf, 1]), trg_pos_ids,
+            trg_vocab_size, d_model, max_length,
+            word_emb_param_name="trg_word_emb_table",
+            pos_enc_param_name="trg_pos_enc_table",
+        )
+        dec_output = decoder(
+            dec_input, enc_output, causal_bias, src_bias_bk,
+            n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
+            use_flash=use_flash,
+        )
+        logits = layers.fc(input=dec_output, size=trg_vocab_size,
+                           num_flatten_dims=2,
+                           param_attr=ParamAttr(name="predict_w"),
+                           bias_attr=ParamAttr(name="predict_b"))
+        # logits at position t: [bk, T, V] -> [bk, V]
+        t_idx = layers.cast(
+            layers.expand(layers.reshape(t, [1, 1, 1]),
+                          [bk, 1, trg_vocab_size]),
+            "int64")
+        step_logits = layers.reshape(
+            layers.take_along_axis(logits, t_idx, axis=1),
+            [b, k, trg_vocab_size])
+        log_probs = _log_softmax(step_logits, axis_dim=2)
+
+        sel_ids, sel_scores, parent_idx = layers.beam_search(
+            pre_ids, pre_scores, None, log_probs, beam_size=k,
+            end_id=eos_id)
+
+        # reorder prefixes by parent beam, write new token at position t+1
+        par3 = layers.expand(layers.reshape(parent_idx, [b, k, 1]),
+                             [1, 1, t_buf])
+        prefix_re = layers.take_along_axis(prefix, par3, axis=1)
+        tpos = layers.increment(layers.assign(t), value=1.0, in_place=False)
+        oh = layers.one_hot(tpos, t_buf)  # [T] f32, 1 at position t+1
+        keep = layers.elementwise_mul(
+            layers.cast(prefix_re, "float32"),
+            layers.scale(oh, scale=-1.0, bias=1.0))
+        put = layers.elementwise_mul(
+            layers.cast(layers.reshape(sel_ids, [b, k, 1]), "float32"), oh)
+        new_prefix = layers.cast(layers.elementwise_add(keep, put), "int64")
+
+        layers.array_write(sel_ids, t, array=ids_arr)
+        layers.array_write(parent_idx, t, array=parents_arr)
+        layers.assign(new_prefix, output=prefix)
+        layers.assign(sel_ids, output=pre_ids)
+        layers.assign(sel_scores, output=pre_scores)
+        layers.increment(t, value=1.0, in_place=True)
+        layers.less_than(t, limit, cond=cond)
+
+    sent_ids, sent_scores = layers.beam_search_decode(
+        ids_arr, pre_scores, beam_size=k, end_id=eos_id,
+        parents=parents_arr)
+    return sent_ids, sent_scores, ["src_word", "src_pos"]

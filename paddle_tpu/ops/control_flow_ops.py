@@ -165,3 +165,97 @@ def lower_array_length(ctx, ins):
     import jax.numpy as jnp
 
     return {"Out": [jnp.asarray([ins["X"][0].shape[0]], jnp.int64)]}
+
+
+@register("beam_search", no_grad=True)
+def lower_beam_search(ctx, ins):
+    """One dense beam-search step (reference: operators/beam_search_op.cc:1,
+    layer at python/paddle/fluid/layers/nn.py:3833).
+
+    TPU-first redesign: the reference prunes ragged LoD beams on the host;
+    here beams are a STATIC [batch, beam] lane so the whole decode loop
+    compiles into one XLA while-loop.  Finished beams (last id == end_id)
+    admit only the end_id continuation at their frozen score, so they ride
+    along in the top-k instead of being pruned.
+
+    Inputs: PreIds [b, beam] int, PreScores [b, beam] f32 (cumulative
+    log-prob), Scores [b, beam, V] f32 (log-probs of the next token).
+    Outputs: SelectedIds/SelectedScores/ParentIdx, each [b, beam].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    pre_ids = ins["PreIds"][0]
+    pre_scores = ins["PreScores"][0]
+    scores = ins["Scores"][0]
+    end_id = ctx.attr("end_id", 1)
+    b, k, v = scores.shape
+    beam_size = ctx.attr("beam_size", k)
+
+    finished = pre_ids.reshape(b, k) == end_id
+    cand = pre_scores.reshape(b, k, 1).astype(jnp.float32) + scores
+    # finished beams: only end_id continues, score frozen
+    vocab_iota = jnp.arange(v)
+    frozen = jnp.where(
+        vocab_iota[None, None, :] == end_id,
+        pre_scores.reshape(b, k, 1).astype(jnp.float32),
+        -1e30,
+    )
+    cand = jnp.where(finished[:, :, None], frozen, cand)
+    top_scores, top_idx = jax.lax.top_k(cand.reshape(b, k * v), beam_size)
+    parent = (top_idx // v).astype(jnp.int64)
+    token = (top_idx % v).astype(jnp.int64)
+    return {
+        "SelectedIds": [token],
+        "SelectedScores": [top_scores],
+        "ParentIdx": [parent],
+    }
+
+
+@register("beam_search_decode", no_grad=True)
+def lower_beam_search_decode(ctx, ins):
+    """Backtrack stored (token, parent) steps into full hypotheses
+    (reference: operators/beam_search_decode_op.cc:1).
+
+    The reference walks LoD sentence trees on the host; here a reverse
+    lax.scan gathers through the parent pointers, so decode stays on device
+    and jit-compiles.  Steps at t >= NumSteps (array slack) are ignored.
+
+    Inputs: Ids [T, b, beam] (stacked tensor-array of selected ids),
+    Parents [T, b, beam] (stacked ParentIdx), Scores [b, beam] final
+    cumulative scores, NumSteps [1] int.
+    Outputs: SentenceIds [b, beam, T] int64 (end_id-padded), SentenceScores
+    [b, beam] f32.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ids = ins["Ids"][0]
+    parents = ins["Parents"][0]
+    scores = ins["Scores"][0]
+    end_id = ctx.attr("end_id", 1)
+    t_cap, b, k = ids.shape
+    if "NumSteps" in ins and ins["NumSteps"]:
+        n_steps = ins["NumSteps"][0].reshape(()).astype(jnp.int32)
+    else:
+        n_steps = jnp.int32(t_cap)
+
+    def step(beam_idx, xs):
+        ids_t, par_t, t = xs
+        tok = jnp.take_along_axis(ids_t, beam_idx, axis=1)
+        par = jnp.take_along_axis(par_t, beam_idx, axis=1)
+        valid = t < n_steps
+        tok = jnp.where(valid, tok, jnp.full_like(tok, end_id))
+        par = jnp.where(valid, par, beam_idx)
+        return par, tok
+
+    ts = jnp.arange(t_cap - 1, -1, -1, jnp.int32)
+    init = jnp.broadcast_to(jnp.arange(k, dtype=parents.dtype), (b, k))
+    _, toks = jax.lax.scan(
+        step, init, (ids[::-1], parents[::-1], ts)
+    )
+    sent = jnp.flip(toks, axis=0).transpose(1, 2, 0).astype(jnp.int64)
+    return {
+        "SentenceIds": [sent],
+        "SentenceScores": [scores],
+    }

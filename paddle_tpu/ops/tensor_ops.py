@@ -373,7 +373,19 @@ def lower_scatter(ctx, ins):
     return {"Out": [out]}
 
 
-@register("expand")
+def _expand_infer(ctx):
+    xs = ctx.input_shape("X")
+    times = ctx.attr("expand_times")
+    if xs is None or times is None:
+        return
+    ctx.set_output(
+        "Out",
+        tuple(int(s) * int(t) for s, t in zip(xs, times)),
+        ctx.input_dtype("X"),
+    )
+
+
+@register("expand", infer_shape=_expand_infer)
 def lower_expand(ctx, ins):
     jnp = _jnp()
     x = ins["X"][0]
@@ -461,7 +473,10 @@ def lower_space_to_depth(ctx, ins):
 
 @register("increment")
 def lower_increment(ctx, ins):
-    return {"Out": [ins["X"][0] + ctx.attr("step", 1.0)]}
+    x = ins["X"][0]
+    # keep the var's dtype: int counters must not promote to float
+    step = _jnp().asarray(ctx.attr("step", 1.0), dtype=x.dtype)
+    return {"Out": [x + step]}
 
 
 @register("isfinite", no_grad=True)
@@ -472,3 +487,23 @@ def lower_isfinite(ctx, ins):
     for v in vals:
         ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(v.astype(jnp.float32))))
     return {"Out": [ok]}
+
+
+def _take_along_axis_infer(ctx):
+    idx = ctx.input_shape("Index")
+    if idx is None:
+        return
+    ctx.set_output("Out", tuple(idx), ctx.input_dtype("X"))
+
+
+@register("take_along_axis", infer_shape=_take_along_axis_infer)
+def lower_take_along_axis(ctx, ins):
+    """Batched gather: out[..., i, ...] = x[..., idx[..., i, ...], ...]
+    along `axis` (numpy take_along_axis semantics).  The reference's closest
+    op is gather (gather_op.cc) which only indexes dim 0; beam-search
+    hypothesis reordering needs the batched form, and XLA lowers it to one
+    fused gather (grad = scatter-add via the default vjp maker)."""
+    jnp = _jnp()
+    x, idx = ins["X"][0], ins["Index"][0]
+    axis = ctx.attr("axis", 0)
+    return {"Out": [jnp.take_along_axis(x, idx, axis=axis)]}

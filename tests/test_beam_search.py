@@ -1,0 +1,168 @@
+"""Beam search step + decode ops and end-to-end transformer decoding
+(reference: operators/beam_search_op.cc, beam_search_decode_op.cc,
+layers.beam_search nn.py:3833, tests/book/test_machine_translation.py)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import framework as fw
+
+
+def test_take_along_axis():
+    x = layers.data(name="x", shape=[3, 5], dtype="float32")
+    idx = layers.data(name="idx", shape=[3, 2], dtype="int64")
+    out = layers.take_along_axis(x, idx, axis=2)
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.random.rand(2, 3, 5).astype("float32")
+    iv = np.random.randint(0, 5, (2, 3, 2)).astype("int64")
+    (o,) = exe.run(feed={"x": xv, "idx": iv}, fetch_list=[out])
+    np.testing.assert_allclose(o, np.take_along_axis(xv, iv, axis=2))
+
+
+def test_beam_search_step_selects_topk():
+    b, k, v = 2, 3, 7
+    pre_ids = layers.data(name="pre_ids", shape=[k], dtype="int64")
+    pre_scores = layers.data(name="pre_scores", shape=[k], dtype="float32")
+    scores = layers.data(name="scores", shape=[k, v], dtype="float32")
+    sel_ids, sel_scores, parent = layers.beam_search(
+        pre_ids, pre_scores, None, scores, beam_size=k, end_id=1)
+    exe = pt.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(3)
+    pi = np.full((b, k), 5, "int64")  # nothing finished (end_id=1)
+    ps = rng.randn(b, k).astype("float32")
+    sc = np.log(
+        rng.dirichlet(np.ones(v), size=(b, k)).astype("float32"))
+    si, ss, pa = exe.run(
+        feed={"pre_ids": pi, "pre_scores": ps, "scores": sc},
+        fetch_list=[sel_ids, sel_scores, parent])
+    # numpy reference: top-k over flattened beam*vocab accumulations
+    cand = ps[:, :, None] + sc
+    flat = cand.reshape(b, k * v)
+    order = np.argsort(-flat, axis=1)[:, :k]
+    np.testing.assert_allclose(
+        ss, np.take_along_axis(flat, order, 1), rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(pa), order // v)
+    np.testing.assert_array_equal(np.asarray(si), order % v)
+    # scores sorted descending
+    assert np.all(np.diff(np.asarray(ss), axis=1) <= 1e-6)
+
+
+def test_beam_search_finished_beams_freeze():
+    b, k, v = 1, 2, 5
+    end_id = 1
+    pre_ids = layers.data(name="pre_ids", shape=[k], dtype="int64")
+    pre_scores = layers.data(name="pre_scores", shape=[k], dtype="float32")
+    scores = layers.data(name="scores", shape=[k, v], dtype="float32")
+    sel_ids, sel_scores, parent = layers.beam_search(
+        pre_ids, pre_scores, None, scores, beam_size=k, end_id=end_id)
+    exe = pt.Executor(pt.CPUPlace())
+    # beam 0 finished with a high score; beam 1 alive with low scores
+    pi = np.array([[end_id, 3]], "int64")
+    ps = np.array([[-0.5, -4.0]], "float32")
+    sc = np.log(np.full((1, k, v), 1.0 / v, "float32"))
+    si, ss, pa = exe.run(
+        feed={"pre_ids": pi, "pre_scores": ps, "scores": sc},
+        fetch_list=[sel_ids, sel_scores, parent])
+    si, ss, pa = np.asarray(si), np.asarray(ss), np.asarray(pa)
+    # the finished beam survives as (end_id, frozen score) at rank 0
+    assert si[0, 0] == end_id
+    np.testing.assert_allclose(ss[0, 0], -0.5, rtol=1e-6)
+    assert pa[0, 0] == 0
+    # second-best is a real continuation of beam 1
+    assert pa[0, 1] == 1
+    np.testing.assert_allclose(ss[0, 1], -4.0 + np.log(1.0 / 5), rtol=1e-5)
+
+
+def test_beam_search_decode_backtracks():
+    t, b, k = 3, 1, 2
+    ids = layers.data(name="ids", shape=[b, k], dtype="int64")
+    parents = layers.data(name="parents", shape=[b, k], dtype="int64")
+    fin = layers.data(name="fin", shape=[k], dtype="float32")
+    # feed stacked [T, b, k] arrays directly (they mimic stacked arrays)
+    sent, sscores = layers.beam_search_decode(
+        ids, fin, beam_size=k, end_id=1, parents=parents)
+    exe = pt.Executor(pt.CPUPlace())
+    # step0: beams pick tokens [4, 7]; step1 tokens [5, 6] with parents
+    # [1, 0] (beams swap); step2 tokens [8, 9], parents [0, 1]
+    ids_v = np.array([[[4, 7]], [[5, 6]], [[8, 9]]], "int64")
+    par_v = np.array([[[0, 1]], [[1, 0]], [[0, 1]]], "int64")
+    fin_v = np.array([[-1.0, -2.0]], "float32")
+    s, sc = exe.run(
+        feed={"ids": ids_v, "parents": par_v, "fin": fin_v},
+        fetch_list=[sent, sscores])
+    s = np.asarray(s)
+    # final beam 0: token 8 at t2, parent 0 -> t1 token 5, parent 1 -> t0
+    # token 7.  final beam 1: 9 <- t1 token 6 (parent idx 1... par[2,1]=1)
+    np.testing.assert_array_equal(s[0, 0], [7, 5, 8])
+    np.testing.assert_array_equal(s[0, 1], [4, 6, 9])
+    np.testing.assert_allclose(np.asarray(sc)[0], fin_v[0])
+
+
+def _copy_task_batch(rng, batch, seq, vocab, bos, eos):
+    """src tokens in [2, vocab); target = src (copy task)."""
+    src = rng.randint(2, vocab, (batch, seq, 1)).astype("int64")
+    pos = np.tile(np.arange(seq, dtype=np.int64)[None, :, None],
+                  (batch, 1, 1))
+    # decoder input: [bos, src[0.. seq-1]]; label: [src[0..], eos-ish]
+    trg_in = np.concatenate([np.full((batch, 1, 1), bos, "int64"),
+                             src[:, :-1]], axis=1)
+    lbl = src.copy()
+    weights = np.ones((batch, seq, 1), "float32")
+    return {
+        "src_word": src, "src_pos": pos,
+        "trg_word": trg_in, "trg_pos": pos,
+        "lbl_word": lbl, "lbl_weight": weights,
+    }, src
+
+
+def test_transformer_beam_decode_end_to_end():
+    """Train a tiny transformer on the copy task, then beam-decode through
+    the in-program While loop and check it reproduces the source."""
+    from paddle_tpu.models import transformer as T
+
+    vocab, seq, bs = 16, 6, 32
+    dims = dict(
+        src_vocab_size=vocab, trg_vocab_size=vocab, max_length=seq + 2,
+        n_layer=1, n_head=2, d_key=16, d_value=16, d_model=32,
+        d_inner_hid=64,
+    )
+    rng = np.random.RandomState(0)
+
+    train_prog, train_startup = pt.Program(), pt.Program()
+    with fw.guard_unique_name():
+        with pt.program_guard(train_prog, train_startup):
+            avg_cost, _, _ = T.transformer(
+                batch_size=bs, src_seq_len=seq, trg_seq_len=seq,
+                dropout_rate=0.0, **dims)
+            pt.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(avg_cost)
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(train_startup)
+    losses = []
+    for i in range(120):
+        feed, _ = _copy_task_batch(rng, bs, seq, vocab, bos=0, eos=1)
+        (lv,) = exe.run(train_prog, feed=feed, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    dec_b, beam = 4, 3
+    dec_prog, dec_startup = pt.Program(), pt.Program()
+    with fw.guard_unique_name():
+        with pt.program_guard(dec_prog, dec_startup):
+            sent, scores, feeds = T.build_decoder(
+                batch_size=dec_b, src_seq_len=seq, max_out_len=seq,
+                beam_size=beam, bos_id=0, eos_id=1, **dims)
+
+    feed, src = _copy_task_batch(rng, dec_b, seq, vocab, bos=0, eos=1)
+    s, sc = exe.run(
+        dec_prog,
+        feed={"src_word": feed["src_word"], "src_pos": feed["src_pos"]},
+        fetch_list=[sent, scores])
+    s, sc = np.asarray(s), np.asarray(sc)
+    assert s.shape == (dec_b, beam, seq)
+    # beam scores sorted best-first
+    assert np.all(np.diff(sc, axis=1) <= 1e-5)
+    # the trained model should mostly copy the source on beam 0
+    acc = float((s[:, 0, :] == src[:, :, 0]).mean())
+    assert acc > 0.55, (acc, s[:, 0], src[:, :, 0])
