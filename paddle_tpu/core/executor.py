@@ -277,6 +277,7 @@ _RANDOM_OPS = frozenset(
         "sampling_id",
         "random_crop",
         "shuffle_batch",
+        "nce",  # draws negative samples from the trace key
     }
 )
 

@@ -814,3 +814,114 @@ def beam_search_decode(ids, scores, beam_size, end_id, parents=None,
         attrs={"beam_size": beam_size, "end_id": end_id},
     )
     return sent_ids, sent_scores
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation (reference: python/paddle/fluid/layers/
+    nn.py nce, operators/nce_op.cc:1).  Only the uniform sampler is
+    implemented (custom_dist/log_uniform fall back to it); is_sparse is
+    accepted for parity but grads are dense."""
+    helper = LayerHelper("nce", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dim = input.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr(), shape=[num_total_classes, dim],
+        dtype=input.dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if helper.bias_attr() is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr(), shape=[num_total_classes],
+            dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    cost = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "nce",
+        inputs=inputs,
+        outputs={"Cost": [cost]},
+        attrs={
+            "num_total_classes": num_total_classes,
+            "num_neg_samples": num_neg_samples or 10,
+            "seed": seed,
+        },
+    )
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: layers/nn.py hsigmoid, hierarchical_sigmoid_op.cc:1).
+    Custom trees (path_table/path_code) are not implemented."""
+    if is_custom or path_table is not None or path_code is not None:
+        raise NotImplementedError("hsigmoid: custom trees not implemented")
+    helper = LayerHelper("hsigmoid", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dim = input.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr(), shape=[num_classes - 1, dim],
+        dtype=input.dtype)
+    inputs = {"X": [input], "Label": [label], "W": [w]}
+    if helper.bias_attr() is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr(), shape=[num_classes - 1],
+            dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "hierarchical_sigmoid",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"num_classes": num_classes},
+    )
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """Linear-chain CRF NLL (reference: layers/nn.py linear_chain_crf,
+    linear_chain_crf_op.cc:1).  Dense form: input [b, T, n] emissions +
+    label [b, T] + optional length [b] (the reference reads LoD).  Returns
+    the per-sequence negative log-likelihood [b, 1]."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    n_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr(), shape=[n_tags + 2, n_tags],
+        dtype=input.dtype)
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    ll = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "linear_chain_crf",
+        inputs=inputs,
+        outputs={"LogLikelihood": [ll]},
+    )
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode with the CRF transition param (reference: layers/nn.py
+    crf_decoding, crf_decoding_op.cc:1).  param_attr must name the SAME
+    transition parameter linear_chain_crf created."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    n_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr(), shape=[n_tags + 2, n_tags],
+        dtype=input.dtype)
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    if length is not None:
+        inputs["Length"] = [length]
+    path = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "crf_decoding",
+        inputs=inputs,
+        outputs={"ViterbiPath": [path]},
+    )
+    return path

@@ -671,3 +671,115 @@ def lower_nearest_interp(ctx, ins):
     n, c, h, w = x.shape
     out = jax.image.resize(x, (n, c, oh, ow), method="nearest")
     return {"Out": [out]}
+
+
+@register("nce", no_grad=False)
+def lower_nce(ctx, ins):
+    """Noise-contrastive estimation loss (reference: operators/nce_op.cc:1,
+    nce_op.h ComputeCost).
+
+    Per sample with scores s_c = x.w_c + b_c and uniform noise q = 1/V:
+      cost = sum_true -log sigma(s_y - log(k q))
+           + sum_{k sampled} -log(1 - sigma(s_i - log(k q)))
+    (the sigma(s - log kq) form equals the reference's o/(o + kq)).
+
+    TPU-first: negatives are drawn inside the compiled step from the
+    executor's threefry key (reproducible, no host RNG round-trip); only
+    true+sampled weight rows are gathered so the [V, d] table never enters
+    the matmul.  Dense grads (the reference's is_sparse variant maps to
+    SelectedRows — the embedding path covers that pattern).
+    Inputs: Input [b,d], Label [b,num_true], Weight [V,d], Bias [V] (opt).
+    Output: Cost [b,1].
+    """
+    import jax
+    jnp = _jnp()
+
+    x = ins["Input"][0]
+    label = ins["Label"][0]
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    num_classes = ctx.attr("num_total_classes", w.shape[0])
+    k = ctx.attr("num_neg_samples", 10)
+
+    b = x.shape[0]
+    if label.ndim == 1:
+        label = label[:, None]
+    num_true = label.shape[1]
+    label = label.astype(jnp.int32)
+
+    samples = jax.random.randint(_nce_key(ctx), (b, k), 0, num_classes)
+    cand = jnp.concatenate([label, samples], axis=1)  # [b, num_true + k]
+
+    w_rows = jnp.take(w, cand.reshape(-1), axis=0).reshape(
+        b, num_true + k, -1)
+    logits = jnp.einsum("bd,bcd->bc", x.astype(jnp.float32),
+                        w_rows.astype(jnp.float32))
+    if bias is not None:
+        logits = logits + jnp.take(
+            bias.reshape(-1).astype(jnp.float32), cand.reshape(-1)
+        ).reshape(b, num_true + k)
+    # uniform sampler correction: log(k * 1/V)
+    logits = logits - jnp.log(k / num_classes)
+    pos = logits[:, :num_true]
+    neg = logits[:, num_true:]
+    # -log sigmoid(pos) + -log(1 - sigmoid(neg)), in softplus form
+    cost = (jax.nn.softplus(-pos).sum(axis=1)
+            + jax.nn.softplus(neg).sum(axis=1))
+    if ins.get("SampleWeight"):
+        cost = cost * ins["SampleWeight"][0].reshape(-1)
+    return {"Cost": [cost[:, None]]}
+
+
+def _nce_key(ctx):
+    import jax
+
+    seed = ctx.attr("seed", 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.next_rng_key()
+
+
+@register("hierarchical_sigmoid", no_grad=False)
+def lower_hierarchical_sigmoid(ctx, ins):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: operators/hierarchical_sigmoid_op.cc:1 +
+    math/matrix_bit_code.h).
+
+    Leaf for class c is heap node n = c + V; its ancestors n >> (j+1) (while
+    >= 1) index rows of W ([V-1, d]); bit j of n picks the branch.  Loss is
+    sum over the path of softplus((1 - 2 bit) * z) with z = x.w_row + b_row
+    — all paths are walked at the static max depth with a validity mask, so
+    XLA sees one fused [b, L, d] gather+einsum instead of the reference's
+    per-sample bit-code loop.
+    Inputs: X [b,d], Label [b,1], W [V-1,d], Bias [V-1] (opt).
+    Output: Out [b,1] cost.
+    """
+    import jax
+    jnp = _jnp()
+
+    x = ins["X"][0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    w = ins["W"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    num_classes = ctx.attr("num_classes", w.shape[0] + 1)
+
+    n = label + num_classes  # heap leaf id, root = 1
+    depth = int(2 * num_classes - 1).bit_length() - 1  # static max path len
+
+    js = jnp.arange(depth)
+    anc = n[:, None] >> (js[None, :] + 1)          # [b, L]
+    valid = anc >= 1
+    row = jnp.clip(anc - 1, 0, num_classes - 2)
+    bit = (n[:, None] >> js[None, :]) & 1
+
+    w_rows = jnp.take(w, row.reshape(-1), axis=0).reshape(
+        label.shape[0], depth, -1)
+    z = jnp.einsum("bd,bld->bl", x.astype(jnp.float32),
+                   w_rows.astype(jnp.float32))
+    if bias is not None:
+        z = z + jnp.take(
+            bias.reshape(-1).astype(jnp.float32), row.reshape(-1)
+        ).reshape(label.shape[0], depth)
+    per_node = jax.nn.softplus((1.0 - 2.0 * bit) * z)
+    cost = jnp.where(valid, per_node, 0.0).sum(axis=1)
+    return {"Out": [cost[:, None]]}

@@ -246,3 +246,138 @@ def lower_edit_distance(ctx, ins):
         "Out": [dist.reshape(-1, 1)],
         "SequenceNum": [jnp.asarray([b], jnp.int64)],
     }
+
+
+def _crf_unpack(transition):
+    """Transition param [(n+2), n]: row 0 start weights, row 1 stop weights,
+    rows 2.. the [n, n] transition matrix (reference linear_chain_crf_op.h
+    layout)."""
+    return transition[0], transition[1], transition[2:]
+
+
+@register("linear_chain_crf", no_grad=False)
+def lower_linear_chain_crf(ctx, ins):
+    """Linear-chain CRF negative log-likelihood (reference:
+    operators/linear_chain_crf_op.cc:1).
+
+    Dense TPU form: Emission [b, T, n] + Label [b, T(,1)] + optional Length
+    [b] replace the reference's LoD ragged batch; the forward algorithm is a
+    lax.scan of masked log-sum-exp steps, so the whole loss jit-compiles
+    (the reference walks sequences one by one on the host).  Gradients come
+    from the generic vjp (the reference hand-derives alpha/beta recursions).
+
+    Output LogLikelihood [b, 1] is the NEGATIVE log-likelihood (what the
+    book label_semantic_roles model minimizes directly).
+    """
+    import jax
+    jnp = _jnp()
+
+    emission = ins["Emission"][0].astype(jnp.float32)
+    transition = ins["Transition"][0].astype(jnp.float32)
+    label = ins["Label"][0]
+    b, t_max, n = emission.shape
+    label = label.reshape(b, t_max).astype(jnp.int32)
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((b,), t_max, jnp.int32)
+    mask = (jnp.arange(t_max)[None, :] < length[:, None])  # [b, T] bool
+
+    start, stop, trans = _crf_unpack(transition)
+
+    # ---- score of the gold path ----------------------------------------
+    emit_scores = jnp.take_along_axis(
+        emission, label[:, :, None], axis=2)[:, :, 0]  # [b, T]
+    gold_emit = jnp.where(mask, emit_scores, 0.0).sum(axis=1)
+    gold_start = jnp.take(start, label[:, 0])
+    last_idx = jnp.maximum(length - 1, 0)
+    last_label = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    gold_stop = jnp.take(stop, last_label)
+    pair_scores = trans[label[:, :-1], label[:, 1:]]  # [b, T-1]
+    pair_mask = mask[:, 1:]
+    gold_trans = jnp.where(pair_mask, pair_scores, 0.0).sum(axis=1)
+    gold = gold_start + gold_emit + gold_trans + gold_stop
+
+    # ---- partition function (forward algorithm) -------------------------
+    alpha0 = start[None, :] + emission[:, 0, :]  # [b, n]
+
+    def step(alpha, xs):
+        emit_t, mask_t = xs  # [b, n], [b]
+        nxt = jax.nn.logsumexp(
+            alpha[:, :, None] + trans[None, :, :], axis=1) + emit_t
+        alpha = jnp.where(mask_t[:, None], nxt, alpha)
+        return alpha, None
+
+    alpha, _ = jax.lax.scan(
+        step, alpha0,
+        (emission[:, 1:].transpose(1, 0, 2), mask[:, 1:].T),
+    )
+    log_z = jax.nn.logsumexp(alpha + stop[None, :], axis=1)
+
+    nll = log_z - gold
+    return {"LogLikelihood": [nll[:, None]]}
+
+
+@register("crf_decoding", no_grad=True)
+def lower_crf_decoding(ctx, ins):
+    """Viterbi decoding for the linear-chain CRF (reference:
+    operators/crf_decoding_op.cc:1).
+
+    Same dense layout as linear_chain_crf; the max-product recursion and
+    the backtrack are both lax.scans, fully on device.  Without Label the
+    output is the decoded tag path [b, T] (zeros past Length); with Label
+    it is the per-position correctness indicator the reference emits.
+    """
+    import jax
+    jnp = _jnp()
+
+    emission = ins["Emission"][0].astype(jnp.float32)
+    transition = ins["Transition"][0].astype(jnp.float32)
+    b, t_max, n = emission.shape
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((b,), t_max, jnp.int32)
+    mask = (jnp.arange(t_max)[None, :] < length[:, None])
+
+    start, stop, trans = _crf_unpack(transition)
+
+    delta0 = start[None, :] + emission[:, 0, :]
+
+    def fwd(delta, xs):
+        emit_t, mask_t = xs
+        cand = delta[:, :, None] + trans[None, :, :]  # [b, prev, cur]
+        best_prev = jnp.argmax(cand, axis=1)          # [b, cur]
+        nxt = jnp.max(cand, axis=1) + emit_t
+        new_delta = jnp.where(mask_t[:, None], nxt, delta)
+        return new_delta, best_prev
+
+    delta, back = jax.lax.scan(
+        fwd, delta0,
+        (emission[:, 1:].transpose(1, 0, 2), mask[:, 1:].T),
+    )  # back: [T-1, b, n]
+
+    # stop weights apply to each sequence's final alive delta
+    final = delta + stop[None, :]
+    last_tag = jnp.argmax(final, axis=1).astype(jnp.int32)  # [b]
+
+    def backtrack(tag, xs):
+        back_t, t = xs  # [b, n], scalar time (row back_t maps t -> t+1)
+        prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+        # only backtrack while t+1 < length (inside the sequence)
+        keep = (t + 1) < length
+        new_tag = jnp.where(keep, prev.astype(jnp.int32), tag)
+        return new_tag, new_tag
+
+    rev_ts = jnp.arange(t_max - 2, -1, -1)
+    _, tags_rev = jax.lax.scan(backtrack, last_tag, (back[::-1], rev_ts))
+    path = jnp.concatenate(
+        [jnp.flip(tags_rev, axis=0), last_tag[None, :]], axis=0
+    ).T  # [b, T]
+    path = jnp.where(mask, path, 0).astype(jnp.int64)
+
+    if ins.get("Label"):
+        label = ins["Label"][0].reshape(b, t_max).astype(jnp.int64)
+        correct = (path == label) & mask
+        return {"ViterbiPath": [correct.astype(jnp.int64)]}
+    return {"ViterbiPath": [path]}

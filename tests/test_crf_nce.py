@@ -1,0 +1,246 @@
+"""NCE, hierarchical sigmoid, linear-chain CRF + viterbi decoding
+(reference: operators/nce_op.cc, hierarchical_sigmoid_op.cc,
+linear_chain_crf_op.cc, crf_decoding_op.cc; book models word2vec /
+label_semantic_roles)."""
+
+import itertools
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.param_attr import ParamAttr
+
+from op_test import OpTest
+
+rng = np.random.RandomState(11)
+
+
+# ---------------------------------------------------------------------------
+# linear_chain_crf / crf_decoding vs brute force
+# ---------------------------------------------------------------------------
+
+
+def _logsumexp(xs):
+    m = max(xs)
+    return m + np.log(sum(np.exp(x - m) for x in xs))
+
+
+def _crf_score(emission, start, stop, trans, path):
+    s = start[path[0]] + stop[path[-1]]
+    s += sum(emission[t, path[t]] for t in range(len(path)))
+    s += sum(trans[path[t - 1], path[t]] for t in range(1, len(path)))
+    return float(s)
+
+
+def _crf_brute(emission, transition, label, length):
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    b, t_max, n = emission.shape
+    nll, best = [], []
+    for i in range(b):
+        ln = int(length[i])
+        scores = {
+            p: _crf_score(emission[i], start, stop, trans, p)
+            for p in itertools.product(range(n), repeat=ln)
+        }
+        log_z = _logsumexp(list(scores.values()))
+        gold = scores[tuple(label[i, :ln])]
+        nll.append(log_z - gold)
+        bp = max(scores, key=scores.get)
+        best.append(list(bp) + [0] * (t_max - ln))
+    return np.array(nll, "float32")[:, None], np.array(best, "int64")
+
+
+class TestLinearChainCRF(OpTest):
+    op_type = "linear_chain_crf"
+
+    def _data(self):
+        b, t_max, n = 3, 4, 3
+        emission = rng.uniform(-1, 1, (b, t_max, n)).astype("float32")
+        transition = rng.uniform(-0.5, 0.5, (n + 2, n)).astype("float32")
+        label = rng.randint(0, n, (b, t_max)).astype("int64")
+        length = np.array([4, 2, 3], "int64")
+        return emission, transition, label, length
+
+    def test_nll_matches_brute_force(self):
+        emission, transition, label, length = self._data()
+        nll, _ = _crf_brute(emission, transition, label, length)
+        self.check_output(
+            {"Emission": emission, "Transition": transition,
+             "Label": label, "Length": length},
+            {"LogLikelihood": nll},
+            atol=1e-4,
+        )
+
+    def test_grads(self):
+        emission, transition, label, length = self._data()
+        self.check_grad(
+            {"Emission": emission, "Transition": transition,
+             "Label": label, "Length": length},
+            {"LogLikelihood": ["nll"]},
+            ["Emission", "Transition"],
+        )
+
+
+class TestCRFDecoding(OpTest):
+    op_type = "crf_decoding"
+
+    def test_viterbi_matches_brute_force(self):
+        b, t_max, n = 3, 4, 3
+        emission = rng.uniform(-1, 1, (b, t_max, n)).astype("float32")
+        transition = rng.uniform(-0.5, 0.5, (n + 2, n)).astype("float32")
+        length = np.array([4, 3, 1], "int64")
+        _, best = _crf_brute(
+            emission, transition,
+            np.zeros((b, t_max), "int64"), length)
+        self.check_output(
+            {"Emission": emission, "Transition": transition,
+             "Length": length},
+            {"ViterbiPath": best},
+        )
+
+
+def test_crf_train_and_decode():
+    """Sequence labeling: tag = token % n_tags; CRF training must push
+    viterbi accuracy high (label_semantic_roles book-model pattern)."""
+    vocab, n_tags, t_max, bs = 12, 4, 6, 32
+    word = layers.data(name="word", shape=[t_max], dtype="int64")
+    label = layers.data(name="label", shape=[t_max], dtype="int64")
+    emb = layers.embedding(
+        layers.reshape(word, [-1, t_max, 1]), size=[vocab, 16])
+    emission = layers.fc(emb, size=n_tags, num_flatten_dims=2)
+    crf_cost = layers.linear_chain_crf(
+        emission, label, param_attr=ParamAttr(name="crf_w"))
+    avg = layers.mean(crf_cost)
+    pt.optimizer.AdamOptimizer(learning_rate=0.05).minimize(avg)
+    path = layers.crf_decoding(emission, param_attr=ParamAttr(name="crf_w"))
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+
+    def batch():
+        w = rng.randint(0, vocab, (bs, t_max)).astype("int64")
+        return {"word": w, "label": (w % n_tags).astype("int64")}
+
+    losses = []
+    feed = None
+    for _ in range(60):
+        feed = batch()
+        (lv,) = exe.run(feed=feed, fetch_list=[avg])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+    prog = pt.default_main_program().clone(for_test=True)
+    (p,) = exe.run(prog, feed=feed, fetch_list=[path])
+    acc = float((np.asarray(p) == feed["word"] % n_tags).mean())
+    assert acc > 0.9, acc
+
+
+# ---------------------------------------------------------------------------
+# NCE
+# ---------------------------------------------------------------------------
+
+
+class TestNCEGrad(OpTest):
+    op_type = "nce"
+
+    def test_grads(self):
+        b, d, v = 4, 6, 9
+        x = rng.uniform(-1, 1, (b, d)).astype("float32")
+        label = rng.randint(0, v, (b, 1)).astype("int64")
+        w = rng.uniform(-1, 1, (v, d)).astype("float32")
+        bias = rng.uniform(-1, 1, (v,)).astype("float32")
+        self.check_grad(
+            {"Input": x, "Label": label, "Weight": w, "Bias": bias},
+            {"Cost": ["cost"]},
+            ["Input", "Weight", "Bias"],
+            attrs={"num_total_classes": v, "num_neg_samples": 5, "seed": 3},
+        )
+
+
+def test_nce_word2vec_trains():
+    """word2vec-style: predict target = sum(context) % vocab via NCE
+    (dist_word2vec.py pattern)."""
+    vocab, d, bs = 20, 12, 64
+    ctx = layers.data(name="ctx", shape=[2, 1], dtype="int64")
+    target = layers.data(name="target", shape=[1], dtype="int64")
+    emb = layers.embedding(ctx, size=[vocab, d])
+    feat = layers.reshape(emb, [-1, 2 * d])
+    cost = layers.nce(feat, target, num_total_classes=vocab,
+                      num_neg_samples=6, seed=7)
+    avg = layers.mean(cost)
+    pt.optimizer.AdamOptimizer(learning_rate=0.02).minimize(avg)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for _ in range(80):
+        c = rng.randint(0, vocab, (bs, 2, 1)).astype("int64")
+        t = (c.sum(axis=1) % vocab).astype("int64")
+        (lv,) = exe.run(feed={"ctx": c, "target": t}, fetch_list=[avg])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid
+# ---------------------------------------------------------------------------
+
+
+def _hsigmoid_ref(x, label, w, bias, num_classes):
+    """Straight-loop mirror of the complete-binary-tree path walk."""
+    b = x.shape[0]
+    out = np.zeros((b, 1), "float32")
+    for i in range(b):
+        n = int(label[i]) + num_classes
+        j = 0
+        while (n >> (j + 1)) >= 1:
+            anc = n >> (j + 1)
+            bit = (n >> j) & 1
+            z = float(x[i] @ w[anc - 1] + bias[anc - 1])
+            out[i, 0] += np.log1p(np.exp((1 - 2 * bit) * z))
+            j += 1
+    return out
+
+
+class TestHSigmoid(OpTest):
+    op_type = "hierarchical_sigmoid"
+
+    def test_output_and_grad(self):
+        b, d, v = 5, 6, 11
+        x = rng.uniform(-1, 1, (b, d)).astype("float32")
+        label = rng.randint(0, v, (b, 1)).astype("int64")
+        w = rng.uniform(-1, 1, (v - 1, d)).astype("float32")
+        bias = rng.uniform(-1, 1, (v - 1,)).astype("float32")
+        expected = _hsigmoid_ref(x, label, w, bias, v)
+        self.check_output(
+            {"X": x, "Label": label, "W": w, "Bias": bias},
+            {"Out": expected},
+            attrs={"num_classes": v},
+            atol=1e-4,
+        )
+        self.check_grad(
+            {"X": x, "Label": label, "W": w, "Bias": bias},
+            {"Out": ["out"]},
+            ["X", "W", "Bias"],
+            attrs={"num_classes": v},
+        )
+
+
+def test_hsigmoid_trains():
+    vocab, d, bs = 16, 10, 64
+    x = layers.data(name="x", shape=[d], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=d, act="tanh")
+    cost = layers.hsigmoid(h, label, num_classes=vocab)
+    avg = layers.mean(cost)
+    pt.optimizer.AdamOptimizer(learning_rate=0.03).minimize(avg)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    proto = rng.randn(vocab, d).astype("float32")
+    losses = []
+    for _ in range(80):
+        lab = rng.randint(0, vocab, (bs, 1)).astype("int64")
+        xs = proto[lab[:, 0]] + 0.1 * rng.randn(bs, d).astype("float32")
+        (lv,) = exe.run(feed={"x": xs, "label": lab}, fetch_list=[avg])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
