@@ -1,0 +1,187 @@
+"""Inference serving: Predictor with an AOT executable cache + the
+BN-fold inference optimization pass.
+
+Reference parity:
+  * PaddlePredictor / NativeConfig — inference/api/paddle_api.h:153,200,
+    api/api_impl.h:34 (NativePaddlePredictor): load a saved model once,
+    then serve many Run() calls with no per-call graph work.
+  * AnalysisPredictor pass pipeline — api/analysis_predictor.h:45,
+    analysis/analyzer.cc: IR optimization before serving; the first pass
+    delivered here is conv/fc + batch_norm folding, the reference's
+    inference_transpiler.py:1 / conv_bn_fuse_pass.cc.
+
+TPU-first: the "executable cache" is the Executor's fingerprint-keyed XLA
+compile cache — Run() re-traces nothing after the first call per feed
+signature; parameters stay resident in the Predictor's private Scope (HBM)
+across calls, mirroring ir_params_sync_among_devices_pass.cc's
+params-frozen-to-device behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import io
+from .core import framework as fw
+from .core.executor import CPUPlace, Executor, Scope
+
+
+def _consumers(block: fw.Block, name: str) -> List[fw.Operator]:
+    return [op for op in block.ops if name in op.input_arg_names()]
+
+
+def _fold_bn_into(block, scope, idx, bn_op, prod_op) -> bool:
+    """Fold `bn_op` (at op index `idx`) into its producer conv2d/mul.
+    Returns True on success; mutates program + scope."""
+    if prod_op.type == "conv2d":
+        w_name = prod_op.input("Filter")[0]
+        out_axis = 0  # OIHW
+    elif prod_op.type == "mul":
+        w_name = prod_op.input("Y")[0]
+        out_axis = 1  # [in, out]
+    else:
+        return False
+
+    w_var = scope.find_var(w_name)
+    if w_var is None:
+        return False
+    gamma = np.asarray(scope.find_var(bn_op.input("Scale")[0]))
+    beta = np.asarray(scope.find_var(bn_op.input("Bias")[0]))
+    mean = np.asarray(scope.find_var(bn_op.input("Mean")[0]))
+    var = np.asarray(scope.find_var(bn_op.input("Variance")[0]))
+    eps = bn_op.attr("epsilon", 1e-5)
+
+    w = np.asarray(w_var)
+    orig_dtype = w.dtype
+    factor = (gamma / np.sqrt(var.astype("float64") + eps)).astype("float64")
+    bshape = [1] * w.ndim
+    bshape[out_axis] = -1
+    scope.set_var(
+        w_name,
+        (w.astype("float64") * factor.reshape(bshape)).astype(orig_dtype),
+    )
+    fold_bias = (
+        beta.astype("float64") - mean.astype("float64") * factor
+    ).astype(orig_dtype)
+
+    bias_name = fw.unique_name(f"{w_name}.bn_fold_bias")
+    block.create_var(
+        name=bias_name, shape=list(fold_bias.shape),
+        dtype=str(fold_bias.dtype), persistable=True,
+    )
+    scope.set_var(bias_name, fold_bias)
+
+    y_name = bn_op.output("Y")[0]
+    x_name = bn_op.input("X")[0]
+    block.remove_op(idx)
+    # channel axis: conv2d output is NCHW -> axis 1; mul output [.., C] -> -1
+    axis = 1 if prod_op.type == "conv2d" else -1
+    block.insert_op(
+        idx,
+        "elementwise_add",
+        inputs={"X": [x_name], "Y": [bias_name]},
+        outputs={"Out": [y_name]},
+        attrs={"axis": axis},
+    )
+    return True
+
+
+def inference_transpile(program: fw.Program, scope: Scope) -> int:
+    """Fold batch_norm (inference mode) into the preceding conv2d/mul
+    weights: W' = W * gamma/sqrt(var+eps); +bias' = beta - mean*that
+    (reference: transpiler/inference_transpiler.py:1, ir/conv_bn_fuse_pass.cc).
+
+    Mutates `program` and the parameter values in `scope`; returns the
+    number of batch_norm ops folded.  Only valid for inference programs
+    (clone(for_test=True) / load_inference_model output)."""
+    block = program.global_block()
+    folded = 0
+    changed = True
+    while changed:
+        changed = False
+        producers: Dict[str, tuple] = {}
+        for i, op in enumerate(block.ops):
+            for n in op.output_arg_names():
+                producers[n] = (i, op)
+        for i, op in enumerate(block.ops):
+            if op.type != "batch_norm":
+                continue
+            x_name = op.input("X")[0]
+            prod = producers.get(x_name)
+            if prod is None:
+                continue
+            _, prod_op = prod
+            # the conv output must feed only this BN (otherwise other
+            # consumers would see the refolded weights)
+            if len(_consumers(block, x_name)) != 1:
+                continue
+            if _fold_bn_into(block, scope, i, op, prod_op):
+                folded += 1
+                changed = True
+                break
+    return folded
+
+
+class Predictor:
+    """Load-once, serve-many inference API (reference: PaddlePredictor
+    api/paddle_api.h:153 + NativePaddlePredictor api_impl.h:34).
+
+        pred = Predictor(dirname)            # load + optimize once
+        outs = pred.run({"x": batch})        # AOT-cached; no retracing
+
+    Each distinct feed signature (shapes/dtypes) compiles exactly once;
+    `pred.compile_count` exposes the executable-cache size for tests.
+    """
+
+    def __init__(
+        self,
+        dirname: str,
+        place=None,
+        optimize: bool = True,
+        model_filename: Optional[str] = None,
+        params_filename: Optional[str] = None,
+    ):
+        self._scope = Scope()
+        self._exe = Executor(place or CPUPlace())
+        self._program, self._feed_names, self._fetch_vars = (
+            io.load_inference_model(
+                dirname, self._exe, scope=self._scope,
+                model_filename=model_filename,
+                params_filename=params_filename,
+            )
+        )
+        self._fetch_names = [v.name for v in self._fetch_vars]
+        self.folded_ops = 0
+        if optimize:
+            self.folded_ops = inference_transpile(self._program, self._scope)
+
+    @property
+    def feed_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    @property
+    def fetch_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    @property
+    def program(self) -> fw.Program:
+        return self._program
+
+    @property
+    def compile_count(self) -> int:
+        return len(self._exe._cache)
+
+    def run(self, feed: Dict[str, np.ndarray], return_numpy: bool = True):
+        """Serve one batch; compiles on first call per feed signature."""
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"Predictor.run: missing feeds {missing}")
+        return self._exe.run(
+            self._program,
+            feed={n: feed[n] for n in self._feed_names},
+            fetch_list=self._fetch_names,
+            scope=self._scope,
+            return_numpy=return_numpy,
+        )

@@ -1,0 +1,101 @@
+"""Inference Predictor + BN-fold pass (reference: api/paddle_api.h:153
+PaddlePredictor, api_impl.h:34, analysis_predictor.h:45,
+transpiler/inference_transpiler.py, ir/conv_bn_fuse_pass.cc)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.inference import Predictor, inference_transpile
+
+rng = np.random.RandomState(5)
+
+
+def _train_small_convnet(tmpdir, steps=12):
+    """conv2d+bn+relu -> fc classifier on a separable synthetic task;
+    returns (dirname, feed fn, logits var name, reference predict fn)."""
+    img = layers.data(name="img", shape=[1, 8, 8], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    conv = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                         act=None, bias_attr=False)
+    bn = layers.batch_norm(conv, act="relu")
+    flat = layers.reshape(bn, [-1, 4 * 8 * 8])
+    logits = layers.fc(flat, size=3)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(
+            logits=logits, label=layers.reshape(label, [-1, 1])))
+    pt.optimizer.AdamOptimizer(learning_rate=0.01).minimize(loss)
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+
+    def batch(n=16):
+        lab = rng.randint(0, 3, (n, 1)).astype("int64")
+        x = rng.randn(n, 1, 8, 8).astype("float32") + lab[:, :, None, None]
+        return {"img": x, "label": lab}
+
+    for _ in range(steps):
+        exe.run(feed=batch(), fetch_list=[loss])
+
+    dirname = str(tmpdir / "model")
+    pt.io.save_inference_model(dirname, ["img"], [logits], exe)
+    return dirname, batch, exe, logits
+
+
+def test_predictor_matches_executor(tmp_path):
+    dirname, batch, exe, logits = _train_small_convnet(tmp_path)
+    feed = batch(8)
+
+    # reference outputs via plain Executor on the live (test-mode) program
+    infer_prog = pt.default_main_program().clone(for_test=True)
+    (ref,) = exe.run(infer_prog, feed=feed, fetch_list=[logits])
+
+    pred = Predictor(dirname, optimize=False)
+    (out,) = pred.run({"img": feed["img"]})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_compiles_once_across_many_runs(tmp_path):
+    dirname, batch, _, _ = _train_small_convnet(tmp_path, steps=2)
+    pred = Predictor(dirname)
+    outs = []
+    for _ in range(50):
+        feed = batch(8)
+        (o,) = pred.run({"img": feed["img"]})
+        outs.append(np.asarray(o))
+    assert pred.compile_count == 1, pred.compile_count
+    # a different batch size is a new signature -> exactly one more compile
+    feed = batch(4)
+    pred.run({"img": feed["img"]})
+    assert pred.compile_count == 2
+
+
+def test_bn_fold_preserves_outputs(tmp_path):
+    dirname, batch, _, _ = _train_small_convnet(tmp_path)
+    feed = batch(8)
+
+    plain = Predictor(dirname, optimize=False)
+    folded = Predictor(dirname, optimize=True)
+    assert folded.folded_ops == 1, folded.folded_ops
+    bn_ops = [op.type for op in folded.program.global_block().ops]
+    assert "batch_norm" not in bn_ops
+
+    (a,) = plain.run({"img": feed["img"]})
+    (b,) = folded.run({"img": feed["img"]})
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bn_fold_skips_shared_conv_output(tmp_path):
+    """A conv output consumed by BN *and* something else must not fold."""
+    img = layers.data(name="img", shape=[1, 4, 4], dtype="float32")
+    conv = layers.conv2d(img, num_filters=2, filter_size=3, padding=1,
+                         bias_attr=False)
+    bn = layers.batch_norm(conv)
+    both = layers.elementwise_add(bn, conv)  # second consumer of conv out
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    prog = pt.default_main_program().clone(for_test=True)
+    n = inference_transpile(prog, pt.global_scope())
+    assert n == 0
