@@ -1,0 +1,3 @@
+"""Contrib tier (reference: python/paddle/fluid/contrib/)."""
+
+from . import quantize  # noqa: F401

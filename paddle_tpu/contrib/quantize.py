@@ -1,0 +1,159 @@
+"""QAT program rewrite (reference:
+python/paddle/fluid/contrib/quantize/quantize_transpiler.py:81
+QuantizeTranspiler).
+
+Inserts fake_quantize/fake_dequantize pairs around the quantizable ops'
+inputs: weights use per-step abs_max, activations a moving-average abs-max
+with persistable scale state initialized in the startup program.
+
+Contract difference from the reference: call `training_transpile` BEFORE
+optimizer.minimize() — the straight-through estimator lives inside the
+fake-quant lowerings (ops/quant_ops.py), so append_backward differentiates
+the rewritten program directly instead of the reference's separate grad-op
+rewiring pass.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..core import framework as fw
+
+QUANTIZABLE_OPS = {
+    "conv2d": ("Input", "Filter"),
+    "depthwise_conv2d": ("Input", "Filter"),
+    "mul": ("X", "Y"),
+}
+
+
+class QuantizeTranspiler:
+    def __init__(
+        self,
+        weight_bits: int = 8,
+        activation_bits: int = 8,
+        activation_quantize_type: str = "moving_average_abs_max",
+        weight_quantize_type: str = "abs_max",
+        moving_rate: float = 0.9,
+    ):
+        if activation_quantize_type not in (
+            "moving_average_abs_max", "abs_max"
+        ):
+            raise ValueError(
+                f"unsupported activation_quantize_type "
+                f"{activation_quantize_type!r}")
+        if weight_quantize_type != "abs_max":
+            raise ValueError("weight_quantize_type must be 'abs_max'")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.moving_rate = moving_rate
+
+    # -- helpers ---------------------------------------------------------
+
+    def _quant_abs_max(self, block, idx, name, bits):
+        q = block.create_var(
+            name=fw.unique_name(f"{name}.quantized"), dtype="float32")
+        scale = block.create_var(
+            name=fw.unique_name(f"{name}.scale"), dtype="float32")
+        block.insert_op(
+            idx,
+            "fake_quantize_abs_max",
+            inputs={"X": [name]},
+            outputs={"Out": [q], "OutScale": [scale]},
+            attrs={"bit_length": bits},
+        )
+        return q.name, scale.name
+
+    def _quant_moving_average(self, block, startup, idx, name, bits):
+        def state(suffix, init):
+            v = block.create_var(
+                name=fw.unique_name(f"{name}.{suffix}"),
+                shape=[1], dtype="float32", persistable=True)
+            v.stop_gradient = True  # scale state gets no cotangent
+            sv = startup.global_block().create_var(
+                name=v.name, shape=[1], dtype="float32", persistable=True)
+            startup.global_block().append_op(
+                "fill_constant",
+                outputs={"Out": [sv]},
+                attrs={"shape": [1], "value": init, "dtype": "float32"},
+            )
+            return v
+
+        scale_in = state("quant_scale", 0.001)
+        accum = state("quant_accum", 0.0)
+        st = state("quant_state", 0.0)
+        q = block.create_var(
+            name=fw.unique_name(f"{name}.quantized"), dtype="float32")
+        block.insert_op(
+            idx,
+            "fake_quantize_moving_average_abs_max",
+            inputs={"X": [name], "InScale": [scale_in],
+                    "InAccum": [accum], "InState": [st]},
+            outputs={"Out": [q], "OutScale": [scale_in],
+                     "OutAccum": [accum], "OutState": [st]},
+            attrs={"bit_length": bits, "moving_rate": self.moving_rate},
+        )
+        return q.name, scale_in.name
+
+    def _dequant(self, block, idx, name, scale_name, bits):
+        out = block.create_var(
+            name=fw.unique_name(f"{name}.dequantized"), dtype="float32")
+        block.insert_op(
+            idx,
+            "fake_dequantize_max_abs",
+            inputs={"X": [name], "Scale": [scale_name]},
+            outputs={"Out": [out]},
+            attrs={"max_range": float((1 << (bits - 1)) - 1),
+                   "bit_length": bits},
+        )
+        return out.name
+
+    # -- public ----------------------------------------------------------
+
+    def training_transpile(
+        self,
+        program: Optional[fw.Program] = None,
+        startup_program: Optional[fw.Program] = None,
+    ) -> int:
+        """Rewrite `program` in place; returns the number of quantized
+        input slots.  Call before minimize()."""
+        program = program or fw.default_main_program()
+        startup = startup_program or fw.default_startup_program()
+        block = program.global_block()
+        params = {p.name for p in block.all_parameters()}
+
+        dequantized: Dict[str, str] = {}
+        count = 0
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            slots = QUANTIZABLE_OPS.get(op.type)
+            if slots is None:
+                i += 1
+                continue
+            for slot in slots:
+                names = op.input(slot)
+                if not names:
+                    continue
+                name = names[0]
+                if name not in dequantized:
+                    is_weight = name in params
+                    bits = (self.weight_bits if is_weight
+                            else self.activation_bits)
+                    if is_weight or (
+                        self.activation_quantize_type == "abs_max"
+                    ):
+                        qname, sname = self._quant_abs_max(
+                            block, i, name, bits)
+                    else:
+                        qname, sname = self._quant_moving_average(
+                            block, startup, i, name, bits)
+                    i += 1
+                    dq = self._dequant(block, i, qname, sname, bits)
+                    i += 1
+                    dequantized[name] = dq
+                op.inputs[slot] = [dequantized[name]]
+                count += 1
+            block._bump()
+            i += 1
+        return count

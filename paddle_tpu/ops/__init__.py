@@ -13,4 +13,5 @@ from . import (  # noqa: F401
     sequence_ops,
     rnn_ops,
     misc_ops,
+    quant_ops,
 )

@@ -1,0 +1,108 @@
+"""QAT fake-quantization ops (reference: operators/fake_quantize_op.cc:1,
+fake_dequantize_op.cc).
+
+TPU-first: the straight-through estimator is baked into the lowering as
+`base + stop_gradient(quantize(base) - base)`, so the generic vjp grad maker
+yields the reference's pass-through gradient with no explicit grad ops, and
+the round/clip chain fuses into the surrounding XLA computation.  The
+moving-average scale follows the batch_norm stateful contract: OutScale /
+state outputs reuse the input var names and the executor writes them back
+to the Scope.
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _ste(base, quantized):
+    """Forward `quantized`, gradient of `base` (straight-through)."""
+    import jax
+
+    return base + jax.lax.stop_gradient(quantized - base)
+
+
+def _qrange(ctx):
+    bits = ctx.attr("bit_length", 8)
+    return float((1 << (bits - 1)) - 1)
+
+
+@register("fake_quantize_abs_max")
+def lower_fake_quantize_abs_max(ctx, ins):
+    """Out = clip(round(X / max|X| * range)); OutScale = max|X|
+    (reference fake_quantize_op.cc FakeQuantizeAbsMaxOp)."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    r = _qrange(ctx)
+    # scale is data, not a differentiable function of x (the reference's
+    # grad is pure pass-through)
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x)).astype(jnp.float32))
+    safe = jnp.maximum(scale, 1e-8)
+    base = x.astype(jnp.float32) / safe * r
+    q = jnp.clip(jnp.round(base), -r, r)
+    return {
+        "Out": [_ste(base, q).astype(x.dtype)],
+        "OutScale": [scale.reshape(1)],
+    }
+
+
+@register("fake_quantize_moving_average_abs_max")
+def lower_fake_quantize_moving_average_abs_max(ctx, ins):
+    """Activation quantization with a moving-average abs-max scale
+    (reference fake_quantize_op.cc FakeQuantizeMovingAverageAbsMaxOp).
+    State (InAccum/InState/InScale) is read and written back by name."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    r = _qrange(ctx)
+    rho = ctx.attr("moving_rate", 0.9)
+    is_test = ctx.attr("is_test", False) or ctx.is_test
+
+    in_scale = ins["InScale"][0].reshape(())
+    if is_test:
+        scale = in_scale
+        accum_out = ins["InAccum"][0] if ins.get("InAccum") else None
+        state_out = ins["InState"][0] if ins.get("InState") else None
+    else:
+        cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        accum = ins["InAccum"][0].reshape(()) * rho + cur
+        state = ins["InState"][0].reshape(()) * rho + 1.0
+        scale = accum / state
+        accum_out = accum.reshape(1)
+        state_out = state.reshape(1)
+
+    import jax
+
+    scale = jax.lax.stop_gradient(scale)
+    safe = jnp.maximum(scale, 1e-8)
+    base = x.astype(jnp.float32) / safe * r
+    q = jnp.clip(jnp.round(base), -r, r)
+    outs = {
+        "Out": [_ste(base, q).astype(x.dtype)],
+        "OutScale": [scale.reshape(1)],
+    }
+    if accum_out is not None:
+        outs["OutAccum"] = [accum_out]
+    if state_out is not None:
+        outs["OutState"] = [state_out]
+    return outs
+
+
+@register("fake_dequantize_max_abs")
+def lower_fake_dequantize_max_abs(ctx, ins):
+    """Out = X * Scale / max_range (reference fake_dequantize_op.cc)."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    scale = jax.lax.stop_gradient(ins["Scale"][0].reshape(()))
+    max_range = ctx.attr("max_range", _qrange(ctx))
+    return {"Out": [(x.astype(jnp.float32) * scale / max_range
+                     ).astype(x.dtype)]}

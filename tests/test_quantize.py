@@ -1,0 +1,145 @@
+"""QAT fake quantization ops + transpiler (reference:
+operators/fake_quantize_op.cc, contrib/quantize/quantize_transpiler.py:81)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.contrib.quantize import QuantizeTranspiler
+
+from op_test import OpTest
+
+rng = np.random.RandomState(9)
+
+
+class TestFakeQuantizeAbsMax(OpTest):
+    op_type = "fake_quantize_abs_max"
+
+    def test_output(self):
+        x = rng.uniform(-4, 4, (6, 5)).astype("float32")
+        scale = np.abs(x).max()
+        r = 127.0
+        q = np.clip(np.round(x / scale * r), -r, r).astype("float32")
+        self.check_output(
+            {"X": x},
+            {"Out": q, "OutScale": np.array([scale], "float32")},
+            attrs={"bit_length": 8},
+        )
+
+    def test_grad_is_straight_through(self):
+        x = rng.uniform(-2, 2, (4, 3)).astype("float32")
+        # STE: d mean(sum(Out)) / dX ~= range/scale * 1/n per element, the
+        # same as differentiating the un-rounded base — finite differences
+        # of the rounded fwd would be 0/spiky, so compare analytic grads of
+        # quant against the linear op X * r / scale instead.
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core import registry
+
+        lower = registry.lookup("fake_quantize_abs_max").lower
+
+        class Ctx:
+            is_test = False
+
+            def attr(self, name, default=None):
+                return {"bit_length": 8}.get(name, default)
+
+        def f(xv):
+            return lower(Ctx(), {"X": [xv]})["Out"][0].sum()
+
+        g = jax.grad(f)(jnp.asarray(x))
+        scale = np.abs(x).max()
+        np.testing.assert_allclose(
+            np.asarray(g), np.full_like(x, 127.0 / scale), rtol=1e-4)
+
+
+class TestFakeDequantize(OpTest):
+    op_type = "fake_dequantize_max_abs"
+
+    def test_output(self):
+        x = rng.uniform(-127, 127, (6, 5)).astype("float32")
+        scale = np.array([3.7], "float32")
+        self.check_output(
+            {"X": x, "Scale": scale},
+            {"Out": x * 3.7 / 127.0},
+            attrs={"max_range": 127.0},
+        )
+
+
+def test_quantize_transpiler_qat_trains():
+    """conv+fc net: transpile -> fake ops present -> trains, and QAT logits
+    stay close to the fp32 twin at 8 bits."""
+    img = layers.data(name="img", shape=[1, 8, 8], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    conv = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                         act="relu")
+    flat = layers.reshape(conv, [-1, 4 * 8 * 8])
+    logits = layers.fc(flat, size=3)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(
+            logits=logits, label=layers.reshape(label, [-1, 1])))
+
+    t = QuantizeTranspiler()
+    n = t.training_transpile()
+    assert n == 4, n  # conv Input+Filter, mul X+Y
+
+    ops = [op.type for op in pt.default_main_program().global_block().ops]
+    assert ops.count("fake_quantize_abs_max") == 2          # two weights
+    assert ops.count("fake_quantize_moving_average_abs_max") == 2
+    assert ops.count("fake_dequantize_max_abs") == 4
+
+    pt.optimizer.AdamOptimizer(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+
+    def batch(n=16):
+        lab = rng.randint(0, 3, (n, 1)).astype("int64")
+        x = rng.randn(n, 1, 8, 8).astype("float32") + lab[:, :, None, None]
+        return {"img": x, "label": lab}
+
+    losses = []
+    for _ in range(25):
+        (lv,) = exe.run(feed=batch(), fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+    # moving-average scale state actually updated
+    scope = pt.global_scope()
+    scale_vars = [
+        v.name
+        for v in pt.default_main_program().list_vars()
+        if ".quant_scale" in v.name and "@GRAD" not in v.name
+    ]
+    assert scale_vars
+    for nm in scale_vars:
+        assert float(np.asarray(scope.find_var(nm)).reshape(-1)[0]) > 0.001
+
+
+def test_qat_matches_fp32_closely():
+    """8-bit fake quantization shouldn't move a small net's outputs much."""
+    def build():
+        img = layers.data(name="img", shape=[6], dtype="float32")
+        out = layers.fc(img, size=4)
+        return out
+
+    # fp32 twin
+    prog_a, st_a = pt.Program(), pt.Program()
+    from paddle_tpu.core import framework as fw
+    with fw.guard_unique_name():
+        with pt.program_guard(prog_a, st_a):
+            out_a = build()
+    prog_b, st_b = pt.Program(), pt.Program()
+    with fw.guard_unique_name():
+        with pt.program_guard(prog_b, st_b):
+            out_b = build()
+            QuantizeTranspiler(
+                activation_quantize_type="abs_max"
+            ).training_transpile(prog_b, st_b)
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(st_a)  # same names -> shared scope params
+    x = rng.uniform(-1, 1, (5, 6)).astype("float32")
+    (a,) = exe.run(prog_a, feed={"img": x}, fetch_list=[out_a])
+    (b,) = exe.run(prog_b, feed={"img": x}, fetch_list=[out_b])
+    a, b = np.asarray(a), np.asarray(b)
+    assert np.max(np.abs(a - b)) < 0.05 * max(1.0, np.max(np.abs(a)))
