@@ -1,0 +1,113 @@
+"""Subprocess worker for the multi-process distributed + resume tests
+(mirrors the reference harness: tests/unittests/test_dist_base.py:35-540
+forks localhost pserver/trainer processes and pickles losses back).
+
+Modes:
+  dist    <trainer_id>  — join a 2-process jax.distributed CPU cluster via
+                          init_distributed_env, train data-parallel over the
+                          GLOBAL mesh, dump per-step losses.
+  train   <steps> <out_dir> [load_dir]
+                        — single-process train (optionally resuming from a
+                          checkpoint); saves persistables + losses.
+"""
+
+import json
+import os
+import sys
+
+# The axon image's sitecustomize can force jax_platforms past the env var;
+# the config update is authoritative as long as it runs before device init
+# (same trick as tests/conftest.py).
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+
+def build_model():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=16, act="tanh")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square(pred - y))
+    opt = pt.optimizer.MomentumOptimizer(learning_rate=0.05, momentum=0.9)
+    opt.minimize(loss)
+    return loss
+
+
+def batch(step, n=16):
+    import numpy as np
+
+    rng = np.random.RandomState(1000 + step)
+    x = rng.randn(n, 8).astype("float32")
+    y = (x.sum(axis=1, keepdims=True) * 0.3).astype("float32")
+    return {"x": x, "y": y}
+
+
+def run_dist(trainer_id):
+    import numpy as np
+
+    from paddle_tpu.parallel.distributed import init_distributed_env
+
+    env = init_distributed_env()
+    assert env.num_trainers == 2
+
+    import jax
+
+    assert jax.process_count() == 2, jax.process_count()
+
+    import paddle_tpu as pt
+
+    loss = build_model()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    compiled = pt.CompiledProgram(
+        pt.default_main_program()
+    ).with_data_parallel(loss_name=loss.name)
+
+    losses = []
+    for step in range(6):
+        (lv,) = exe.run(compiled, feed=batch(step), fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+
+    if trainer_id == 0:
+        with open(os.environ["DIST_OUT"], "w") as f:
+            json.dump({"losses": losses, "devices": jax.device_count()}, f)
+
+
+def run_train(steps, out_dir, load_dir=None):
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    loss = build_model()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    start = 0
+    if load_dir:
+        pt.io.load_persistables(exe, load_dir)
+        with open(os.path.join(load_dir, "meta.json")) as f:
+            start = json.load(f)["step"]
+    losses = []
+    for step in range(start, start + steps):
+        (lv,) = exe.run(feed=batch(step), fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    os.makedirs(out_dir, exist_ok=True)
+    pt.io.save_persistables(exe, out_dir)
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump({"step": start + steps}, f)
+    with open(os.path.join(out_dir, "losses.json"), "w") as f:
+        json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1]
+    if mode == "dist":
+        run_dist(int(sys.argv[2]))
+    elif mode == "train":
+        run_train(int(sys.argv[2]), sys.argv[3],
+                  sys.argv[4] if len(sys.argv) > 4 else None)
+    else:
+        raise SystemExit(f"unknown mode {mode}")

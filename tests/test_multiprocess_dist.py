@@ -1,0 +1,103 @@
+"""Real multi-process distributed training + checkpoint-resume
+(reference: tests/unittests/test_dist_base.py:35-540 — localhost
+subprocesses, loss parity vs the single-process run; dist_save_load.py)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "dist_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env(extra):
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
+
+
+def test_two_process_data_parallel_matches_single(tmp_path):
+    """2 jax.distributed processes x 2 virtual CPU devices == 4-way DP;
+    losses must match a single-process 4-device run on the same data."""
+    port = _free_port()
+    endpoints = f"127.0.0.1:{port},127.0.0.1:{_free_port()}"
+    out = str(tmp_path / "dist.json")
+
+    procs = []
+    for tid in range(2):
+        env = _env({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "PADDLE_TRAINERS": "2",
+            "PADDLE_TRAINER_ID": str(tid),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "DIST_OUT": out,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, "dist", str(tid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        ))
+    outs = [p.communicate(timeout=480) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, se.decode()[-3000:]
+
+    with open(out) as f:
+        dist = json.load(f)
+    assert dist["devices"] == 4  # global mesh spans both processes
+
+    # single-process reference: same data, 4 virtual devices, same DP math
+    ref_out = str(tmp_path / "ref")
+    env = _env({"XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    # reuse the worker's single-process mode but data-parallel via dist
+    # mode with 1 trainer is the plain path; train mode runs unsharded,
+    # which is the loss-parity oracle (same global batch, same updates)
+    r = subprocess.run(
+        [sys.executable, WORKER, "train", "6", ref_out],
+        env=env, capture_output=True, timeout=480)
+    assert r.returncode == 0, r.stderr.decode()[-3000:]
+    with open(os.path.join(ref_out, "losses.json")) as f:
+        ref_losses = json.load(f)
+
+    np.testing.assert_allclose(dist["losses"], ref_losses, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_checkpoint_resume_exactly(tmp_path):
+    """train 4 -> save -> FRESH PROCESS load -> train 4 more: losses must
+    equal the uninterrupted 8-step run (optimizer state incl. momentum
+    accumulators rides the persistables checkpoint)."""
+    a1 = str(tmp_path / "phase1")
+    a2 = str(tmp_path / "phase2")
+    full = str(tmp_path / "full")
+    env = _env({})
+
+    r = subprocess.run([sys.executable, WORKER, "train", "4", a1],
+                       env=env, capture_output=True, timeout=480)
+    assert r.returncode == 0, r.stderr.decode()[-3000:]
+    r = subprocess.run([sys.executable, WORKER, "train", "4", a2, a1],
+                       env=env, capture_output=True, timeout=480)
+    assert r.returncode == 0, r.stderr.decode()[-3000:]
+    r = subprocess.run([sys.executable, WORKER, "train", "8", full],
+                       env=env, capture_output=True, timeout=480)
+    assert r.returncode == 0, r.stderr.decode()[-3000:]
+
+    with open(os.path.join(a2, "losses.json")) as f:
+        resumed = json.load(f)
+    with open(os.path.join(full, "losses.json")) as f:
+        uninterrupted = json.load(f)
+    np.testing.assert_allclose(resumed, uninterrupted[4:], rtol=1e-6)
