@@ -88,11 +88,10 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=1.0,
     dim is sharded over `axis_name` of `mesh`; returns global output with the
     same sharding."""
     import jax
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, None, axis_name, None)
-    fn = shard_map(
+    fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=axis_name, scale=scale,
                           causal=causal),
         mesh=mesh,

@@ -36,3 +36,20 @@ def fused_attention(q, k, v, bias=None, scale=1.0, causal=False,
         out = dropout(out, dropout_prob=dropout_rate,
                       dropout_implementation="upscale_in_train")
     return out
+
+
+def ring_attention(q, k, v, scale=1.0, causal=False, axis_name="sp",
+                   name=None):
+    """Context-parallel attention layer over [B,H,T,D] tensors: the T axis
+    shards over mesh axis `axis_name` (see ops/fused_ops.py ring_attention).
+    Use through a ShardingPlan whose mesh declares that axis."""
+    helper = LayerHelper("ring_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        "ring_attention",
+        inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale), "causal": causal,
+               "axis_name": axis_name},
+    )
+    return out

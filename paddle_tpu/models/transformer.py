@@ -26,8 +26,16 @@ def multi_head_attention(
     n_head=1,
     dropout_rate=0.0,
     use_flash=False,
+    use_ring=False,
+    ring_causal=False,
+    ring_axis="sp",
 ):
-    """reference transformer_model.py:44."""
+    """reference transformer_model.py:44.
+
+    use_ring (context parallelism, self-attention only): the sequence axis
+    shards over mesh axis `ring_axis` and K/V circulate via ppermute —
+    attn_bias is ignored on this path (pad-free batches / pure-causal via
+    ring_causal), see ops/fused_ops.py ring_attention."""
     keys = queries if keys is None else keys
     values = keys if values is None else values
 
@@ -47,7 +55,12 @@ def multi_head_attention(
     k = split_heads(k, d_key)
     v = split_heads(v, d_value)
 
-    if use_flash:
+    if use_ring:
+        from ..layers.contrib import ring_attention
+
+        ctx = ring_attention(q, k, v, scale=d_key**-0.5, causal=ring_causal,
+                             axis_name=ring_axis)
+    elif use_flash:
         from ..layers.contrib import fused_attention
 
         ctx = fused_attention(q, k, v, attn_bias, scale=d_key**-0.5,
@@ -134,10 +147,11 @@ def prepare_encoder(
 
 
 def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
-                  d_inner_hid, dropout_rate=0.0, use_flash=False):
+                  d_inner_hid, dropout_rate=0.0, use_flash=False,
+                  use_ring=False):
     attn_output = multi_head_attention(
         enc_input, None, None, attn_bias, d_key, d_value, d_model, n_head,
-        dropout_rate, use_flash=use_flash,
+        dropout_rate, use_flash=use_flash, use_ring=use_ring,
     )
     attn_output = pre_post_process_layer(enc_input, attn_output, "dan",
                                          dropout_rate)
@@ -146,11 +160,12 @@ def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
 
 
 def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
-            d_inner_hid, dropout_rate=0.0, use_flash=False):
+            d_inner_hid, dropout_rate=0.0, use_flash=False, use_ring=False):
     for i in range(n_layer):
         enc_output = encoder_layer(
             enc_input, attn_bias, n_head, d_key, d_value, d_model,
             d_inner_hid, dropout_rate, use_flash=use_flash,
+            use_ring=use_ring,
         )
         enc_input = enc_output
     return enc_output
@@ -158,10 +173,11 @@ def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
 
 def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
                   n_head, d_key, d_value, d_model, d_inner_hid,
-                  dropout_rate=0.0, use_flash=False):
+                  dropout_rate=0.0, use_flash=False, use_ring=False):
     slf_attn_output = multi_head_attention(
         dec_input, None, None, slf_attn_bias, d_key, d_value, d_model, n_head,
-        dropout_rate, use_flash=use_flash,
+        dropout_rate, use_flash=use_flash, use_ring=use_ring,
+        ring_causal=True,
     )
     slf_attn_output = pre_post_process_layer(dec_input, slf_attn_output, "dan",
                                              dropout_rate)
@@ -178,12 +194,12 @@ def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
 
 def decoder(dec_input, enc_output, dec_slf_attn_bias, dec_enc_attn_bias,
             n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
-            dropout_rate=0.0, use_flash=False):
+            dropout_rate=0.0, use_flash=False, use_ring=False):
     for i in range(n_layer):
         dec_output = decoder_layer(
             dec_input, enc_output, dec_slf_attn_bias, dec_enc_attn_bias,
             n_head, d_key, d_value, d_model, d_inner_hid, dropout_rate,
-            use_flash=use_flash,
+            use_flash=use_flash, use_ring=use_ring,
         )
         dec_input = dec_output
     return dec_output
@@ -204,6 +220,7 @@ def transformer(
     src_seq_len=None,
     trg_seq_len=None,
     use_flash=False,
+    use_ring=False,
     device_biases=True,
 ):
     """Full encoder-decoder Transformer-base (reference
@@ -278,6 +295,7 @@ def transformer(
     enc_output = encoder(
         enc_input, src_slf_attn_bias, n_layer, n_head, d_key, d_value,
         d_model, d_inner_hid, dropout_rate, use_flash=use_flash,
+        use_ring=use_ring,
     )
 
     dec_input = prepare_encoder(
@@ -288,7 +306,7 @@ def transformer(
     dec_output = decoder(
         dec_input, enc_output, trg_slf_attn_bias, trg_src_attn_bias,
         n_layer, n_head, d_key, d_value, d_model, d_inner_hid, dropout_rate,
-        use_flash=use_flash,
+        use_flash=use_flash, use_ring=use_ring,
     )
 
     predict = layers.fc(input=dec_output, size=trg_vocab_size,

@@ -46,3 +46,41 @@ def lower_fused_ln_gelu(ctx, ins):
         ctx.attr("epsilon", 1e-5),
     )
     return {"Out": [jax.nn.gelu(y)]}
+
+
+def _ring_attention_infer(ctx):
+    qs = ctx.input_shape("Q")
+    if qs is not None:
+        ctx.set_output("Out", tuple(qs), ctx.input_dtype("Q"))
+
+
+@register("ring_attention", infer_shape=_ring_attention_infer)
+def lower_ring_attention(ctx, ins):
+    """Context-parallel exact attention: the sequence axis is sharded over a
+    mesh axis and K/V shards stream around the ring via ppermute over ICI
+    (kernels/ring_attention.py; SURVEY.md §5.7 — a capability the reference
+    lacks, its max context is bounded by one device's memory).
+
+    Lowers to shard_map(ring) when the executor's mesh has the `axis_name`
+    axis; otherwise (single-device trace, tests, dryrun without an sp axis)
+    falls back to the numerically-identical reference attention.  Supports
+    causal masking; additive bias is not supported on the ring path (pad-
+    free batches or pure-causal decoders)."""
+    from ..kernels.attention import reference_attention
+    from ..kernels.ring_attention import ring_attention_sharded
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    scale = ctx.attr("scale", 1.0)
+    causal = ctx.attr("causal", False)
+    axis_name = ctx.attr("axis_name", "sp")
+    mesh = getattr(ctx.executor_ctx, "mesh", None)
+    if (
+        mesh is None
+        or axis_name not in getattr(mesh, "axis_names", ())
+        or q.shape[2] % mesh.shape[axis_name] != 0
+    ):
+        out = reference_attention(q, k, v, None, scale=scale, causal=causal)
+    else:
+        out = ring_attention_sharded(
+            q, k, v, mesh, axis_name=axis_name, scale=scale, causal=causal)
+    return {"Out": [out]}

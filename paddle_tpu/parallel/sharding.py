@@ -34,15 +34,29 @@ class ShardingPlan:
         data_axis: str = "data",
         zero_stage: int = 0,
         devices=None,
+        feed_rules: Optional[List[Tuple[str, object]]] = None,
     ):
         """param_rules: [(name regex, PartitionSpec)] — first match wins.
         zero_stage >= 1 shards unmatched params' optimizer moments over the
-        data axis; stage >= 2 shards the params themselves."""
+        data axis; stage >= 2 shards the params themselves.
+        feed_rules: [(feed-name regex, PartitionSpec)] — overrides the
+        default batch-over-data_axis feed sharding; use to shard the
+        sequence dim for context parallelism, e.g.
+        (r\"src_word|trg_word\", P(\"data\", \"sp\"))."""
         self.mesh_axes = dict(mesh_axes)
         self.param_rules = param_rules or []
         self.data_axis = data_axis
         self.zero_stage = zero_stage
         self.devices = devices
+        self.feed_rules = feed_rules or []
+
+    def spec_for_feed(self, name: str):
+        from jax.sharding import PartitionSpec as P
+
+        for pat, spec in self.feed_rules:
+            if re.fullmatch(pat, name):
+                return spec
+        return P(self.data_axis)
 
     def build_mesh(self):
         import jax
@@ -140,9 +154,11 @@ class ShardedProgram:
             self._cache[key] = entry
         (jitted, rw_state, ro_state, state_writes, needs_key, shardings) = entry
 
-        data_sharding = NamedSharding(mesh, P(self.plan.data_axis))
         feed_vals = [
-            jax.device_put(np.asarray(feed[n]), data_sharding)
+            jax.device_put(
+                np.asarray(feed[n]),
+                NamedSharding(mesh, self.plan.spec_for_feed(n)),
+            )
             for n in feed_names
         ]
 
@@ -196,7 +212,10 @@ class ShardedProgram:
 
         shardings = {n: sharding_for(n) for n in state_reads + state_writes}
 
-        data_sharding = NamedSharding(mesh, P(self.plan.data_axis))
+        feed_shardings = [
+            NamedSharding(mesh, self.plan.spec_for_feed(n))
+            for n in feed_names
+        ]
         probe_random = exec_mod.program_uses_random(block)
 
         def run_fn(feed_vals, rw_vals, ro_vals, key=None):
@@ -217,7 +236,7 @@ class ShardedProgram:
             )
 
         in_shardings = (
-            [data_sharding] * len(feed_names),
+            feed_shardings,
             [shardings[n] for n in rw_state],
             [shardings[n] for n in ro_state],
         )
