@@ -62,6 +62,8 @@ from . import profiler  # noqa: F401
 from . import amp  # noqa: F401
 from . import inference  # noqa: F401
 from . import contrib  # noqa: F401
+from . import recordio  # noqa: F401
+from . import imperative  # noqa: F401
 from .core import registry  # noqa: F401
 
 __version__ = "0.1.0"

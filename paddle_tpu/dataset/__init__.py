@@ -1,1 +1,12 @@
-from . import common, mnist  # noqa: F401
+"""Datasets (reference: python/paddle/dataset/ — 15 auto-download+cache
+datasets).  Each has a synthetic offline fallback (synthetic=True or
+PADDLE_TPU_SYNTH_DATA=1) for zero-egress environments."""
+
+from . import (  # noqa: F401
+    cifar,
+    common,
+    imdb,
+    mnist,
+    movielens,
+    uci_housing,
+)

@@ -1,0 +1,63 @@
+"""CIFAR-10/100 (reference: python/paddle/dataset/cifar.py — pickled batch
+archives; yields (flattened float image / 255, label)).
+
+Offline fallback: synthetic class-separable images."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+URL10 = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+URL100 = "https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz"
+
+
+def _synthetic(n, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, n).astype("int64")
+    imgs = rng.rand(n, 3, 32, 32).astype("float32") * 0.1
+    for i in range(n):
+        c = int(labels[i]) % 16
+        imgs[i, c % 3, (c * 2) % 28:(c * 2) % 28 + 4, :] += 0.9
+    return imgs.reshape(n, 3072), labels
+
+
+def _read_archive(url, sub_names, label_key, synthetic, num_classes, seed):
+    def reader():
+        if synthetic or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1":
+            imgs, labels = _synthetic(512, num_classes, seed)
+            for im, lb in zip(imgs, labels):
+                yield im, int(lb)
+            return
+        path = common.download(url, "cifar", None)
+        with tarfile.open(path, mode="r") as f:
+            names = [n for n in f.getnames()
+                     if any(s in n for s in sub_names)]
+            for name in sorted(names):
+                batch = pickle.load(f.extractfile(name), encoding="bytes")
+                data = batch[b"data"].astype("float32") / 255.0
+                labels = batch[label_key]
+                for im, lb in zip(data, labels):
+                    yield im, int(lb)
+    return reader
+
+
+def train10(synthetic=False):
+    return _read_archive(URL10, ["data_batch"], b"labels", synthetic, 10, 1)
+
+
+def test10(synthetic=False):
+    return _read_archive(URL10, ["test_batch"], b"labels", synthetic, 10, 2)
+
+
+def train100(synthetic=False):
+    return _read_archive(URL100, ["train"], b"fine_labels", synthetic, 100, 3)
+
+
+def test100(synthetic=False):
+    return _read_archive(URL100, ["test"], b"fine_labels", synthetic, 100, 4)

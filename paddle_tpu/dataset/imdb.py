@@ -1,0 +1,86 @@
+"""IMDB sentiment dataset (reference: python/paddle/dataset/imdb.py —
+word_dict() + train/test readers yielding (word-id list, 0/1 label);
+understand_sentiment book model).
+
+Offline fallback: synthetic reviews drawn from class-biased token
+distributions — separable, so sentiment models train on it."""
+
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from . import common
+
+URL = "https://ai.stanford.edu/~amaas/data/sentiment/aclImdb_v1.tar.gz"
+_VOCAB = 2000
+
+
+def _use_synth(synthetic):
+    return synthetic or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1"
+
+
+def word_dict(synthetic=False):
+    """word -> id (reference imdb.word_dict; ids dense from 0, <unk> last)."""
+    if _use_synth(synthetic):
+        return {f"w{i}": i for i in range(_VOCAB)} | {"<unk>": _VOCAB}
+    path = common.download(URL, "imdb", None)
+    freq = {}
+    pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+    with tarfile.open(path, mode="r") as f:
+        for name in f.getnames():
+            if pat.match(name):
+                doc = f.extractfile(name).read().decode("utf-8", "ignore")
+                for w in doc.lower().split():
+                    freq[w] = freq.get(w, 0) + 1
+    words = sorted(freq, key=lambda w: (-freq[w], w))[: _VOCAB]
+    d = {w: i for i, w in enumerate(words)}
+    d["<unk>"] = len(d)
+    return d
+
+
+def _synthetic_reader(seed, n=500):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(10, 60))
+            # positive reviews draw from the low half of the vocab,
+            # negative from the high half (overlapping but separable)
+            lo = 0 if label == 1 else _VOCAB // 2
+            ids = rng.randint(lo, lo + _VOCAB // 2 + _VOCAB // 4,
+                              length) % _VOCAB
+            yield list(ids), label
+    return reader
+
+
+def _real_reader(pattern, word_idx):
+    def reader():
+        path = common.download(URL, "imdb", None)
+        unk = word_idx.get("<unk>", len(word_idx))
+        pat = re.compile(pattern)
+        with tarfile.open(path, mode="r") as f:
+            for name in f.getnames():
+                m = pat.match(name)
+                if not m:
+                    continue
+                label = 1 if "/pos/" in name else 0
+                doc = f.extractfile(name).read().decode("utf-8", "ignore")
+                ids = [word_idx.get(w, unk) for w in doc.lower().split()]
+                yield ids, label
+    return reader
+
+
+def train(word_idx, synthetic=False):
+    if _use_synth(synthetic):
+        return _synthetic_reader(7)
+    return _real_reader(r"aclImdb/train/(pos|neg)/.*\.txt$", word_idx)
+
+
+def test(word_idx, synthetic=False):
+    if _use_synth(synthetic):
+        return _synthetic_reader(8)
+    return _real_reader(r"aclImdb/test/(pos|neg)/.*\.txt$", word_idx)

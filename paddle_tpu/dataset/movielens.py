@@ -1,0 +1,132 @@
+"""MovieLens 1M (reference: python/paddle/dataset/movielens.py — user/movie
+feature readers for the recommender_system book model; samples are
+[user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+score]).
+
+Offline fallback: synthetic users/movies with a low-rank preference
+structure, so factorization models actually learn."""
+
+from __future__ import annotations
+
+import os
+import zipfile
+
+import numpy as np
+
+from . import common
+
+URL = "https://files.grouplens.org/datasets/movielens/ml-1m.zip"
+
+MAX_USER_ID = 6040
+MAX_MOVIE_ID = 3952
+MAX_JOB_ID = 20
+AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+CATEGORIES = [
+    "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+    "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+    "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
+]
+_TITLE_VOCAB = 1000
+
+
+def max_user_id():
+    return MAX_USER_ID
+
+
+def max_movie_id():
+    return MAX_MOVIE_ID
+
+
+def max_job_id():
+    return MAX_JOB_ID
+
+
+def age_table():
+    return list(AGE_TABLE)
+
+
+def movie_categories():
+    return list(CATEGORIES)
+
+
+def _use_synth(synthetic):
+    return synthetic or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1"
+
+
+def _synthetic_samples(seed, n=2000, n_users=200, n_movies=300):
+    rng = np.random.RandomState(seed)
+    d = 4
+    uf = rng.randn(n_users + 1, d)
+    mf = rng.randn(n_movies + 1, d)
+    user_meta = {
+        u: (int(rng.randint(0, 2)), int(rng.randint(0, len(AGE_TABLE))),
+            int(rng.randint(0, MAX_JOB_ID)))
+        for u in range(1, n_users + 1)
+    }
+    movie_meta = {
+        m: (sorted(rng.choice(len(CATEGORIES), rng.randint(1, 4),
+                              replace=False).tolist()),
+            rng.randint(0, _TITLE_VOCAB, rng.randint(1, 6)).tolist())
+        for m in range(1, n_movies + 1)
+    }
+    for _ in range(n):
+        u = int(rng.randint(1, n_users + 1))
+        m = int(rng.randint(1, n_movies + 1))
+        raw = uf[u] @ mf[m]
+        score = float(np.clip(np.round(3.0 + raw), 1, 5))
+        g, a, j = user_meta[u]
+        cats, title = movie_meta[m]
+        yield [u, g, a, j, m, cats, title, score]
+
+
+def _real_samples(is_test):
+    path = common.download(URL, "movielens", None)
+    cat_idx = {c: i for i, c in enumerate(CATEGORIES)}
+    age_idx = {a: i for i, a in enumerate(AGE_TABLE)}
+    users, movies, title_vocab = {}, {}, {}
+    with zipfile.ZipFile(path) as z:
+        with z.open("ml-1m/users.dat") as f:
+            for line in f.read().decode("latin1").splitlines():
+                uid, gender, age, job, _ = line.split("::")
+                users[int(uid)] = (
+                    0 if gender == "F" else 1, age_idx[int(age)], int(job))
+        with z.open("ml-1m/movies.dat") as f:
+            for line in f.read().decode("latin1").splitlines():
+                mid, title, cats = line.split("::")
+                words = title.lower().split()
+                for w in words:
+                    title_vocab.setdefault(w, len(title_vocab) % _TITLE_VOCAB)
+                movies[int(mid)] = (
+                    [cat_idx[c] for c in cats.split("|") if c in cat_idx],
+                    [title_vocab[w] for w in words],
+                )
+        with z.open("ml-1m/ratings.dat") as f:
+            lines = f.read().decode("latin1").splitlines()
+    for i, line in enumerate(lines):
+        if (i % 10 == 9) != is_test:  # 90/10 split
+            continue
+        uid, mid, score, _ = line.split("::")
+        uid, mid = int(uid), int(mid)
+        if uid not in users or mid not in movies:
+            continue
+        g, a, j = users[uid]
+        cats, title = movies[mid]
+        yield [uid, g, a, j, mid, cats, title, float(score)]
+
+
+def train(synthetic=False):
+    def reader():
+        if _use_synth(synthetic):
+            yield from _synthetic_samples(21)
+        else:
+            yield from _real_samples(is_test=False)
+    return reader
+
+
+def test(synthetic=False):
+    def reader():
+        if _use_synth(synthetic):
+            yield from _synthetic_samples(22)
+        else:
+            yield from _real_samples(is_test=True)
+    return reader

@@ -1,0 +1,58 @@
+"""UCI housing dataset (reference: python/paddle/dataset/uci_housing.py —
+13 normalized features, median price target; fit_a_line book model).
+
+Offline fallback: synthetic linear data with the same shape/scale."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+URL = ("https://archive.ics.uci.edu/ml/machine-learning-databases/housing/"
+       "housing.data")
+FEATURE_NUM = 13
+
+
+def _load_real():
+    path = common.download(URL, "uci_housing", None)
+    data = np.loadtxt(path)
+    return data[:, :-1].astype("float32"), data[:, -1:].astype("float32")
+
+
+def _synthetic(n=506, seed=13):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, FEATURE_NUM).astype("float32")
+    w = rng.randn(FEATURE_NUM, 1).astype("float32")
+    y = x @ w + 0.1 * rng.randn(n, 1).astype("float32") + 22.5
+    return x, y.astype("float32")
+
+
+def _data(synthetic):
+    if synthetic or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1":
+        x, y = _synthetic()
+    else:
+        x, y = _load_real()
+    # feature-wise normalization (reference feature_range maximums/minimums)
+    x = (x - x.mean(axis=0)) / (x.std(axis=0) + 1e-8)
+    return x, y
+
+
+def train(synthetic=False):
+    def reader():
+        x, y = _data(synthetic)
+        n = int(len(x) * 0.8)
+        for i in range(n):
+            yield x[i], y[i]
+    return reader
+
+
+def test(synthetic=False):
+    def reader():
+        x, y = _data(synthetic)
+        n = int(len(x) * 0.8)
+        for i in range(n, len(x)):
+            yield x[i], y[i]
+    return reader

@@ -1,0 +1,193 @@
+// Chunked, seekable, CRC-checked record file format — the native data-plane
+// component of paddle_tpu (reference: paddle/fluid/recordio/ — Header
+// header.h:39, Chunk chunk.h:27, Writer writer.h:22, Scanner scanner.h; the
+// reference's is C++ too, and chunk-seekability is what enables the
+// master's task-splitting / sharded readers).
+//
+// File = sequence of chunks:
+//   u32 magic | u32 num_records | u32 payload_len | u32 payload_crc32
+//   payload = num_records * u32 record lengths, then record bytes.
+// All little-endian.  Exposed as a C ABI for ctypes (no pybind11 in the
+// image); paddle_tpu/recordio.py holds the Python face + a pure-Python
+// fallback writer/scanner for environments without a toolchain.
+//
+// Build: g++ -O2 -shared -fPIC recordio.cc -o librecordio.so -lz
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x43525450;  // "PTRC" little-endian
+
+struct Writer {
+  FILE* f = nullptr;
+  std::vector<uint32_t> lengths;
+  std::string payload;
+  uint32_t max_chunk_bytes = 1 << 20;
+
+  int flush() {
+    if (lengths.empty()) return 0;
+    std::string body;
+    body.reserve(lengths.size() * 4 + payload.size());
+    for (uint32_t len : lengths) {
+      body.append(reinterpret_cast<const char*>(&len), 4);
+    }
+    body.append(payload);
+    uint32_t header[4] = {
+        kMagic, static_cast<uint32_t>(lengths.size()),
+        static_cast<uint32_t>(body.size()),
+        static_cast<uint32_t>(
+            crc32(0, reinterpret_cast<const Bytef*>(body.data()),
+                  body.size())),
+    };
+    if (fwrite(header, 4, 4, f) != 4) return -1;
+    if (!body.empty() && fwrite(body.data(), 1, body.size(), f) !=
+        body.size()) {
+      return -1;
+    }
+    lengths.clear();
+    payload.clear();
+    return 0;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::vector<int64_t> chunk_offsets;  // file offset of each chunk header
+  // current chunk state
+  std::vector<uint32_t> lengths;
+  std::string payload;          // record bytes only
+  size_t record_idx = 0;
+  size_t byte_off = 0;
+  size_t next_chunk = 0;        // index into chunk_offsets
+
+  int index() {
+    chunk_offsets.clear();
+    if (fseek(f, 0, SEEK_END) != 0) return -1;
+    int64_t file_size = ftell(f);
+    int64_t off = 0;
+    while (off + 16 <= file_size) {
+      uint32_t header[4];
+      if (fseek(f, off, SEEK_SET) != 0) return -1;
+      if (fread(header, 4, 4, f) != 4) return -1;
+      if (header[0] != kMagic) return -2;  // corrupt
+      chunk_offsets.push_back(off);
+      off += 16 + static_cast<int64_t>(header[2]);
+    }
+    return off == file_size ? 0 : -2;
+  }
+
+  // load chunk i into memory; -2 = corrupt/crc, -1 = io error
+  int load_chunk(size_t i) {
+    if (i >= chunk_offsets.size()) return 1;  // EOF
+    uint32_t header[4];
+    if (fseek(f, chunk_offsets[i], SEEK_SET) != 0) return -1;
+    if (fread(header, 4, 4, f) != 4) return -1;
+    uint32_t num = header[1], payload_len = header[2], want_crc = header[3];
+    std::string body(payload_len, '\0');
+    if (payload_len &&
+        fread(&body[0], 1, payload_len, f) != payload_len) {
+      return -1;
+    }
+    uint32_t got_crc = crc32(
+        0, reinterpret_cast<const Bytef*>(body.data()), body.size());
+    if (got_crc != want_crc) return -2;
+    if (static_cast<size_t>(num) * 4 > body.size()) return -2;
+    lengths.assign(
+        reinterpret_cast<const uint32_t*>(body.data()),
+        reinterpret_cast<const uint32_t*>(body.data()) + num);
+    payload = body.substr(num * 4);
+    record_idx = 0;
+    byte_off = 0;
+    return 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, uint32_t max_chunk_bytes) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  if (max_chunk_bytes) w->max_chunk_bytes = max_chunk_bytes;
+  return w;
+}
+
+int rio_write(void* handle, const char* buf, uint32_t len) {
+  auto* w = static_cast<Writer*>(handle);
+  w->lengths.push_back(len);
+  w->payload.append(buf, len);
+  if (w->payload.size() >= w->max_chunk_bytes) return w->flush();
+  return 0;
+}
+
+int rio_writer_close(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  int rc = w->flush();
+  if (fclose(w->f) != 0) rc = -1;
+  delete w;
+  return rc;
+}
+
+void* rio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* s = new Scanner();
+  s->f = f;
+  if (s->index() != 0) {
+    fclose(f);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int64_t rio_num_chunks(void* handle) {
+  return static_cast<Scanner*>(handle)->chunk_offsets.size();
+}
+
+// position the scanner at the start of chunk i (for sharded reads)
+int rio_seek_chunk(void* handle, int64_t i) {
+  auto* s = static_cast<Scanner*>(handle);
+  s->next_chunk = static_cast<size_t>(i);
+  s->lengths.clear();
+  s->payload.clear();
+  s->record_idx = 0;
+  s->byte_off = 0;
+  return 0;
+}
+
+// next record in the CURRENT chunk only; 1 = chunk exhausted
+int64_t rio_next_in_chunk(void* handle, const char** out) {
+  auto* s = static_cast<Scanner*>(handle);
+  if (s->record_idx >= s->lengths.size()) return -3;  // chunk exhausted
+  uint32_t len = s->lengths[s->record_idx++];
+  *out = s->payload.data() + s->byte_off;
+  s->byte_off += len;
+  return len;
+}
+
+// load the chunk at next_chunk and advance; 1 = EOF, <0 = error
+int rio_load_next_chunk(void* handle) {
+  auto* s = static_cast<Scanner*>(handle);
+  int rc = s->load_chunk(s->next_chunk);
+  if (rc == 0) s->next_chunk++;
+  return rc;
+}
+
+void rio_scanner_close(void* handle) {
+  auto* s = static_cast<Scanner*>(handle);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

@@ -206,3 +206,75 @@ def cache(reader):
         yield from all_data
 
     return data_reader
+
+
+def double_buffer(batch_reader, capacity=2):
+    """Device-prefetch double buffering (reference:
+    operators/reader/buffered_reader.cc — pre-copies batches to the device
+    on a side stream; layers/io.py:1002 double_buffer).
+
+    A daemon thread converts upcoming batches to device arrays
+    (jnp.asarray = host->HBM copy) while the main thread's current step
+    computes; Executor._to_device_array passes device-resident feeds
+    through untouched, so the copy never lands on the critical path.
+    Works on feed dicts ({name: ndarray}) and tuples/lists of ndarrays.
+    """
+
+    def _one(v):
+        import numpy as np
+        import jax.numpy as jnp
+
+        if hasattr(v, "devices"):  # already a device array
+            return v
+        arr = np.asarray(v)
+        # Large slabs: chunk along dim 0 and transfer on a small thread
+        # pool — concurrent puts parallelize the host->device link (on
+        # tunneled chips a single big transfer degrades ~40x; measured
+        # 13 MB/s single vs ~1.1 GB/s with 4 threads x ~32MB chunks).
+        if arr.nbytes > (32 << 20) and arr.shape and arr.shape[0] > 1:
+            import concurrent.futures as cf
+
+            n = min(arr.shape[0], max(2, arr.nbytes >> 25))
+            chunks = np.array_split(arr, n, axis=0)
+            with cf.ThreadPoolExecutor(4) as pool:
+                parts = list(pool.map(jnp.asarray, chunks))
+            return jnp.concatenate(parts, axis=0)
+        return jnp.asarray(arr)
+
+    def _put(item):
+        if isinstance(item, dict):
+            return {k: _one(v) for k, v in item.items()}
+        if isinstance(item, (tuple, list)):
+            return type(item)(_one(v) for v in item)
+        return _one(item)
+
+    class _Err:
+        def __init__(self, exc):
+            self.exc = exc
+
+    def reader():
+        import queue
+        import threading
+
+        q = queue.Queue(maxsize=capacity)
+        end = object()
+
+        def work():
+            try:
+                for item in batch_reader():
+                    q.put(_put(item))
+            except Exception as e:  # propagate into the consuming thread
+                q.put(_Err(e))
+            q.put(end)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            if isinstance(item, _Err):
+                raise item.exc
+            yield item
+
+    return reader

@@ -1,0 +1,176 @@
+"""RecordIO (native C++ + python fallback), double-buffer prefetch,
+datasets (reference: paddle/fluid/recordio/, operators/reader/
+buffered_reader.cc, python/paddle/dataset/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import recordio
+from paddle_tpu.reader import decorator
+
+RECORDS = [b"alpha", b"", b"x" * 100, b"beta" * 1000, b"tail"]
+
+
+def _roundtrip(tmp_path, write_native, read_native, chunk=64):
+    path = str(tmp_path / f"t_{write_native}_{read_native}.rio")
+    with recordio.Writer(path, max_chunk_bytes=chunk,
+                         use_native=write_native) as w:
+        for r in RECORDS:
+            w.write(r)
+    got = list(recordio.Scanner(path, use_native=read_native))
+    assert got == RECORDS
+
+
+def test_recordio_python_roundtrip(tmp_path):
+    _roundtrip(tmp_path, False, False)
+
+
+@pytest.mark.skipif(not recordio.native_available(),
+                    reason="no C++ toolchain")
+def test_recordio_native_roundtrip(tmp_path):
+    _roundtrip(tmp_path, True, True)
+
+
+@pytest.mark.skipif(not recordio.native_available(),
+                    reason="no C++ toolchain")
+def test_recordio_native_python_interop(tmp_path):
+    """Same on-disk format both ways."""
+    _roundtrip(tmp_path, True, False)
+    _roundtrip(tmp_path, False, True)
+
+
+def test_recordio_sharded_chunks_partition(tmp_path):
+    path = str(tmp_path / "shard.rio")
+    recs = [f"rec{i}".encode() for i in range(40)]
+    with recordio.Writer(path, max_chunk_bytes=20) as w:  # many chunks
+        for r in recs:
+            w.write(r)
+    n_chunks = recordio.Scanner(path).num_chunks()
+    assert n_chunks >= 4
+    shards = [
+        list(recordio.Scanner(path, shard_id=i, num_shards=3))
+        for i in range(3)
+    ]
+    union = [r for s in shards for r in s]
+    assert sorted(union) == sorted(recs)      # complete, no overlap
+    assert all(len(s) > 0 for s in shards)    # each shard gets chunks
+
+
+def test_recordio_crc_detects_corruption(tmp_path):
+    path = str(tmp_path / "bad.rio")
+    with recordio.Writer(path) as w:
+        w.write(b"payload-payload-payload")
+    raw = bytearray(open(path, "rb").read())
+    raw[-3] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(OSError):
+        list(recordio.Scanner(path, use_native=False))
+    if recordio.native_available():
+        with pytest.raises(OSError):
+            list(recordio.Scanner(path, use_native=True))
+
+
+def test_double_buffer_prefetches_device_arrays():
+    import jax
+
+    batches = [{"x": np.full((2, 3), i, "float32")} for i in range(5)]
+
+    def src():
+        yield from batches
+
+    got = list(decorator.double_buffer(src)())
+    assert len(got) == 5
+    for i, b in enumerate(got):
+        assert isinstance(b["x"], jax.Array)  # already device-resident
+        np.testing.assert_array_equal(np.asarray(b["x"]), batches[i]["x"])
+
+
+def test_double_buffer_feeds_training():
+    from paddle_tpu import layers
+
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square(pred - y))
+    pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+
+    rng = np.random.RandomState(2)
+
+    def src():
+        for _ in range(10):
+            xv = rng.randn(8, 4).astype("float32")
+            yield {"x": xv,
+                   "y": xv.sum(axis=1, keepdims=True).astype("float32")}
+
+    losses = [
+        float(np.asarray(exe.run(feed=b, fetch_list=[loss])[0]))
+        for b in decorator.double_buffer(src)()
+    ]
+    assert losses[-1] < losses[0]
+
+
+def test_double_buffer_propagates_errors():
+    def src():
+        yield {"x": np.zeros(2, "float32")}
+        raise ValueError("boom")
+
+    it = decorator.double_buffer(src)()
+    next(it)
+    with pytest.raises(ValueError, match="boom"):
+        next(it)
+
+
+# ---------------------------------------------------------------------------
+# datasets (synthetic mode — offline)
+# ---------------------------------------------------------------------------
+
+
+def test_uci_housing_synthetic():
+    train = list(pt.dataset.uci_housing.train(synthetic=True)())
+    test = list(pt.dataset.uci_housing.test(synthetic=True)())
+    assert len(train) == 404 and len(test) == 102
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_cifar_synthetic():
+    samples = list(pt.dataset.cifar.train10(synthetic=True)())
+    assert len(samples) == 512
+    im, lb = samples[0]
+    assert im.shape == (3072,) and 0 <= lb < 10
+    s100 = list(pt.dataset.cifar.train100(synthetic=True)())
+    assert max(lb for _, lb in s100) > 10
+
+
+def test_imdb_synthetic():
+    wd = pt.dataset.imdb.word_dict(synthetic=True)
+    assert "<unk>" in wd
+    samples = list(pt.dataset.imdb.train(wd, synthetic=True)())
+    assert len(samples) == 500
+    ids, label = samples[0]
+    assert label in (0, 1) and all(0 <= i < len(wd) for i in ids)
+
+
+def test_movielens_synthetic():
+    samples = list(pt.dataset.movielens.train(synthetic=True)())
+    assert len(samples) == 2000
+    uid, gender, age, job, mid, cats, title, score = samples[0]
+    assert gender in (0, 1)
+    assert 0 <= age < len(pt.dataset.movielens.age_table())
+    assert all(
+        0 <= c < len(pt.dataset.movielens.movie_categories()) for c in cats)
+    assert 1.0 <= score <= 5.0
+
+
+def test_double_buffer_chunked_large_array():
+    """Arrays >32MB take the chunked threaded-put path; values intact."""
+    big = np.arange(12 * 1024 * 1024, dtype="float32").reshape(12, -1)  # 48MB
+
+    def src():
+        yield {"x": big}
+
+    (got,) = list(decorator.double_buffer(src)())
+    np.testing.assert_array_equal(np.asarray(got["x"]), big)
