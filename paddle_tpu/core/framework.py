@@ -53,6 +53,11 @@ class UniqueNameGenerator:
 
 _name_generator = UniqueNameGenerator()
 
+# When set (by paddle_tpu.imperative.guard), every op appended to any block
+# is also executed eagerly: hook(block, op).  Mirrors the reference's
+# dygraph Tracer intercepting trace calls (imperative/tracer.cc:42).
+_eager_op_hook = None
+
 
 def unique_name(key: str) -> str:
     return _name_generator(key)
@@ -439,6 +444,8 @@ class Block:
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.append(op)
         self._bump()
+        if _eager_op_hook is not None:
+            _eager_op_hook(self, op)
         return op
 
     def prepend_op(self, type, inputs=None, outputs=None, attrs=None) -> Operator:

@@ -1,0 +1,288 @@
+"""Imperative (dygraph) mode: define-by-run eager execution with autograd
+(reference: paddle/fluid/imperative/ — VarBase/OpBase layer.h:99,
+Tracer::Trace tracer.cc:42, Autograd walk layer.cc; python
+fluid/imperative/ base.py to_variable, layers.py Layer).
+
+TPU-first design: under `imperative.guard()` every op appended through the
+layers DSL ALSO executes immediately through its registered JAX lowering
+(the same single source of truth the compiled executor traces), recording a
+tape.  `.backward()` walks the tape in reverse, computing per-op input
+cotangents with jax.vjp of the op's lowering — the eager twin of the
+compiled path's generic vjp grad maker.  Because ops execute as plain JAX
+calls, eager work still runs on the TPU (dispatched op-by-op rather than
+as one fused XLA program).
+
+Python control flow IS the dygraph control flow; program-level while/cond
+sub-blocks are rejected in eager mode (the reference's dygraph had no
+control-flow ops either at Fluid 1.2)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import framework as fw
+from ..core import registry
+
+_session: Optional["EagerSession"] = None
+
+
+def enabled() -> bool:
+    return _session is not None
+
+
+def _require_session() -> "EagerSession":
+    if _session is None:
+        raise RuntimeError(
+            "imperative API used outside paddle_tpu.imperative.guard()")
+    return _session
+
+
+class EagerSession:
+    """Value store + tape + PRNG state for one guard scope (the eager
+    counterpart of TraceContext + Scope)."""
+
+    def __init__(self, seed=0):
+        import jax
+
+        self.values: Dict[str, object] = {}
+        self.tape: List[tuple] = []  # (opdef, op, ctx, in_names)
+        self.grads: Dict[str, object] = {}
+        self.is_test = False
+        self.mesh = None
+        self.amp_bf16 = False
+        self._base_key = jax.random.PRNGKey(seed)
+        self._rng_counter = 0
+        self._op_keys: Dict[int, object] = {}
+
+    def next_rng_key(self, op=None):
+        import jax
+
+        # fixed per-op key so the backward vjp re-execution sees the SAME
+        # randomness the forward drew (dropout masks etc.)
+        if op is not None and id(op) in self._op_keys:
+            return self._op_keys[id(op)]
+        self._rng_counter += 1
+        key = jax.random.fold_in(self._base_key, self._rng_counter)
+        if op is not None:
+            self._op_keys[id(op)] = key
+        return key
+
+
+def _run_op(session: EagerSession, block, op):
+    import jax.numpy as jnp
+
+    if op.attrs.get("sub_block") is not None:
+        raise NotImplementedError(
+            f"imperative mode: op {op.type!r} with a sub-block is not "
+            "supported — use Python control flow in dygraph")
+    opdef = registry.lookup(op.type)
+    if opdef is None:
+        raise RuntimeError(f"no lowering registered for op {op.type!r}")
+
+    ins = {
+        slot: [session.values.get(n) if n else None for n in names]
+        for slot, names in op.inputs.items()
+    }
+    ctx = registry.LowerContext(op, op.attrs, session)
+    outs = opdef.lower(ctx, ins)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, [])
+        for i, n in enumerate(names):
+            if n and i < len(vals):
+                session.values[n] = vals[i]
+    if not opdef.no_grad:
+        session.tape.append((opdef, op, ctx))
+
+
+def _eager_hook(block, op):
+    _run_op(_require_session(), block, op)
+
+
+@contextlib.contextmanager
+def guard(seed=0):
+    """Enter dygraph mode (reference: fluid.imperative.guard()).  Fresh
+    default programs + unique names; every layers.* call executes
+    immediately."""
+    global _session
+    if _session is not None:
+        raise RuntimeError("imperative.guard() does not nest")
+    old_main = fw.switch_main_program(fw.Program())
+    old_startup = fw.switch_startup_program(fw.Program())
+    _session = EagerSession(seed=seed)
+    fw._eager_op_hook = _eager_hook
+    try:
+        with fw.guard_unique_name():
+            yield
+    finally:
+        fw._eager_op_hook = None
+        _session = None
+        fw.switch_main_program(old_main)
+        fw.switch_startup_program(old_startup)
+
+
+def to_variable(value, name=None, stop_gradient=False):
+    """numpy -> eager Variable (reference imperative/base.py to_variable)."""
+    import jax.numpy as jnp
+
+    session = _require_session()
+    arr = np.asarray(value)
+    block = fw.default_main_program().current_block()
+    var = block.create_var(
+        name=name or fw.unique_name("eager_tmp"),
+        shape=list(arr.shape),
+        dtype=str(arr.dtype),
+    )
+    var.stop_gradient = stop_gradient
+    session.values[var.name] = jnp.asarray(arr)
+    return var
+
+
+def _accumulate(d, name, g):
+    if name in d:
+        d[name] = d[name] + g
+    else:
+        d[name] = g
+
+
+def backward(loss_var):
+    """Autograd walk over the tape (reference imperative Autograd,
+    layer.cc): seeds d(loss)=1 and pushes cotangents through each recorded
+    op via jax.vjp of its lowering."""
+    import jax
+    import jax.numpy as jnp
+
+    session = _require_session()
+    loss_val = session.values[loss_var.name]
+    if np.prod(loss_val.shape) != 1:
+        raise ValueError("backward() needs a scalar loss")
+    session.grads = {loss_var.name: jnp.ones_like(loss_val)}
+    grads = session.grads
+
+    for opdef, op, ctx in reversed(session.tape):
+        out_slots = {
+            slot: [n for n in names]
+            for slot, names in op.outputs.items()
+        }
+        # skip ops that contributed nothing to the loss
+        if not any(
+            n in grads for names in out_slots.values() for n in names if n
+        ):
+            continue
+        in_struct = {
+            slot: [session.values.get(n) if n else None for n in names]
+            for slot, names in op.inputs.items()
+        }
+
+        def fwd(diff_ins):
+            merged = {
+                slot: [
+                    (diff_ins[slot][i]
+                     if diff_ins.get(slot) and diff_ins[slot][i] is not None
+                     else in_struct[slot][i])
+                    for i in range(len(in_struct[slot]))
+                ]
+                for slot in in_struct
+            }
+            return opdef.lower(ctx, merged)
+
+        # differentiate only inexact-float inputs
+        diff_ins = {
+            slot: [
+                v if (v is not None and hasattr(v, "dtype")
+                      and jnp.issubdtype(v.dtype, jnp.inexact))
+                else None
+                for v in vals
+            ]
+            for slot, vals in in_struct.items()
+        }
+        out_vals, vjp_fn = jax.vjp(fwd, diff_ins)
+        cots = {
+            slot: [
+                (grads.get(n) if n and n in grads
+                 else (jnp.zeros_like(v) if v is not None else None))
+                for n, v in zip(out_slots.get(slot, []), vals)
+            ]
+            for slot, vals in out_vals.items()
+        }
+        (in_cots,) = vjp_fn(cots)
+        for slot, names in op.inputs.items():
+            for n, g in zip(names, in_cots.get(slot, [])):
+                if n and g is not None and hasattr(g, "dtype") \
+                        and jnp.issubdtype(g.dtype, jnp.inexact):
+                    var = fw.default_main_program().current_block(
+                    )._find_var_recursive(n)
+                    if var is not None and getattr(var, "stop_gradient",
+                                                   False):
+                        continue
+                    _accumulate(grads, n, g)
+
+
+class Layer:
+    """Dygraph layer base (reference: python fluid/imperative/layers.py).
+    Subclass and implement forward(); parameters() returns the Parameter
+    vars created by layers.* calls inside."""
+
+    def __init__(self, name_scope=None):
+        self._name_scope = name_scope
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def parameters(self):
+        return list(fw.default_main_program().all_parameters())
+
+
+def parameters():
+    """All eager parameters created so far in this guard scope."""
+    return list(fw.default_main_program().all_parameters())
+
+
+def value_of(var) -> np.ndarray:
+    return np.asarray(_require_session().values[var.name])
+
+
+def gradient_of(var) -> Optional[np.ndarray]:
+    g = _require_session().grads.get(var.name)
+    return None if g is None else np.asarray(g)
+
+
+def apply_sgd(lr: float):
+    """Minimal eager optimizer step: p -= lr * grad for every parameter
+    with a gradient (dygraph training loops in the reference era did the
+    same through the optimizer's eager path)."""
+    session = _require_session()
+    for p in parameters():
+        g = session.grads.get(p.name)
+        if g is not None:
+            session.values[p.name] = session.values[p.name] - lr * g
+
+
+def clear_gradients():
+    _require_session().grads = {}
+    _require_session().tape.clear()
+
+
+# -- Variable conveniences ---------------------------------------------------
+
+
+def _var_numpy(self):
+    return value_of(self)
+
+
+def _var_gradient(self):
+    return gradient_of(self)
+
+
+def _var_backward(self):
+    return backward(self)
+
+
+fw.Variable.numpy = _var_numpy
+fw.Variable.gradient = _var_gradient
+fw.Variable.backward = _var_backward

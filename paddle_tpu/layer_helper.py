@@ -48,6 +48,16 @@ class LayerHelper:
             init = ConstantInitializer(0.0) if is_bias else XavierInitializer()
 
         main_block = self.main_program.global_block()
+        # Dygraph: a named parameter that already holds an eager value is
+        # REUSED, not re-initialized — otherwise every layers.* call in a
+        # training loop would reset the weights it just trained (the
+        # reference's dygraph layers hold params across forward calls).
+        from . import imperative as _imp
+
+        if _imp.enabled() and attr.name in _imp._session.values:
+            existing = main_block._find_var_recursive(attr.name)
+            if existing is not None:
+                return existing
         param = main_block.create_parameter(
             attr.name,
             shape,
