@@ -12,6 +12,8 @@ from .control_flow import (  # noqa: F401
     create_array,
 )
 from .sequence import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from . import detection  # noqa: F401
 from . import math_op_patch  # noqa: F401  (installs Variable operator overloads)
 from . import nn, tensor, ops, contrib, control_flow, sequence  # noqa: F401
 from . import learning_rate_scheduler  # noqa: F401

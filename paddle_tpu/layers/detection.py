@@ -1,0 +1,151 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py —
+prior_box, box_coder, iou_similarity, bipartite_match, multiclass_nms,
+roi_pool, roi_align)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box",
+    "box_coder",
+    "iou_similarity",
+    "bipartite_match",
+    "multiclass_nms",
+    "roi_pool",
+    "roi_align",
+]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=False, clip=False, steps=None, offset=0.5,
+              name=None, min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference("float32")
+    var = helper.create_variable_for_type_inference("float32")
+    steps = steps or [0.0, 0.0]
+    helper.append_op(
+        "prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={
+            "min_sizes": list(min_sizes),
+            "max_sizes": list(max_sizes or []),
+            "aspect_ratios": list(aspect_ratios or [1.0]),
+            "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+            "flip": flip,
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+            "min_max_aspect_ratios_order": min_max_aspect_ratios_order,
+        },
+    )
+    boxes.stop_gradient = True
+    var.stop_gradient = True
+    return boxes, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        "box_coder",
+        inputs=inputs,
+        outputs={"OutputBox": [out]},
+        attrs={"code_type": code_type, "box_normalized": box_normalized},
+    )
+    return out
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "iou_similarity",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"box_normalized": box_normalized},
+    )
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference("int64")
+    dist = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "bipartite_match",
+        inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [idx], "ColToRowMatchDis": [dist]},
+        attrs={"match_type": match_type, "dist_threshold": dist_threshold},
+    )
+    return idx, dist
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_rois_num=False):
+    """Dense NMS: Out [N, keep_top_k, 6] padded with label -1 (+ optional
+    NmsRoisNum [N]); the reference returns a ragged LoD tensor."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    num = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "NmsRoisNum": [num]},
+        attrs={
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "nms_threshold": nms_threshold,
+            "normalized": normalized,
+            "nms_eta": nms_eta,
+            "background_label": background_label,
+        },
+    )
+    if return_rois_num:
+        return out, num
+    return out
+
+
+def _roi(op_type, input, rois, pooled_height, pooled_width, spatial_scale,
+         batch_idx, extra_attrs, name):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if batch_idx is not None:
+        inputs["BatchIdx"] = [batch_idx]
+    helper.append_op(
+        op_type,
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+            "spatial_scale": spatial_scale,
+            **extra_attrs,
+        },
+    )
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, batch_idx=None, name=None):
+    return _roi("roi_pool", input, rois, pooled_height, pooled_width,
+                spatial_scale, batch_idx, {}, name)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, batch_idx=None,
+              name=None):
+    return _roi("roi_align", input, rois, pooled_height, pooled_width,
+                spatial_scale, batch_idx,
+                {"sampling_ratio": sampling_ratio}, name)

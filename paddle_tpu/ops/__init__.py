@@ -14,4 +14,5 @@ from . import (  # noqa: F401
     rnn_ops,
     misc_ops,
     quant_ops,
+    detection_ops,
 )

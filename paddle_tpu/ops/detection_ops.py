@@ -1,0 +1,396 @@
+"""Detection op family (reference: paddle/fluid/operators/detection/ —
+prior_box_op.h, box_coder_op.h, iou_similarity_op.h, bipartite_match_op.cc,
+multiclass_nms_op.cc, roi_pool_op.cc, roi_align_op.cc).
+
+TPU-first redesigns:
+  * everything is dense/static-shape: multiclass_nms emits a fixed
+    [N, keep_top_k, 6] tensor padded with label -1 plus a count vector
+    (the reference emits a ragged LoD tensor on the host);
+  * NMS suppression and bipartite matching are lax.fori_loop/scan chains
+    over fixed trip counts, so the whole detection head stays inside one
+    XLA program instead of falling back to per-image C++ loops;
+  * roi_align/roi_pool sample with gathers — XLA fuses them; batch
+    membership of each ROI is an explicit BatchIdx input (the reference
+    encodes it in LoD).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _expand_aspect_ratios(ratios, flip):
+    out = [1.0]
+    for ar in ratios:
+        if all(abs(ar - o) > 1e-6 for o in out):
+            out.append(ar)
+            if flip:
+                out.append(1.0 / ar)
+    return out
+
+
+@register("prior_box", no_grad=True)
+def lower_prior_box(ctx, ins):
+    """SSD anchor generation (reference prior_box_op.h:54).  Outputs
+    Boxes/Variances [H, W, num_priors, 4] in normalized ltrb."""
+    jnp = _jnp()
+    feat = ins["Input"][0]
+    image = ins["Image"][0]
+    min_sizes = [float(s) for s in ctx.attr("min_sizes")]
+    max_sizes = [float(s) for s in ctx.attr("max_sizes", []) or []]
+    ratios = _expand_aspect_ratios(
+        [float(r) for r in ctx.attr("aspect_ratios", [1.0])],
+        ctx.attr("flip", False))
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    clip = ctx.attr("clip", False)
+    offset = ctx.attr("offset", 0.5)
+    mmao = ctx.attr("min_max_aspect_ratios_order", False)
+
+    img_h, img_w = image.shape[2], image.shape[3]
+    fh, fw = feat.shape[2], feat.shape[3]
+    step_w = ctx.attr("step_w", 0.0) or img_w / fw
+    step_h = ctx.attr("step_h", 0.0) or img_h / fh
+
+    # per-cell half-extents (static python lists -> device constants)
+    whs = []
+    for si, ms in enumerate(min_sizes):
+        per = []
+        for ar in ratios:
+            per.append((ms * math.sqrt(ar) / 2.0, ms / math.sqrt(ar) / 2.0))
+        sq = None
+        if si < len(max_sizes):
+            m = math.sqrt(ms * max_sizes[si]) / 2.0
+            sq = (m, m)
+        if mmao:
+            # min square, max square, then non-1 ratios
+            ordered = [per[0]] + ([sq] if sq else []) + per[1:]
+        else:
+            ordered = per + ([sq] if sq else [])
+        whs.extend(ordered)
+    half = jnp.asarray(whs, jnp.float32)  # [P, 2] (w/2, h/2)
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, half.shape[0]))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, half.shape[0]))
+    bw = half[None, None, :, 0]
+    bh = half[None, None, :, 1]
+    boxes = jnp.stack(
+        [(cxg - bw) / img_w, (cyg - bh) / img_h,
+         (cxg + bw) / img_w, (cyg + bh) / img_h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register("box_coder", no_grad=True)
+def lower_box_coder(ctx, ins):
+    """Encode/decode boxes against priors with variances (reference
+    box_coder_op.h encode_center_size/decode_center_size)."""
+    jnp = _jnp()
+    prior = ins["PriorBox"][0].reshape(-1, 4)
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    target = ins["TargetBox"][0]
+    code_type = ctx.attr("code_type", "encode_center_size")
+    norm = ctx.attr("box_normalized", True)
+    one = 0.0 if norm else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is not None:
+        pvar = pvar.reshape(-1, 4)
+        v0, v1, v2, v3 = pvar[:, 0], pvar[:, 1], pvar[:, 2], pvar[:, 3]
+    else:
+        v0 = v1 = v2 = v3 = 1.0
+
+    if code_type.lower().startswith("encode"):
+        t = target.reshape(-1, 4)  # [M, 4] gt boxes
+        tw = t[:, 2] - t[:, 0] + one
+        th = t[:, 3] - t[:, 1] + one
+        tcx = t[:, 0] + tw * 0.5
+        tcy = t[:, 1] + th * 0.5
+        # out[i, j] = encoding of target j against prior i
+        out = jnp.stack([
+            (tcx[None, :] - pcx[:, None]) / pw[:, None] / _col(v0),
+            (tcy[None, :] - pcy[:, None]) / ph[:, None] / _col(v1),
+            jnp.log(tw[None, :] / pw[:, None]) / _col(v2),
+            jnp.log(th[None, :] / ph[:, None]) / _col(v3),
+        ], axis=-1)
+        return {"OutputBox": [out]}
+
+    # decode: target [N, M, 4] deltas against M priors
+    t = target
+    dcx = t[..., 0] * v0 * pw + pcx
+    dcy = t[..., 1] * v1 * ph + pcy
+    dw = jnp.exp(t[..., 2] * v2) * pw
+    dh = jnp.exp(t[..., 3] * v3) * ph
+    out = jnp.stack([
+        dcx - dw * 0.5, dcy - dh * 0.5,
+        dcx + dw * 0.5 - one, dcy + dh * 0.5 - one,
+    ], axis=-1)
+    return {"OutputBox": [out]}
+
+
+def _col(v):
+    jnp = _jnp()
+    return v[:, None] if hasattr(v, "ndim") and v.ndim == 1 else v
+
+
+def _iou_matrix(a, b, norm=True):
+    """a [M,4], b [N,4] -> IoU [M,N] (reference iou_similarity_op.h)."""
+    jnp = _jnp()
+    one = 0.0 if norm else 1.0
+    area_a = (a[:, 2] - a[:, 0] + one) * (a[:, 3] - a[:, 1] + one)
+    area_b = (b[:, 2] - b[:, 0] + one) * (b[:, 3] - b[:, 1] + one)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + one, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + one, 0.0)
+    inter = iw * ih
+    return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-10)
+
+
+@register("iou_similarity", no_grad=True)
+def lower_iou_similarity(ctx, ins):
+    x = ins["X"][0].reshape(-1, 4)
+    y = ins["Y"][0].reshape(-1, 4)
+    return {"Out": [_iou_matrix(x, y, ctx.attr("box_normalized", True))]}
+
+
+@register("bipartite_match", no_grad=True)
+def lower_bipartite_match(ctx, ins):
+    """Greedy bipartite matching over a [M, N] similarity matrix
+    (reference bipartite_match_op.cc BipartiteMatch): repeatedly take the
+    globally-largest entry, match that (row gt, col prior) pair, exclude
+    both.  match_type='per_prediction' additionally matches unmatched
+    columns to their argmax row when similarity > dist_threshold.
+    Outputs ColToRowMatchIndices/ColToRowMatchDist [1, N] (-1 = unmatched).
+    """
+    import jax
+
+    jnp = _jnp()
+    sim = ins["DistMat"][0]
+    if sim.ndim == 3:
+        sim = sim[0]
+    m, n = sim.shape
+    match_type = ctx.attr("match_type", "bipartite")
+    thresh = ctx.attr("dist_threshold", 0.5)
+
+    def body(_, carry):
+        s, col_row, col_dist = carry
+        idx = jnp.argmax(s)
+        r, c = idx // n, idx % n
+        best = s[r, c]
+        do = best > -1e9
+        col_row = jnp.where(
+            do & (jnp.arange(n) == c), r.astype(jnp.int64), col_row)
+        col_dist = jnp.where(do & (jnp.arange(n) == c), best, col_dist)
+        s = jnp.where(do & (jnp.arange(m)[:, None] == r), -1e10, s)
+        s = jnp.where(do & (jnp.arange(n)[None, :] == c), -1e10, s)
+        return s, col_row, col_dist
+
+    col_row = jnp.full((n,), -1, jnp.int64)
+    col_dist = jnp.zeros((n,), jnp.float32)
+    _, col_row, col_dist = jax.lax.fori_loop(
+        0, min(m, n), body, (sim, col_row, col_dist))
+
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(sim, axis=0).astype(jnp.int64)
+        best_val = jnp.max(sim, axis=0)
+        extra = (col_row < 0) & (best_val > thresh)
+        col_row = jnp.where(extra, best_row, col_row)
+        col_dist = jnp.where(extra, best_val, col_dist)
+    return {
+        "ColToRowMatchIndices": [col_row[None, :]],
+        "ColToRowMatchDis": [col_dist[None, :]],
+    }
+
+
+@register("multiclass_nms", no_grad=True)
+def lower_multiclass_nms(ctx, ins):
+    """Per-class NMS + cross-class top-k (reference multiclass_nms_op.cc).
+
+    Dense output: Out [N, keep_top_k, 6] rows (label, score, x1, y1, x2,
+    y2), padded with label=-1; NmsRoisNum [N] valid counts (the reference
+    returns a host-built LoD tensor)."""
+    import jax
+
+    jnp = _jnp()
+    bboxes = ins["BBoxes"][0]   # [N, M, 4]
+    scores = ins["Scores"][0]   # [N, C, M]
+    bg = ctx.attr("background_label", 0)
+    score_th = ctx.attr("score_threshold", 0.0)
+    nms_th = ctx.attr("nms_threshold", 0.3)
+    nms_top_k = ctx.attr("nms_top_k", 64)
+    keep_top_k = ctx.attr("keep_top_k", 16)
+    normalized = ctx.attr("normalized", True)
+
+    n, c, m = scores.shape
+    top = min(nms_top_k if nms_top_k > 0 else m, m)
+
+    def one_class(boxes, sc):
+        # boxes [M,4], sc [M] -> (scores_kept [top], idx [top]) after NMS
+        vals, idx = jax.lax.top_k(sc, top)
+        b = jnp.take(boxes, idx, axis=0)
+        iou = _iou_matrix(b, b, normalized)
+        valid0 = vals > score_th
+
+        def body(i, keep):
+            # suppress j>i overlapping an alive i
+            alive_i = keep[i]
+            sup = (iou[i] > nms_th) & (jnp.arange(top) > i) & alive_i
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, top, body, valid0)
+        return jnp.where(keep, vals, -1.0), idx
+
+    def one_image(boxes, sc):
+        # sc [C, M]
+        cls_scores, cls_idx = jax.vmap(
+            lambda s: one_class(boxes, s))(sc)  # [C, top], [C, top]
+        labels = jnp.broadcast_to(
+            jnp.arange(c)[:, None], (c, top))
+        flat_scores = cls_scores.reshape(-1)
+        flat_idx = cls_idx.reshape(-1)
+        flat_labels = labels.reshape(-1)
+        if 0 <= bg < c:
+            flat_scores = jnp.where(flat_labels == bg, -1.0, flat_scores)
+        k = min(keep_top_k if keep_top_k > 0 else flat_scores.shape[0],
+                flat_scores.shape[0])
+        vals, sel = jax.lax.top_k(flat_scores, k)
+        sel_boxes = jnp.take(boxes, jnp.take(flat_idx, sel), axis=0)
+        sel_labels = jnp.take(flat_labels, sel)
+        # suppressed / below-threshold / background entries carry score -1
+        valid = vals > -0.5
+        out = jnp.concatenate([
+            jnp.where(valid, sel_labels, -1).astype(jnp.float32)[:, None],
+            vals[:, None],
+            sel_boxes,
+        ], axis=1)
+        return out, valid.sum().astype(jnp.int64)
+
+    outs, counts = jax.vmap(one_image)(bboxes, scores)
+    return {"Out": [outs], "NmsRoisNum": [counts]}
+
+
+def _roi_common(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0]
+    rois = ins["ROIs"][0].reshape(-1, 4)
+    if ins.get("BatchIdx"):
+        bidx = ins["BatchIdx"][0].reshape(-1).astype(jnp.int32)
+    else:
+        bidx = jnp.zeros((rois.shape[0],), jnp.int32)
+    return x, rois, bidx
+
+
+@register("roi_align", no_grad=False)
+def lower_roi_align(ctx, ins):
+    """ROI align with bilinear sampling (reference roi_align_op.cc).
+    sampling_ratio fixed grid; differentiable (generic vjp -> scatter)."""
+    import jax
+
+    jnp = _jnp()
+    x, rois, bidx = _roi_common(ctx, ins)
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    scale = ctx.attr("spatial_scale", 1.0)
+    ratio = ctx.attr("sampling_ratio", -1)
+    ratio = ratio if ratio > 0 else 2
+    n, ch, h, w = x.shape
+
+    def one(roi, bi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid [ph*ratio, pw*ratio]
+        gy = y1 + (jnp.arange(ph * ratio) + 0.5) * bin_h / ratio
+        gx = x1 + (jnp.arange(pw * ratio) + 0.5) * bin_w / ratio
+        gy = jnp.clip(gy, 0.0, h - 1.0)
+        gx = jnp.clip(gx, 0.0, w - 1.0)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+        wy = gy - y0
+        wx = gx - x0
+        img = x[bi]  # [C, H, W]
+        # bilinear: [C, gy, gx]
+        v00 = img[:, y0][:, :, x0]
+        v01 = img[:, y0][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0]
+        v11 = img[:, y1i][:, :, x1i]
+        val = (v00 * ((1 - wy)[:, None] * (1 - wx)[None, :])
+               + v01 * ((1 - wy)[:, None] * wx[None, :])
+               + v10 * (wy[:, None] * (1 - wx)[None, :])
+               + v11 * (wy[:, None] * wx[None, :]))
+        # average each ratio x ratio cell
+        val = val.reshape(ch, ph, ratio, pw, ratio).mean(axis=(2, 4))
+        return val
+
+    out = jax.vmap(one)(rois, bidx)
+    return {"Out": [out]}
+
+
+@register("roi_pool", no_grad=False)
+def lower_roi_pool(ctx, ins):
+    """ROI max pooling (reference roi_pool_op.cc).  Quantized bin edges,
+    max within each bin (empty bins -> 0)."""
+    import jax
+
+    jnp = _jnp()
+    x, rois, bidx = _roi_common(ctx, ins)
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    scale = ctx.attr("spatial_scale", 1.0)
+    n, ch, h, w = x.shape
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one(roi, bi):
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = x[bi]  # [C, H, W]
+
+        # membership masks per pooled cell (static shapes, fused by XLA)
+        py = jnp.arange(ph, dtype=jnp.float32)
+        px = jnp.arange(pw, dtype=jnp.float32)
+        y_lo = jnp.clip(jnp.floor(y1 + py * bin_h), 0, h - 1)
+        y_hi = jnp.clip(jnp.ceil(y1 + (py + 1) * bin_h), 0, h)
+        x_lo = jnp.clip(jnp.floor(x1 + px * bin_w), 0, w - 1)
+        x_hi = jnp.clip(jnp.ceil(x1 + (px + 1) * bin_w), 0, w)
+        in_y = (ys[None, :] >= y_lo[:, None]) & (ys[None, :] < y_hi[:, None])
+        in_x = (xs[None, :] >= x_lo[:, None]) & (xs[None, :] < x_hi[:, None])
+        mask = in_y[:, None, :, None] & in_x[None, :, None, :]  # [ph,pw,H,W]
+        masked = jnp.where(mask[None], img[:, None, None], -jnp.inf)
+        val = masked.max(axis=(-1, -2))  # [C, ph, pw]
+        return jnp.where(jnp.isfinite(val), val, 0.0)
+
+    out = jax.vmap(one)(rois, bidx)
+    return {"Out": [out]}

@@ -1,0 +1,177 @@
+"""Detection ops vs numpy references (reference: operators/detection/ +
+tests/unittests/test_prior_box_op.py, test_box_coder_op.py,
+test_iou_similarity_op.py, test_bipartite_match_op.py,
+test_multiclass_nms_op.py, test_roi_pool_op.py)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+rng = np.random.RandomState(6)
+
+
+def _run(fetches, feed):
+    exe = pt.Executor(pt.CPUPlace())
+    return exe.run(feed=feed, fetch_list=fetches)
+
+
+def test_prior_box_matches_reference_math():
+    feat = layers.data(name="feat", shape=[8, 4, 4], dtype="float32")
+    img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    boxes, var = layers.prior_box(
+        feat, img, min_sizes=[8.0], max_sizes=[16.0],
+        aspect_ratios=[2.0], flip=True, clip=True)
+    (b, v) = _run([boxes, var], {
+        "feat": rng.rand(1, 8, 4, 4).astype("float32"),
+        "img": rng.rand(1, 3, 32, 32).astype("float32"),
+    })
+    b, v = np.asarray(b), np.asarray(v)
+    # ratios expand to [1, 2, 0.5] + one sqrt(min*max) square = 4 priors
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    # cell (0,0): center (0.5*8, 0.5*8) = (4, 4); ar=1 box is min_size/2=4
+    np.testing.assert_allclose(
+        b[0, 0, 0], [0.0, 0.0, 8 / 32, 8 / 32], atol=1e-6)
+    # square prior: sqrt(8*16)/2 = ~5.657
+    s = np.sqrt(8 * 16) / 2
+    np.testing.assert_allclose(
+        b[0, 0, 3], [0.0, 0.0, (4 + s) / 32, (4 + s) / 32], atol=1e-5)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    assert b.min() >= 0 and b.max() <= 1  # clip
+
+
+def test_box_coder_encode_decode_roundtrip():
+    m, p = 5, 7
+    priors = np.sort(rng.rand(p, 2, 2), axis=1).reshape(p, 4)
+    priors = priors.astype("float32")
+    pvar = np.full((p, 4), 0.1, "float32")
+    gt = np.sort(rng.rand(m, 2, 2), axis=1).reshape(m, 4).astype("float32")
+
+    exe = pt.Executor(pt.CPUPlace())
+    enc_prog, dec_prog = pt.Program(), pt.Program()
+    with pt.program_guard(enc_prog, pt.Program()):
+        pb = layers.data(name="pb", shape=[4], dtype="float32")
+        pv = layers.data(name="pv", shape=[4], dtype="float32")
+        tb = layers.data(name="tb", shape=[4], dtype="float32")
+        enc = layers.box_coder(pb, pv, tb, code_type="encode_center_size")
+    (e,) = exe.run(enc_prog, feed={"pb": priors, "pv": pvar, "tb": gt},
+                   fetch_list=[enc])
+    e = np.asarray(e)
+    assert e.shape == (p, m, 4)
+
+    # decode(encode(gt)) == gt: feed the per-prior encoding row-aligned
+    with pt.program_guard(dec_prog, pt.Program()):
+        pb2 = layers.data(name="pb2", shape=[4], dtype="float32")
+        pv2 = layers.data(name="pv2", shape=[4], dtype="float32")
+        tb2 = layers.data(name="tb2", shape=[7, 4], dtype="float32")
+        dec = layers.box_coder(pb2, pv2, tb2,
+                               code_type="decode_center_size")
+    # take gt 0's encoding against every prior -> decode must give gt 0
+    (d,) = exe.run(dec_prog,
+                   feed={"pb2": priors, "pv2": pvar,
+                         "tb2": e[None, :, 0, :]},
+                   fetch_list=[dec])
+    d = np.asarray(d)[0]
+    np.testing.assert_allclose(d, np.tile(gt[0], (p, 1)), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], "float32")
+    b = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], "float32")
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[4], dtype="float32")
+    out = layers.iou_similarity(x, y)
+    (o,) = _run([out], {"x": a, "y": b})
+    expected = np.array([[1.0, 0.0], [1 / 7, 1 / 7]], "float32")
+    np.testing.assert_allclose(np.asarray(o), expected, atol=1e-6)
+
+
+def test_bipartite_match_greedy():
+    sim = np.array([
+        [0.9, 0.1, 0.3],
+        [0.8, 0.7, 0.2],
+    ], "float32")
+    d = layers.data(name="d", shape=[3], dtype="float32")
+    idx, dist = layers.bipartite_match(d)
+    (i, ds) = _run([idx, dist], {"d": sim})
+    i, ds = np.asarray(i)[0], np.asarray(ds)[0]
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; col 2 unmatched
+    np.testing.assert_array_equal(i, [0, 1, -1])
+    np.testing.assert_allclose(ds[:2], [0.9, 0.7])
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # 4 boxes: 0/1 overlap heavily, 2 is separate, 3 overlaps 2
+    boxes = np.array([[
+        [0, 0, 10, 10],
+        [1, 1, 11, 11],
+        [20, 20, 30, 30],
+        [21, 21, 31, 31],
+    ]], "float32")
+    scores = np.zeros((1, 2, 4), "float32")
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.6]  # class 1 (class 0 = background)
+    bb = layers.data(name="bb", shape=[4, 4], dtype="float32")
+    sc = layers.data(name="sc", shape=[2, 4], dtype="float32")
+    out, num = layers.multiclass_nms(
+        bb, sc, score_threshold=0.1, nms_top_k=4, keep_top_k=4,
+        nms_threshold=0.5, normalized=False, return_rois_num=True)
+    (o, n) = _run([out, num], {"bb": boxes, "sc": scores})
+    o, n = np.asarray(o)[0], int(np.asarray(n)[0])
+    assert n == 2  # one survivor per overlapping pair
+    kept = o[o[:, 0] >= 0]
+    assert len(kept) == 2
+    np.testing.assert_allclose(kept[:, 1], [0.9, 0.7])  # best of each pair
+    np.testing.assert_allclose(kept[0, 2:], [0, 0, 10, 10])
+    np.testing.assert_allclose(kept[1, 2:], [20, 20, 30, 30])
+
+
+def test_roi_pool_max_per_bin():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], "float32")
+    xi = layers.data(name="x", shape=[1, 4, 4], dtype="float32")
+    ri = layers.data(name="rois", shape=[4], dtype="float32")
+    out = layers.roi_pool(xi, ri, pooled_height=2, pooled_width=2,
+                          spatial_scale=1.0)
+    (o,) = _run([out], {"x": x, "rois": rois})
+    np.testing.assert_allclose(
+        np.asarray(o)[0, 0], [[5.0, 7.0], [13.0, 15.0]])
+
+
+def test_roi_align_constant_field():
+    """On a constant feature map, roi_align must return the constant."""
+    x = np.full((1, 2, 8, 8), 3.25, "float32")
+    rois = np.array([[1.0, 1.0, 6.0, 6.0], [0.0, 0.0, 7.5, 7.5]], "float32")
+    xi = layers.data(name="x", shape=[2, 8, 8], dtype="float32")
+    ri = layers.data(name="rois", shape=[4], dtype="float32")
+    out = layers.roi_align(xi, ri, pooled_height=3, pooled_width=3,
+                           spatial_scale=1.0, sampling_ratio=2)
+    (o,) = _run([out], {"x": x, "rois": rois})
+    np.testing.assert_allclose(np.asarray(o), np.full((2, 2, 3, 3), 3.25),
+                               rtol=1e-6)
+
+
+def test_roi_align_is_differentiable():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core import registry
+
+    lower = registry.lookup("roi_align").lower
+
+    class Ctx:
+        is_test = False
+
+        def attr(self, name, default=None):
+            return {"pooled_height": 2, "pooled_width": 2,
+                    "spatial_scale": 1.0, "sampling_ratio": 2}.get(
+                        name, default)
+
+    xv = jnp.asarray(rng.rand(1, 1, 6, 6).astype("float32"))
+    rois = jnp.asarray(np.array([[0.0, 0.0, 5.0, 5.0]], "float32"))
+
+    def f(x):
+        return lower(Ctx(), {"X": [x], "ROIs": [rois]})["Out"][0].sum()
+
+    g = jax.grad(f)(xv)
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
