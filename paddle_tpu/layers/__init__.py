@@ -6,6 +6,7 @@ from .ops import *  # noqa: F401,F403
 from .control_flow import (  # noqa: F401
     While,
     Switch,
+    IfElse,
     array_write,
     array_read,
     array_length,

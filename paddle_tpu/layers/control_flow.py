@@ -206,3 +206,80 @@ class _SwitchCaseGuard:
             attrs={"sub_block": sub},
         )
         return True
+
+
+class IfElse:
+    """Row-wise conditional (reference: control_flow.py IfElse, ~L1500).
+
+    TPU-first divergence: the reference gathers true/false row subsets and
+    runs each block only on its subset; under XLA both blocks run on the
+    FULL batch and results merge with a masked select — the standard
+    dense-compute idiom (no dynamic shapes), same results.
+
+        ie = layers.IfElse(cond)          # cond: [b, 1] bool
+        with ie.true_block():
+            ie.output(f_true(ie.input(x)))
+        with ie.false_block():
+            ie.output(f_false(ie.input(x)))
+        (merged,) = ie()
+    """
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self._outputs = {True: [], False: []}
+        self._in_branch = None
+
+    def _branch(self, flag):
+        ie = self
+
+        class _Guard:
+            def __enter__(self):
+                if ie._in_branch is not None:
+                    raise RuntimeError("IfElse blocks do not nest")
+                ie._in_branch = flag
+
+            def __exit__(self, *exc):
+                ie._in_branch = None
+                return False
+
+        return _Guard()
+
+    def true_block(self):
+        return self._branch(True)
+
+    def false_block(self):
+        return self._branch(False)
+
+    def input(self, x):
+        """The reference splits x by cond here; dense execution passes it
+        through untouched."""
+        if self._in_branch is None:
+            raise RuntimeError("IfElse.input() outside a block")
+        return x
+
+    def output(self, *outs):
+        if self._in_branch is None:
+            raise RuntimeError("IfElse.output() outside a block")
+        self._outputs[self._in_branch].extend(outs)
+
+    def __call__(self):
+        from . import tensor as T
+
+        t_outs = self._outputs[True]
+        f_outs = self._outputs[False]
+        if len(t_outs) != len(f_outs):
+            raise ValueError(
+                f"IfElse: true block registered {len(t_outs)} outputs, "
+                f"false block {len(f_outs)}")
+        helper = self.helper
+        cond_f = T.cast(self.cond, "float32")
+        merged = []
+        for tv, fv in zip(t_outs, f_outs):
+            # out = cond * true + (1 - cond) * false ([b,1] broadcasts)
+            not_cond = T.elementwise_sub(
+                T.fill_constant([1], "float32", 1.0), cond_f)
+            a = T.elementwise_mul(tv, cond_f)
+            b = T.elementwise_mul(fv, not_cond)
+            merged.append(T.elementwise_add(a, b))
+        return merged

@@ -925,3 +925,102 @@ def crf_decoding(input, param_attr, label=None, length=None):
         outputs={"ViterbiPath": [path]},
     )
     return path
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    """NCDHW 3-D convolution (reference: layers/nn.py conv3d,
+    conv_op.cc Conv3D)."""
+    helper = LayerHelper("conv3d", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+
+    def triple(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+
+    fs = triple(filter_size)
+    c_in = input.shape[1]
+    w = helper.create_parameter(
+        attr=helper.param_attr(),
+        shape=[num_filters, c_in // groups] + fs,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={
+            "strides": triple(stride),
+            "paddings": triple(padding),
+            "dilations": triple(dilation),
+            "groups": groups,
+        },
+    )
+    if helper.bias_attr() is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr(), shape=[num_filters],
+            dtype=input.dtype, is_bias=True)
+        biased = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(
+            "elementwise_add",
+            inputs={"X": [out], "Y": [b]},
+            outputs={"Out": [biased]},
+            attrs={"axis": 1},
+        )
+        out = biased
+    return helper.append_activation(out)
+
+
+def pool3d(input, pool_size=2, pool_type="max", pool_stride=None,
+           pool_padding=0, global_pooling=False, name=None):
+    """NCDHW 3-D pooling (reference: layers/nn.py pool3d)."""
+    helper = LayerHelper("pool3d", name=name)
+
+    def triple(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool3d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "ksize": triple(pool_size),
+            "strides": triple(pool_stride or pool_size),
+            "paddings": triple(pool_padding),
+            "pooling_type": pool_type,
+            "global_pooling": global_pooling,
+        },
+    )
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, length=None):
+    """Chunking evaluation (reference: layers/nn.py chunk_eval,
+    chunk_eval_op.h).  Returns (precision, recall, f1, num_infer,
+    num_label, num_correct)."""
+    helper = LayerHelper("chunk_eval")
+    outs = [helper.create_variable_for_type_inference(dt)
+            for dt in ("float32", "float32", "float32",
+                       "int64", "int64", "int64")]
+    inputs = {"Inference": [input], "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        "chunk_eval",
+        inputs=inputs,
+        outputs={
+            "Precision": [outs[0]],
+            "Recall": [outs[1]],
+            "F1-Score": [outs[2]],
+            "NumInferChunks": [outs[3]],
+            "NumLabelChunks": [outs[4]],
+            "NumCorrectChunks": [outs[5]],
+        },
+        attrs={
+            "chunk_scheme": chunk_scheme,
+            "num_chunk_types": num_chunk_types,
+            "excluded_chunk_types": list(excluded_chunk_types or []),
+        },
+    )
+    return tuple(outs)

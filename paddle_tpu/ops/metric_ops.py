@@ -93,3 +93,176 @@ def _install():
 
 
 _install()
+
+
+_CHUNK_SCHEMES = {
+    # scheme: (num_tag_types, begin, inside, end, single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+@register("chunk_eval", no_grad=True)
+def lower_chunk_eval(ctx, ins):
+    """Chunking (NER-style) evaluation (reference: chunk_eval_op.h
+    GetSegments/ChunkBegin/ChunkEnd).
+
+    Dense TPU form: Inference/Label [b, T] + optional Length [b].  The
+    reference walks segments per sequence on the host; here ChunkBegin /
+    ChunkEnd are evaluated pointwise over adjacent positions and segment
+    matching reduces to begin-aligned + type-equal + same next-end —
+    computed with a reverse cumulative min, so the whole metric is one
+    fused XLA program.
+    """
+    import jax
+
+    jnp = _jnp()
+    inf = ins["Inference"][0]
+    lab = ins["Label"][0]
+    b = inf.shape[0]
+    inf = inf.reshape(b, -1).astype(jnp.int32)
+    lab = lab.reshape(b, -1).astype(jnp.int32)
+    t_max = inf.shape[1]
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((b,), t_max, jnp.int32)
+
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    num_chunk_types = ctx.attr("num_chunk_types")
+    excluded = list(ctx.attr("excluded_chunk_types", []) or [])
+    ntag, t_begin, t_inside, t_end, t_single = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types
+
+    pos_mask = jnp.arange(t_max)[None, :] < length[:, None]
+
+    def segments(seq):
+        # seq [b, T] encoded labels; positions past length -> other type
+        tag = seq % ntag
+        typ = jnp.where(pos_mask, seq // ntag, other)
+        # prev at position 0: type=other (tag irrelevant)
+        ptag = jnp.pad(tag[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+        ptyp = jnp.pad(typ[:, :-1], ((0, 0), (1, 0)), constant_values=other)
+
+        def chunk_begin(pt, pty, t, ty):
+            return jnp.where(
+                pty == other, ty != other,
+                jnp.where(
+                    ty == other, False,
+                    jnp.where(
+                        ty != pty, True,
+                        (t == t_begin) | (t == t_single)
+                        | ((t == t_inside) & ((pt == t_end)
+                                              | (pt == t_single)))
+                        | ((t == t_end) & ((pt == t_end)
+                                           | (pt == t_single))))))
+
+        def chunk_end(pt, pty, t, ty):
+            return jnp.where(
+                pty == other, False,
+                jnp.where(
+                    ty == other, True,
+                    jnp.where(
+                        ty != pty, True,
+                        ((pt == t_begin) | (pt == t_inside))
+                        & ((t == t_begin) | (t == t_single))
+                        | (pt == t_end) | (pt == t_single))))
+
+        begin = chunk_begin(ptag, ptyp, tag, typ) & (typ != other)
+        # end_at[i]: i is the last position of a chunk — the NEXT position
+        # triggers ChunkEnd (or the sequence ends here)
+        ntag_ = jnp.pad(tag[:, 1:], ((0, 0), (0, 1)), constant_values=-1)
+        ntyp_ = jnp.pad(typ[:, 1:], ((0, 0), (0, 1)),
+                        constant_values=other)
+        end_at = (typ != other) & chunk_end(tag, typ, ntag_, ntyp_)
+        # next-end index per position (reverse cumulative min)
+        idx = jnp.broadcast_to(jnp.arange(t_max), typ.shape)
+        e_idx = jnp.where(end_at, idx, t_max + 1)
+        next_end = jnp.flip(
+            jax.lax.associative_scan(
+                jnp.minimum, jnp.flip(e_idx, axis=1), axis=1),
+            axis=1)
+        keep = begin
+        for ex in excluded:
+            keep = keep & (typ != ex)
+        return keep, typ, next_end
+
+    lb, lt, le = segments(lab)
+    ib, it, ie = segments(inf)
+    num_label = lb.sum()
+    num_infer = ib.sum()
+    correct = (lb & ib & (lt == it) & (le == ie)).sum()
+
+    nl = num_label.astype(jnp.float32)
+    ni = num_infer.astype(jnp.float32)
+    nc = correct.astype(jnp.float32)
+    precision = jnp.where(ni > 0, nc / ni, 0.0)
+    recall = jnp.where(nl > 0, nc / nl, 0.0)
+    f1 = jnp.where(nc > 0,
+                   2 * precision * recall / (precision + recall), 0.0)
+    return {
+        "Precision": [precision.reshape(1)],
+        "Recall": [recall.reshape(1)],
+        "F1-Score": [f1.reshape(1)],
+        "NumInferChunks": [num_infer.astype(jnp.int64).reshape(1)],
+        "NumLabelChunks": [num_label.astype(jnp.int64).reshape(1)],
+        "NumCorrectChunks": [correct.astype(jnp.int64).reshape(1)],
+    }
+
+
+@register("precision_recall", no_grad=True)
+def lower_precision_recall(ctx, ins):
+    """Multi-class precision/recall/F1, macro + micro averaged, with
+    running accumulation (reference: metrics/precision_recall_op.cc).
+
+    Inputs: MaxProbs [b,1] + Indices [b,1] (predicted class) or Indices
+    only, Labels [b,1], optional Weights [b,1], optional StatesInfo
+    [C, 4] running (TP, FP, TN, FN).  Outputs BatchMetrics [6],
+    AccumMetrics [6], AccumStatesInfo [C, 4]."""
+    jnp = _jnp()
+    idx = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    labels = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    c = ctx.attr("class_number")
+    if ins.get("Weights"):
+        w = ins["Weights"][0].reshape(-1).astype(jnp.float32)
+    else:
+        w = jnp.ones(idx.shape, jnp.float32)
+
+    cls = jnp.arange(c)
+    pred_oh = (idx[:, None] == cls[None, :]).astype(jnp.float32) * w[:, None]
+    lab_oh = (labels[:, None] == cls[None, :]).astype(jnp.float32) * w[:, None]
+    correct = ((idx == labels)[:, None]
+               & (labels[:, None] == cls[None, :])).astype(jnp.float32)
+    correct = correct * w[:, None]
+    tp = correct.sum(axis=0)
+    fp = pred_oh.sum(axis=0) - tp
+    fn = lab_oh.sum(axis=0) - tp
+    tn = w.sum() - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)  # [C, 4]
+
+    if ins.get("StatesInfo"):
+        accum_states = ins["StatesInfo"][0].astype(jnp.float32) + batch_states
+    else:
+        accum_states = batch_states
+
+    def metrics(states):
+        tp_, fp_, tn_, fn_ = (states[:, 0], states[:, 1], states[:, 2],
+                              states[:, 3])
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_ + 1e-10), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_ + 1e-10), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec + 1e-10),
+                       0.0)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        tps, fps, fns = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = jnp.where(tps + fps > 0, tps / (tps + fps + 1e-10), 0.0)
+        mr = jnp.where(tps + fns > 0, tps / (tps + fns + 1e-10), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / (mp + mr + 1e-10), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    return {
+        "BatchMetrics": [metrics(batch_states)],
+        "AccumMetrics": [metrics(accum_states)],
+        "AccumStatesInfo": [accum_states],
+    }

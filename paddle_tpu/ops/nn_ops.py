@@ -783,3 +783,102 @@ def lower_hierarchical_sigmoid(ctx, ins):
     per_node = jax.nn.softplus((1.0 - 2.0 * bit) * z)
     cost = jnp.where(valid, per_node, 0.0).sum(axis=1)
     return {"Out": [cost[:, None]]}
+
+
+# ---------------------------------------------------------------------------
+# conv3d (reference: conv_op.cc Conv3D, vol2col fallback)
+# ---------------------------------------------------------------------------
+
+
+def _conv3d_infer(ctx):
+    xs = ctx.input_shape("Input")
+    ws = ctx.input_shape("Filter")
+    if xs is None or ws is None:
+        return
+    strides = ctx.attr("strides", [1, 1, 1])
+    paddings = ctx.attr("paddings", [0, 0, 0])
+    dilations = ctx.attr("dilations", [1, 1, 1])
+    n, _, d, h, w = xs
+    oc, _, kd, kh, kw = ws
+
+    def out(sz, p, dil, k, s):
+        return (sz + 2 * p - (dil * (k - 1) + 1)) // s + 1
+
+    ctx.set_output(
+        "Output",
+        (n, oc,
+         out(d, paddings[0], dilations[0], kd, strides[0]),
+         out(h, paddings[1], dilations[1], kh, strides[1]),
+         out(w, paddings[2], dilations[2], kw, strides[2])),
+        ctx.input_dtype("Input"),
+    )
+
+
+@register("conv3d", infer_shape=_conv3d_infer)
+def lower_conv3d(ctx, ins):
+    """NCDHW 3-D convolution (reference conv_op.cc:1 Conv3DOpMaker); XLA
+    tiles it onto the MXU like conv2d — no vol2col needed."""
+    import jax.lax as lax
+
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(ctx.attr("strides", [1, 1, 1]))
+    p = ctx.attr("paddings", [0, 0, 0])
+    dilations = tuple(ctx.attr("dilations", [1, 1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+def _pool3d_infer(ctx):
+    xs = ctx.input_shape("X")
+    if xs is None:
+        return
+    if ctx.attr("global_pooling", False):
+        ctx.set_output("Out", (xs[0], xs[1], 1, 1, 1),
+                       ctx.input_dtype("X"))
+        return
+    ksize = ctx.attr("ksize", [2, 2, 2])
+    strides = ctx.attr("strides", ksize)
+    p = ctx.attr("paddings", [0, 0, 0])
+    dims = tuple(
+        (xs[2 + i] + 2 * p[i] - ksize[i]) // strides[i] + 1
+        for i in range(3)
+    )
+    ctx.set_output("Out", (xs[0], xs[1]) + dims, ctx.input_dtype("X"))
+
+
+@register("pool3d", infer_shape=_pool3d_infer)
+def lower_pool3d(ctx, ins):
+    """NCDHW max/avg 3-D pooling (reference pool_op.cc Pool3D)."""
+    import jax.lax as lax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    ksize = ctx.attr("ksize", [2, 2, 2])
+    strides = ctx.attr("strides", ksize)
+    p = ctx.attr("paddings", [0, 0, 0])
+    ptype = ctx.attr("pooling_type", "max")
+    global_pool = ctx.attr("global_pooling", False)
+    if global_pool:
+        ksize = list(x.shape[2:])
+        strides = ksize
+        p = [0, 0, 0]
+    window = (1, 1) + tuple(ksize)
+    strides_ = (1, 1) + tuple(strides)
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides_, pads)
+    else:
+        ones = jnp.ones_like(x)
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides_, pads)
+        c = lax.reduce_window(ones, 0.0, lax.add, window, strides_, pads)
+        out = s / c
+    return {"Out": [out]}

@@ -516,3 +516,72 @@ Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 LarsMomentum = LarsMomentumOptimizer
+
+
+class ModelAverage:
+    """Running average of parameters for evaluation (reference:
+    python/paddle/fluid/optimizer.py:1467 ModelAverage).
+
+    Call AFTER minimize(): appends per-step accumulation ops (sum += param,
+    n += 1) to the main program, so averaging rides inside the compiled
+    train step.  `apply(executor)` swaps averaged weights in (a context
+    manager — weights restore on exit), mirroring the reference's
+    apply/restore programs.  The reference's rotating sum_1/2/3 windows
+    are an overflow guard for fp32 accumulation on 2018 hardware; here a
+    single fp32 running sum is kept (documented simplification)."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=1,
+                 max_average_window=10000, program=None,
+                 startup_program=None):
+        from . import layers
+        from .core import framework as fw
+
+        self.program = program or fw.default_main_program()
+        startup = startup_program or fw.default_startup_program()
+        self._pairs = []  # (param, sum_var, n_var)
+        with fw.program_guard(self.program, startup):
+            for p in self.program.all_parameters():
+                if not getattr(p, "trainable", True):
+                    continue
+                sum_var = layers.create_global_var(
+                    shape=list(p.shape), value=0.0, dtype="float32",
+                    persistable=True, name=f"{p.name}.avg_sum")
+                n_var = layers.create_global_var(
+                    shape=[1], value=0.0, dtype="float32",
+                    persistable=True, name=f"{p.name}.avg_n")
+                new_sum = layers.elementwise_add(
+                    sum_var, layers.cast(p, "float32"))
+                layers.assign(new_sum, output=sum_var)
+                layers.increment(n_var, value=1.0, in_place=True)
+                self._pairs.append((p, sum_var, n_var))
+
+    import contextlib as _ctx
+
+    @_ctx.contextmanager
+    def apply(self, executor, need_restore=True, scope=None):
+        import numpy as np
+
+        from .core.executor import global_scope
+
+        scope = scope or global_scope()
+        saved = {}
+        for p, s, n in self._pairs:
+            pv = scope.find_var(p.name)
+            sv = np.asarray(scope.find_var(s.name))
+            nv = float(np.asarray(scope.find_var(n.name)).reshape(-1)[0])
+            if nv <= 0:
+                continue
+            saved[p.name] = pv
+            avg = (sv / nv).astype(str(
+                np.asarray(pv).dtype) if pv is not None else "float32")
+            scope.set_var(p.name, avg)
+        try:
+            yield
+        finally:
+            if need_restore:
+                for name, val in saved.items():
+                    scope.set_var(name, val)
+
+    def restore(self, executor, scope=None):
+        """No-op (apply() is a context manager that restores on exit);
+        kept for reference-signature parity."""

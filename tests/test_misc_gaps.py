@@ -1,0 +1,190 @@
+"""conv3d/pool3d, ModelAverage, chunk_eval, precision_recall, IfElse
+(reference: conv_op.cc Conv3D, optimizer.py:1467 ModelAverage,
+chunk_eval_op.h, metrics/precision_recall_op.cc, control_flow.py IfElse)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+rng = np.random.RandomState(17)
+
+
+def _run(fetches, feed, startup=True):
+    exe = pt.Executor(pt.CPUPlace())
+    if startup:
+        exe.run(pt.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetches)
+
+
+def test_conv3d_matches_manual():
+    x = rng.randn(2, 3, 4, 5, 5).astype("float32")
+    xi = layers.data(name="x", shape=[3, 4, 5, 5], dtype="float32")
+    out = layers.conv3d(xi, num_filters=4, filter_size=3, padding=1,
+                        bias_attr=False)
+    (o,) = _run([out], {"x": x})
+    o = np.asarray(o)
+    assert o.shape == (2, 4, 4, 5, 5)
+    # compare center element against manual correlation with the weight
+    w = np.asarray(pt.global_scope().find_var(
+        pt.default_main_program().all_parameters()[0].name))
+    patch = x[0, :, 1:4, 1:4, 1:4]
+    expected = (patch * w[1]).sum()
+    np.testing.assert_allclose(o[0, 1, 2, 2, 2], expected, rtol=1e-4)
+
+
+def test_conv3d_trains():
+    x = layers.data(name="x", shape=[1, 4, 6, 6], dtype="float32")
+    label = layers.data(name="y", shape=[1], dtype="float32")
+    c = layers.conv3d(x, num_filters=2, filter_size=3, padding=1, act="relu")
+    p = layers.pool3d(c, global_pooling=True)
+    pred = layers.fc(layers.reshape(p, [-1, 2]), size=1)
+    loss = layers.mean(layers.square(pred - label))
+    pt.optimizer.AdamOptimizer(learning_rate=0.02).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for _ in range(20):
+        xv = rng.randn(8, 1, 4, 6, 6).astype("float32")
+        yv = xv.mean(axis=(1, 2, 3, 4), keepdims=False)[:, None] * 3
+        (lv,) = exe.run(feed={"x": xv, "y": yv.astype("float32")},
+                        fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_pool3d_max_and_avg():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 2, 2, 4)
+    xi = layers.data(name="x", shape=[1, 2, 2, 4], dtype="float32")
+    mx = layers.pool3d(xi, pool_size=2, pool_type="max")
+    av = layers.pool3d(xi, pool_size=2, pool_type="avg")
+    (m, a) = _run([mx, av], {"x": x}, startup=False)
+    np.testing.assert_allclose(np.asarray(m)[0, 0, 0, 0], [13.0, 15.0])
+    np.testing.assert_allclose(np.asarray(a)[0, 0, 0, 0], [6.5, 8.5])
+
+
+def _chunks_iob(seq, n_types):
+    """Reference-style segment extraction, host mirror (IOB)."""
+    segs, start, cur = [], None, None
+    for i, v in enumerate(seq):
+        tag, typ = v % 2, v // 2
+        if typ == n_types:  # O
+            if start is not None:
+                segs.append((start, i - 1, cur))
+                start = None
+            continue
+        if tag == 0 or start is None or typ != cur:
+            if start is not None:
+                segs.append((start, i - 1, cur))
+            start, cur = i, typ
+    if start is not None:
+        segs.append((start, len(seq) - 1, cur))
+    return set(segs)
+
+
+def test_chunk_eval_matches_host_mirror():
+    n_types, t, b = 3, 12, 4
+    o_label = 2 * n_types  # "O" = num_chunk_types * num_tag_types
+    inf = rng.randint(0, o_label + 1, (b, t)).astype("int64")
+    lab = rng.randint(0, o_label + 1, (b, t)).astype("int64")
+    lengths = np.array([12, 9, 5, 12], "int64")
+
+    xi = layers.data(name="inf", shape=[t], dtype="int64")
+    li = layers.data(name="lab", shape=[t], dtype="int64")
+    ln = layers.data(name="len", shape=[1], dtype="int64")
+    outs = layers.chunk_eval(xi, li, chunk_scheme="IOB",
+                             num_chunk_types=n_types, length=ln)
+    res = _run(list(outs), {"inf": inf, "lab": lab, "len": lengths},
+               startup=False)
+    prec, rec, f1, n_inf, n_lab, n_cor = [np.asarray(r) for r in res]
+
+    # host mirror
+    ti = tl = tc = 0
+    for i in range(b):
+        L = lengths[i]
+        si = _chunks_iob(inf[i, :L], n_types)
+        sl = _chunks_iob(lab[i, :L], n_types)
+        ti += len(si)
+        tl += len(sl)
+        tc += len(si & sl)
+    assert int(n_inf[0]) == ti
+    assert int(n_lab[0]) == tl
+    assert int(n_cor[0]) == tc
+    if ti and tl:
+        np.testing.assert_allclose(prec[0], tc / ti, rtol=1e-5)
+        np.testing.assert_allclose(rec[0], tc / tl, rtol=1e-5)
+
+
+def test_precision_recall_accumulates():
+    from paddle_tpu.core import registry
+
+    lower = registry.lookup("precision_recall").lower
+
+    class Ctx:
+        is_test = False
+
+        def attr(self, name, default=None):
+            return {"class_number": 3}.get(name, default)
+
+    import jax.numpy as jnp
+
+    idx = jnp.asarray([[0], [1], [2], [1]])
+    lab = jnp.asarray([[0], [2], [2], [1]])
+    outs = lower(Ctx(), {"Indices": [idx], "Labels": [lab]})
+    batch = np.asarray(outs["BatchMetrics"][0])
+    states = np.asarray(outs["AccumStatesInfo"][0])
+    # tp per class: c0=1, c1=1, c2=1 ; fp: c1 has one wrong prediction
+    np.testing.assert_allclose(states[:, 0], [1, 1, 1])  # TP
+    np.testing.assert_allclose(states[:, 1], [0, 1, 0])  # FP
+    np.testing.assert_allclose(states[:, 3], [0, 0, 1])  # FN
+    # micro precision = 3/4
+    np.testing.assert_allclose(batch[3], 0.75, rtol=1e-5)
+    # accumulate a second identical batch
+    outs2 = lower(Ctx(), {"Indices": [idx], "Labels": [lab],
+                          "StatesInfo": [outs["AccumStatesInfo"][0]]})
+    states2 = np.asarray(outs2["AccumStatesInfo"][0])
+    np.testing.assert_allclose(states2, states * 2)
+
+
+def test_ifelse_merges_row_wise():
+    x = layers.data(name="x", shape=[2], dtype="float32")
+    zero = layers.fill_constant([1], "float32", 0.0)
+    row_sum = layers.reduce_sum(x, dim=1, keep_dim=True)  # [b,1]
+    cond = layers.less_than(zero, row_sum)  # sum > 0
+    ie = layers.IfElse(cond)
+    with ie.true_block():
+        ie.output(layers.scale(ie.input(x), scale=2.0))
+    with ie.false_block():
+        ie.output(layers.scale(ie.input(x), scale=-1.0))
+    (merged,) = ie()
+    xv = np.array([[1.0, 2.0], [-3.0, 1.0]], "float32")
+    (o,) = _run([merged], {"x": xv}, startup=False)
+    np.testing.assert_allclose(
+        np.asarray(o), [[2.0, 4.0], [3.0, -1.0]])
+
+
+def test_model_average_swaps_and_restores():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1, bias_attr=False,
+                     param_attr=pt.param_attr.ParamAttr(name="ma_w"))
+    loss = layers.mean(layers.square(pred - y))
+    pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    ma = pt.optimizer.ModelAverage()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+
+    w_hist = []
+    for step in range(5):
+        xv = rng.randn(8, 4).astype("float32")
+        yv = xv.sum(axis=1, keepdims=True).astype("float32")
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        w_hist.append(np.asarray(pt.global_scope().find_var("ma_w")).copy())
+
+    current = np.asarray(pt.global_scope().find_var("ma_w")).copy()
+    expected_avg = np.mean(np.stack(w_hist), axis=0)
+    with ma.apply(exe):
+        applied = np.asarray(pt.global_scope().find_var("ma_w"))
+        np.testing.assert_allclose(applied, expected_avg, rtol=1e-5)
+    restored = np.asarray(pt.global_scope().find_var("ma_w"))
+    np.testing.assert_allclose(restored, current)
