@@ -64,6 +64,10 @@ from . import inference  # noqa: F401
 from . import contrib  # noqa: F401
 from . import recordio  # noqa: F401
 from . import imperative  # noqa: F401
+from . import flags  # noqa: F401
+from .flags import FLAGS  # noqa: F401
+from . import log  # noqa: F401
+from . import debugger  # noqa: F401
 from .core import registry  # noqa: F401
 
 __version__ = "0.1.0"

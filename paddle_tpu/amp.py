@@ -40,6 +40,7 @@ WHITE_OPS = frozenset({
     "mul",
     "matmul",
     "fused_attention",
+    "ring_attention",
 })
 
 # Numerically sensitive ops: compute in fp32 (reductions over many elements,
@@ -54,7 +55,10 @@ BLACK_OPS = frozenset({
     "lrn",
     "softmax",
     "log_softmax",
-    "softmax_with_cross_entropy",
+    # softmax_with_cross_entropy is NOT black-listed: its lowering does the
+    # exp-sum/loss in fp32 internally while the [N, V] logits stay bf16 —
+    # black-listing it would materialize a ~2 GB fp32 logits copy per
+    # transformer-base step (see ops/nn_ops.py lower_softmax_with_ce).
     "cross_entropy",
     "sigmoid_cross_entropy_with_logits",
     "bpr_loss",

@@ -383,9 +383,9 @@ class Executor:
         # debug mode, parity with the reference's FLAGS_check_nan_inf
         # (operator.cc:943): validate every op's outputs are finite
         if check_nan_inf is None:
-            check_nan_inf = os.environ.get("FLAGS_check_nan_inf", "") in (
-                "1", "true", "True",
-            )
+            from ..flags import FLAGS  # typed flag registry w/ env override
+
+            check_nan_inf = FLAGS.check_nan_inf
         self.check_nan_inf = check_nan_inf
 
     def close(self):

@@ -29,7 +29,7 @@ def _synthetic(n, num_classes, seed):
 
 def _read_archive(url, sub_names, label_key, synthetic, num_classes, seed):
     def reader():
-        if synthetic or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1":
+        if common.use_synthetic(synthetic):
             imgs, labels = _synthetic(512, num_classes, seed)
             for im, lb in zip(imgs, labels):
                 yield im, int(lb)

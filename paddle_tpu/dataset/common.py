@@ -47,3 +47,15 @@ def download(url, module_name, md5sum, save_name=None):
     if md5sum and md5file(filename) != md5sum:
         raise RuntimeError(f"md5 mismatch for {filename}")
     return filename
+
+
+def use_synthetic(explicit=False):
+    """Whether readers should yield synthetic offline data (explicit arg,
+    FLAGS_synthetic_data, or PADDLE_TPU_SYNTH_DATA=1)."""
+    from ..flags import FLAGS
+
+    return bool(
+        explicit
+        or FLAGS.synthetic_data
+        or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1"
+    )

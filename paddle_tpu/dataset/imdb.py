@@ -20,7 +20,7 @@ _VOCAB = 2000
 
 
 def _use_synth(synthetic):
-    return synthetic or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1"
+    return common.use_synthetic(synthetic)
 
 
 def word_dict(synthetic=False):

@@ -46,7 +46,7 @@ def _synthetic(n, seed):
 
 
 def _use_synth(synthetic):
-    return synthetic or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1"
+    return common.use_synthetic(synthetic)
 
 
 def _reader_creator(image_file, label_file, synthetic, n_synth, seed):

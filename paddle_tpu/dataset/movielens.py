@@ -50,7 +50,7 @@ def movie_categories():
 
 
 def _use_synth(synthetic):
-    return synthetic or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1"
+    return common.use_synthetic(synthetic)
 
 
 def _synthetic_samples(seed, n=2000, n_users=200, n_movies=300):

@@ -31,7 +31,7 @@ def _synthetic(n=506, seed=13):
 
 
 def _data(synthetic):
-    if synthetic or os.environ.get("PADDLE_TPU_SYNTH_DATA") == "1":
+    if common.use_synthetic(synthetic):
         x, y = _synthetic()
     else:
         x, y = _load_real()

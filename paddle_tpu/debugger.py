@@ -1,0 +1,88 @@
+"""Program visualization + structured dumps (reference:
+python/paddle/fluid/debugger.py draw_block_graphviz, net_drawer.py,
+graphviz.py — the reference shells out to graphviz; here the DOT source is
+the artifact (render anywhere), plus a human-readable program printer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from .core import framework as fw
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+def draw_block_graphviz(block: fw.Block, highlights: Optional[Set[str]] = None,
+                        path: Optional[str] = None) -> str:
+    """Emit a graphviz DOT description of the block's op/var dataflow
+    (reference debugger.py:draw_block_graphviz).  Ops are boxes, vars are
+    ellipses (parameters shaded); returns the DOT source and optionally
+    writes it to `path`."""
+    highlights = highlights or set()
+    params = {p.name for p in block.program.all_parameters()}
+    lines = [
+        "digraph G {",
+        "  rankdir=TB;",
+        '  node [fontsize=10, fontname="monospace"];',
+    ]
+    var_nodes: Set[str] = set()
+
+    def var_node(name: str) -> str:
+        # the escaped name IS the (deterministic, collision-free) node id
+        nid = f"var_{_esc(name)}"
+        if name not in var_nodes:
+            var_nodes.add(name)
+            style = 'style=filled, fillcolor="lightblue"' \
+                if name in params else ""
+            if name in highlights:
+                style = 'style=filled, fillcolor="orange"'
+            v = block._find_var_recursive(name)
+            shape = getattr(v, "shape", None)
+            label = _esc(name if shape is None else f"{name}\\n{shape}")
+            lines.append(
+                f'  "{nid}" [label="{label}", shape=ellipse, {style}];')
+        return nid
+
+    for i, op in enumerate(block.ops):
+        oid = f"op_{i}"
+        lines.append(
+            f'  "{oid}" [label="{_esc(op.type)}", shape=box, '
+            'style=filled, fillcolor="lightgrey"];')
+        for n in op.input_arg_names():
+            if n:
+                lines.append(f'  "{var_node(n)}" -> "{oid}";')
+        for n in op.output_arg_names():
+            if n:
+                lines.append(f'  "{oid}" -> "{var_node(n)}";')
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot
+
+
+def pprint_program(program: fw.Program) -> str:
+    """Human-readable program dump: one line per op with inputs -> outputs
+    and non-default attrs (reference debugger.pprint_program_codes)."""
+    out = []
+    for bi, block in enumerate(program.blocks):
+        out.append(f"block {bi} (parent {block.parent_idx}):")
+        for op in block.ops:
+            ins = ", ".join(
+                f"{slot}={names}" for slot, names in op.inputs.items()
+                if names)
+            outs = ", ".join(
+                f"{slot}={names}" for slot, names in op.outputs.items()
+                if names)
+            attrs = {
+                k: v for k, v in op.attrs.items()
+                if k not in ("op_role", "sub_block")
+                and not hasattr(v, "ops")
+            }
+            a = f"  attrs={attrs}" if attrs else ""
+            out.append(f"  {op.type}({ins}) -> {outs}{a}")
+    return "\n".join(out)

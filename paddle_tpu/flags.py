@@ -1,0 +1,129 @@
+"""Typed runtime flags with environment overrides (reference: the gflags
+surface — ~50 FLAGS_* defined at point-of-use, e.g.
+FLAGS_check_nan_inf operator.cc:943, FLAGS_fraction_of_gpu_memory_to_use
+gpu_info.cc, FLAGS_allocator_strategy allocator_strategy.cc — plus the
+Python bootstrap that whitelists FLAGS_* env vars into gflags,
+python/paddle/fluid/__init__.py:95-170 __bootstrap__).
+
+TPU-first: one typed registry (SURVEY §5.6 plan) instead of scattered
+globals.  Flags are declared with a type + default + help; values resolve
+in priority order CLI-set < env (`FLAGS_<name>`) < programmatic set_flag.
+`paddle_tpu.flags.FLAGS.<name>` reads; unknown names raise.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: lambda s: int(s, 0),
+    float: float,
+    str: str,
+}
+
+
+class _FlagDef:
+    __slots__ = ("name", "type", "default", "help")
+
+    def __init__(self, name, type_, default, help_):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.help = help_
+
+
+class _Flags:
+    def __init__(self):
+        object.__setattr__(self, "_defs", {})
+        object.__setattr__(self, "_values", {})
+
+    def define(self, name: str, type_: type, default, help_: str = ""):
+        if name in self._defs:
+            raise ValueError(f"flag {name!r} already defined")
+        if type_ not in _PARSERS:
+            raise TypeError(f"unsupported flag type {type_!r}")
+        self._defs[name] = _FlagDef(name, type_, default, help_)
+
+    def __getattr__(self, name):
+        defs = object.__getattribute__(self, "_defs")
+        if name not in defs:
+            raise AttributeError(f"unknown flag {name!r}")
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        env = os.environ.get(f"FLAGS_{name}")
+        if env is not None:
+            return _PARSERS[defs[name].type](env)
+        return defs[name].default
+
+    def __setattr__(self, name, value):
+        self.set(name, value)
+
+    def set(self, name, value):
+        defs = object.__getattribute__(self, "_defs")
+        if name not in defs:
+            raise AttributeError(f"unknown flag {name!r}")
+        d = defs[name]
+        if not isinstance(value, d.type):
+            value = _PARSERS[d.type](str(value))
+        object.__getattribute__(self, "_values")[name] = value
+
+    def reset(self, name=None):
+        values = object.__getattribute__(self, "_values")
+        if name is None:
+            values.clear()
+        else:
+            values.pop(name, None)
+
+    def help(self) -> str:
+        defs = object.__getattribute__(self, "_defs")
+        lines = []
+        for d in sorted(defs.values(), key=lambda d: d.name):
+            lines.append(
+                f"FLAGS_{d.name} ({d.type.__name__}, default "
+                f"{d.default!r}): {d.help}")
+        return "\n".join(lines)
+
+
+FLAGS = _Flags()
+
+# -- the framework's flag surface (reference points cited per flag) ---------
+
+FLAGS.define(
+    "check_nan_inf", bool, False,
+    "validate every op output for NaN/Inf and name the offending op "
+    "(reference FLAGS_check_nan_inf, operator.cc:943)")
+FLAGS.define(
+    "benchmark", bool, False,
+    "synchronize after every executor call for stable timing "
+    "(reference FLAGS_benchmark, operator.cc:938)")
+FLAGS.define(
+    "cpu_deterministic", bool, True,
+    "kept for parity; determinism is free under XLA "
+    "(reference FLAGS_cpu_deterministic)")
+FLAGS.define(
+    "eager_delete_tensor_gb", float, 0.0,
+    "kept for parity; buffer lifetime is XLA's job "
+    "(reference FLAGS_eager_delete_tensor_gb)")
+FLAGS.define(
+    "prefetch_chunk_mb", int, 32,
+    "chunk size for double-buffer host->device transfers "
+    "(reader/decorator.py device_put_chunked)")
+FLAGS.define(
+    "prefetch_threads", int, 4,
+    "thread-pool width for chunked host->device transfers")
+FLAGS.define(
+    "synthetic_data", bool, False,
+    "datasets yield synthetic offline samples (same as "
+    "PADDLE_TPU_SYNTH_DATA=1)")
+FLAGS.define(
+    "vlog", int, 0,
+    "verbose logging level, like glog's VLOG(n) (reference init.cc "
+    "InitGLOG); see paddle_tpu.log")

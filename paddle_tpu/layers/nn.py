@@ -98,13 +98,15 @@ def conv2d(
     use_cudnn=True,
     act=None,
     name=None,
+    data_format="NCHW",
 ):
     """reference nn.py:1754 (use_cudnn accepted for API parity; XLA owns
-    kernel choice on TPU)."""
+    kernel choice on TPU).  data_format NHWC runs channel-last (the
+    MXU-preferred layout; filter param stays OIHW)."""
     helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
                          act=act, name=name)
     dtype = input.dtype
-    num_channels = input.shape[1]
+    num_channels = input.shape[-1 if data_format == "NHWC" else 1]
     groups = groups or 1
 
     def _pair(x):
@@ -138,6 +140,7 @@ def conv2d(
             "paddings": padding,
             "dilations": dilation,
             "groups": groups,
+            "data_format": data_format,
         },
     )
     if bias_attr is False:
@@ -151,7 +154,7 @@ def conv2d(
             "elementwise_add",
             inputs={"X": [pre_bias], "Y": [b]},
             outputs={"Out": [pre_act]},
-            attrs={"axis": 1},
+            attrs={"axis": -1 if data_format == "NHWC" else 1},
         )
     return helper.append_activation(pre_act)
 
@@ -217,6 +220,7 @@ def pool2d(
     ceil_mode=False,
     exclusive=True,
     name=None,
+    data_format="NCHW",
 ):
     """reference nn.py:2292."""
     helper = LayerHelper("pool2d", name=name)
@@ -237,6 +241,7 @@ def pool2d(
             "global_pooling": global_pooling,
             "ceil_mode": ceil_mode,
             "exclusive": exclusive,
+            "data_format": data_format,
         },
     )
     return tmp

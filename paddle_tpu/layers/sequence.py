@@ -196,3 +196,65 @@ def edit_distance(input, label, normalized=True, input_length=None,
         attrs={"normalized": normalized},
     )
     return out, seq_num
+
+
+def sequence_concat(x, y, x_length=None, y_length=None, name=None):
+    """Per-sequence concat of two padded batches (reference:
+    layers/sequence_concat, sequence_concat_op.cc).  Returns (out,
+    out_length)."""
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    inputs = {"X": [x], "Y": [y]}
+    if x_length is not None:
+        inputs["XLength"] = [x_length]
+    if y_length is not None:
+        inputs["YLength"] = [y_length]
+    helper.append_op(
+        "sequence_concat",
+        inputs=inputs,
+        outputs={"Out": [out], "OutLength": [out_len]},
+    )
+    return out, out_len
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence slice (reference: layers/sequence_slice,
+    sequence_slice_op.cc).  Returns (out, out_length)."""
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "sequence_slice",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
+        outputs={"Out": [out], "OutLength": [out_len]},
+    )
+    return out, out_len
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    """Image patches -> sequence rows (reference: layers/im2sequence,
+    im2sequence_op.cc)."""
+    helper = LayerHelper("im2sequence", name=name)
+
+    def pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    if isinstance(padding, int):
+        pad = [padding] * 4
+    elif len(padding) == 2:  # [pad_h, pad_w] -> up/left/down/right
+        pad = [padding[0], padding[1], padding[0], padding[1]]
+    else:
+        pad = list(padding)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "im2sequence",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "kernels": pair(filter_size),
+            "strides": pair(stride),
+            "paddings": list(pad),
+        },
+    )
+    return out

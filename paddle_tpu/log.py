@@ -1,0 +1,43 @@
+"""Leveled logging discipline (reference: glog with VLOG(n) everywhere —
+platform/init.cc InitGLOG; python bridges core.init_glog in
+fluid/__init__.py __bootstrap__).
+
+`vlog(n, msg)` emits when FLAGS_vlog >= n (env FLAGS_vlog=2 etc.); the
+module logger routes through stdlib logging so hosts can redirect it.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .flags import FLAGS
+
+# Library convention: a NullHandler only; level/handlers/propagation belong
+# to the host application.  Call enable_default_handler() for the
+# glog-style stderr format in standalone scripts.
+logger = logging.getLogger("paddle_tpu")
+logger.addHandler(logging.NullHandler())
+
+
+def enable_default_handler(level=logging.INFO):
+    h = logging.StreamHandler()
+    h.setFormatter(logging.Formatter(
+        "%(levelname).1s %(asctime)s paddle_tpu] %(message)s",
+        datefmt="%H:%M:%S"))
+    logger.addHandler(h)
+    logger.setLevel(level)
+    return h
+
+
+def vlog(level: int, msg: str, *args):
+    """VLOG(n)-style verbose logging, gated on FLAGS.vlog."""
+    if FLAGS.vlog >= level:
+        logger.info(msg, *args)
+
+
+def warning(msg: str, *args):
+    logger.warning(msg, *args)
+
+
+def error(msg: str, *args):
+    logger.error(msg, *args)

@@ -381,3 +381,97 @@ def lower_crf_decoding(ctx, ins):
         correct = (path == label) & mask
         return {"ViterbiPath": [correct.astype(jnp.int64)]}
     return {"ViterbiPath": [path]}
+
+
+@register("sequence_concat", no_grad=False)
+def lower_sequence_concat(ctx, ins):
+    """Per-sequence concatenation of two padded batches (reference:
+    sequence_ops/sequence_concat_op.cc — LoD concat; dense form: out[i] =
+    [x[i, :lx_i], y[i, :ly_i]] packed left, padded with 0).
+
+    Inputs: X [b, Tx, ...], Y [b, Ty, ...], XLength/YLength [b] (optional;
+    default full).  Output: Out [b, Tx+Ty, ...], OutLength [b]."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    b, tx = x.shape[0], x.shape[1]
+    ty = y.shape[1]
+    if ins.get("XLength"):
+        lx = ins["XLength"][0].reshape(-1).astype(jnp.int32)
+    else:
+        lx = jnp.full((b,), tx, jnp.int32)
+    if ins.get("YLength"):
+        ly = ins["YLength"][0].reshape(-1).astype(jnp.int32)
+    else:
+        ly = jnp.full((b,), ty, jnp.int32)
+    t_out = tx + ty
+    pos = jnp.arange(t_out)
+    # gather map: position p takes x[p] if p < lx, else y[p - lx]
+    from_x = pos[None, :] < lx[:, None]
+    x_idx = jnp.clip(pos[None, :], 0, tx - 1)
+    y_idx = jnp.clip(pos[None, :] - lx[:, None], 0, ty - 1)
+    extra = (1,) * (x.ndim - 2)
+    fx = from_x.reshape(from_x.shape + extra)
+    xg = jnp.take_along_axis(
+        x, x_idx.reshape(x_idx.shape + extra), axis=1)
+    yg = jnp.take_along_axis(
+        y, y_idx.reshape(y_idx.shape + extra), axis=1)
+    out = jnp.where(fx, xg, yg)
+    valid = pos[None, :] < (lx + ly)[:, None]
+    out = jnp.where(valid.reshape(valid.shape + extra), out,
+                    jnp.zeros_like(out))
+    return {"Out": [out], "OutLength": [(lx + ly).astype(jnp.int64)]}
+
+
+@register("sequence_slice", no_grad=False)
+def lower_sequence_slice(ctx, ins):
+    """Per-sequence [offset, offset+length) slice (reference:
+    sequence_ops/sequence_slice_op.cc).  Inputs: X [b, T, ...], Offset [b],
+    Length [b].  Output packed left into [b, T, ...], zeros past each new
+    length, plus OutLength.
+
+    Divergence: the reference host-validates offset+length <= seq_len with
+    PADDLE_ENFORCE; data-dependent validation can't raise inside a jitted
+    TPU program, so out-of-range requests are truncated to the sequence
+    bounds (OutLength reflects the truncation) instead of fabricating
+    duplicated rows."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    b, t = x.shape[0], x.shape[1]
+    off = jnp.clip(
+        ins["Offset"][0].reshape(-1).astype(jnp.int32), 0, t)
+    ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    ln = jnp.clip(ln, 0, t - off)  # truncate to the sequence bounds
+    pos = jnp.arange(t)
+    src = jnp.clip(pos[None, :] + off[:, None], 0, t - 1)
+    extra = (1,) * (x.ndim - 2)
+    g = jnp.take_along_axis(x, src.reshape(src.shape + extra), axis=1)
+    valid = pos[None, :] < ln[:, None]
+    out = jnp.where(valid.reshape(valid.shape + extra), g,
+                    jnp.zeros_like(g))
+    return {"Out": [out], "OutLength": [ln.astype(jnp.int64)]}
+
+
+@register("im2sequence", no_grad=False)
+def lower_im2sequence(ctx, ins):
+    """Image -> patch sequence (reference: im2sequence_op.cc): NCHW input
+    with kernel/stride/padding becomes [b, oh*ow, c*kh*kw] rows, the OCR-
+    pipeline front end.  XLA's patch extraction is one strided gather."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    kh, kw = ctx.attr("kernels", [1, 1])
+    sh, sw = ctx.attr("strides", [1, 1])
+    pads = ctx.attr("paddings", [0, 0, 0, 0])  # up, left, down, right
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
+                    (pads[1], pads[3])))
+    hp, wp = x.shape[2], x.shape[3]
+    oh = (hp - kh) // sh + 1
+    ow = (wp - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [n, c*kh*kw, oh, ow]
+    out = patches.reshape(n, c * kh * kw, oh * ow).transpose(0, 2, 1)
+    return {"Out": [out]}

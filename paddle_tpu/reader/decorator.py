@@ -218,13 +218,16 @@ def device_put_chunked(v):
 
     if hasattr(v, "devices"):  # already a device array
         return v
+    from ..flags import FLAGS
+
+    chunk_bytes = FLAGS.prefetch_chunk_mb << 20
     arr = np.asarray(v)
-    if arr.nbytes > (32 << 20) and arr.shape and arr.shape[0] > 1:
+    if arr.nbytes > chunk_bytes and arr.shape and arr.shape[0] > 1:
         import concurrent.futures as cf
 
-        n = min(arr.shape[0], max(2, arr.nbytes >> 25))
+        n = min(arr.shape[0], max(2, arr.nbytes // chunk_bytes))
         chunks = np.array_split(arr, n, axis=0)
-        with cf.ThreadPoolExecutor(4) as pool:
+        with cf.ThreadPoolExecutor(FLAGS.prefetch_threads) as pool:
             parts = list(pool.map(jnp.asarray, chunks))
         return jnp.concatenate(parts, axis=0)
     return jnp.asarray(arr)

@@ -1,0 +1,113 @@
+"""Typed flags + env overrides, VLOG logging, debugger/graphviz, new
+sequence ops (reference: gflags surface + __bootstrap__ fluid/__init__.py,
+debugger.py, sequence_concat_op.cc, sequence_slice_op.cc,
+im2sequence_op.cc)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.flags import FLAGS
+
+
+def test_flags_typed_defaults_and_env(monkeypatch):
+    FLAGS.reset()
+    assert FLAGS.check_nan_inf is False
+    assert FLAGS.prefetch_chunk_mb == 32
+    monkeypatch.setenv("FLAGS_check_nan_inf", "true")
+    assert FLAGS.check_nan_inf is True
+    monkeypatch.setenv("FLAGS_prefetch_chunk_mb", "64")
+    assert FLAGS.prefetch_chunk_mb == 64
+    # programmatic set wins over env
+    FLAGS.prefetch_chunk_mb = 16
+    assert FLAGS.prefetch_chunk_mb == 16
+    FLAGS.reset("prefetch_chunk_mb")
+    with pytest.raises(AttributeError):
+        FLAGS.not_a_flag
+    with pytest.raises(AttributeError):
+        FLAGS.set("not_a_flag", 1)
+    FLAGS.reset()
+
+
+def test_flags_drive_executor_nan_check(monkeypatch):
+    monkeypatch.setenv("FLAGS_check_nan_inf", "1")
+    exe = pt.Executor(pt.CPUPlace())
+    assert exe.check_nan_inf is True
+    monkeypatch.delenv("FLAGS_check_nan_inf")
+    assert pt.Executor(pt.CPUPlace()).check_nan_inf is False
+
+
+def test_vlog_gating(caplog):
+    import logging
+
+    from paddle_tpu import log
+
+    with caplog.at_level(logging.INFO, logger="paddle_tpu"):
+        FLAGS.vlog = 0
+        log.vlog(2, "hidden %d", 1)
+        FLAGS.vlog = 2
+        log.vlog(2, "shown %d", 2)
+        FLAGS.reset()
+    messages = [r.getMessage() for r in caplog.records]
+    assert not any("hidden" in m for m in messages)
+    assert any("shown 2" in m for m in messages)
+
+
+def test_debugger_dot_and_pprint(tmp_path):
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    h = layers.fc(x, size=3, act="relu")
+    loss = layers.mean(h)
+    prog = pt.default_main_program()
+    dot = pt.debugger.draw_block_graphviz(
+        prog.global_block(), path=str(tmp_path / "g.dot"))
+    assert dot.startswith("digraph G {") and dot.endswith("}")
+    assert '"op_0"' in dot and "mul" in dot
+    assert (tmp_path / "g.dot").read_text() == dot
+    # parameters shaded
+    assert "lightblue" in dot
+
+    txt = pt.debugger.pprint_program(prog)
+    assert "block 0" in txt and "mul(" in txt and "mean(" in txt
+
+
+def test_sequence_concat_and_slice():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[3], dtype="float32")
+    xl = layers.data(name="xl", shape=[1], dtype="int64")
+    yl = layers.data(name="yl", shape=[1], dtype="int64")
+    out, out_len = layers.sequence_concat(x, y, x_length=xl, y_length=yl)
+    off = layers.data(name="off", shape=[1], dtype="int64")
+    ln = layers.data(name="ln", shape=[1], dtype="int64")
+    sl, sl_len = layers.sequence_slice(x, off, ln)
+
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.arange(8, dtype="float32").reshape(2, 4)
+    yv = np.arange(10, 16, dtype="float32").reshape(2, 3)
+    o, olen, s, slen = exe.run(
+        feed={"x": xv, "y": yv, "xl": np.array([2, 4], "int64"),
+              "yl": np.array([3, 1], "int64"),
+              "off": np.array([1, 0], "int64"),
+              "ln": np.array([2, 3], "int64")},
+        fetch_list=[out, out_len, sl, sl_len])
+    o = np.asarray(o)
+    np.testing.assert_allclose(o[0], [0, 1, 10, 11, 12, 0, 0])
+    np.testing.assert_allclose(o[1], [4, 5, 6, 7, 13, 0, 0])
+    np.testing.assert_array_equal(np.asarray(olen), [5, 5])
+    s = np.asarray(s)
+    np.testing.assert_allclose(s[0], [1, 2, 0, 0])
+    np.testing.assert_allclose(s[1], [4, 5, 6, 0])
+
+
+def test_im2sequence_patches():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    xi = layers.data(name="x", shape=[1, 4, 4], dtype="float32")
+    out = layers.im2sequence(xi, filter_size=2, stride=2)
+    exe = pt.Executor(pt.CPUPlace())
+    (o,) = exe.run(feed={"x": x}, fetch_list=[out])
+    o = np.asarray(o)
+    assert o.shape == (1, 4, 4)  # 2x2 patches of 1*2*2
+    np.testing.assert_allclose(o[0, 0], [0, 1, 4, 5])
+    np.testing.assert_allclose(o[0, 3], [10, 11, 14, 15])

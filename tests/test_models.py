@@ -132,3 +132,42 @@ def test_edit_distance():
     # kitten-style: [1,2,3] vs [1,3,3] = 1 sub; [1,1,1,1] vs [2,2,2] = 4? no:
     # 3 subs + 1 del = 4... classic DP gives 4
     np.testing.assert_allclose(d.ravel(), [1.0, 4.0])
+
+
+def test_resnet_nhwc_matches_nchw():
+    """Channel-last tower must produce the same loss as NCHW with the same
+    (OIHW-shaped) parameters."""
+    from paddle_tpu.core import framework as fw
+    from paddle_tpu.models import resnet as R
+
+    def build(fmt):
+        prog, startup = pt.Program(), pt.Program()
+        with fw.guard_unique_name():
+            with pt.program_guard(prog, startup):
+                img, label, avg_cost, acc, _ = R.build_train_net(
+                    class_dim=10, image_shape=(3, 32, 32), depth=18,
+                    with_optimizer=False, data_format=fmt)
+        return prog, startup, avg_cost
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "image": rng.rand(4, 3, 32, 32).astype("float32"),
+        "label": rng.randint(0, 10, (4, 1)).astype("int64"),
+    }
+    exe = pt.Executor(pt.CPUPlace())
+    losses = {}
+    for fmt in ("NCHW", "NHWC"):
+        prog, startup, cost = build(fmt)
+        scope = pt.Scope()
+        exe.run(startup, scope=scope)
+        if fmt == "NCHW":
+            saved = {
+                p.name: np.asarray(scope.find_var(p.name))
+                for p in prog.all_parameters()
+            }
+        else:
+            for name, val in saved.items():
+                scope.set_var(name, val)
+        (lv,) = exe.run(prog, feed=feed, fetch_list=[cost], scope=scope)
+        losses[fmt] = float(np.asarray(lv))
+    assert abs(losses["NCHW"] - losses["NHWC"]) < 1e-4, losses
