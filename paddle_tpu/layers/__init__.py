@@ -7,6 +7,8 @@ from .control_flow import (  # noqa: F401
     While,
     Switch,
     IfElse,
+    StaticRNN,
+    DynamicRNN,
     array_write,
     array_read,
     array_length,

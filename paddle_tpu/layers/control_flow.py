@@ -283,3 +283,219 @@ class IfElse:
             b = T.elementwise_mul(fv, not_cond)
             merged.append(T.elementwise_add(a, b))
         return merged
+
+
+class StaticRNN:
+    """Recurrent step-loop DSL (reference: control_flow.py StaticRNN over
+    recurrent_op.cc StepScopes; here the step builds a sub-block that
+    lowers to ONE lax.scan — see ops/control_flow_ops.py static_rnn).
+
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(x)            # x [b, T, d] -> [b, d]
+            prev = rnn.memory(shape=[H], batch_ref=word)  # or init=var
+            hidden = layers.fc(input=..., size=H)
+            rnn.update_memory(prev, hidden)
+            rnn.step_output(hidden)
+        outs = rnn()                             # [b, T, H]
+
+    Differentiable end-to-end: outer vars read inside the step (parameters
+    included) ride as explicit op inputs.
+    """
+
+    #: set by DynamicRNN to enable length masking
+    _seq_len_var = None
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.main_program = self.helper.main_program
+        self._step_inputs = []    # (outer seq var, step var)
+        self._memories = []       # (step var, init var, updated var)
+        self._outputs = []        # step-local output vars
+        self._sub_block = None
+        self._result_vars = None
+
+    # -- build-phase API --------------------------------------------------
+
+    def step(self):
+        rnn = self
+
+        class _Guard:
+            def __enter__(self):
+                rnn._sub_block = rnn.main_program._create_block()
+                return rnn
+
+            def __exit__(self, exc_type, exc_val, exc_tb):
+                rnn.main_program._rollback()
+                if exc_type is not None:
+                    return False
+                rnn._finalize()
+                return True
+
+        return _Guard()
+
+    def _require_in_step(self):
+        if self._sub_block is None or (
+            self.main_program.current_block() is not self._sub_block
+        ):
+            raise RuntimeError("StaticRNN API used outside rnn.step()")
+
+    def step_input(self, x):
+        """Register a [b, T, ...] sequence; returns its per-step [b, ...]
+        slice var."""
+        self._require_in_step()
+        if x.shape is None or len(x.shape) < 2:
+            raise ValueError(f"step_input needs [b, T, ...]; got {x.shape}")
+        step = self._sub_block.create_var(
+            name=fw.unique_name(f"{x.name}.step"),
+            shape=[x.shape[0]] + list(x.shape[2:]),
+            dtype=x.dtype,
+        )
+        self._step_inputs.append((x, step))
+        return step
+
+    def memory(self, init=None, shape=None, batch_ref=None, value=0.0,
+               dtype="float32"):
+        """Loop-carried state: pass `init` (a [b, ...] var built OUTSIDE
+        the step) or (shape + batch_ref) for a constant-filled init whose
+        batch dim follows the sequence input at runtime (created lazily in
+        the parent block via fill_constant_batch_size_like — batch dims
+        are dynamic in the IR)."""
+        self._require_in_step()
+        if init is None:
+            if shape is None:
+                raise ValueError("memory() needs init= or shape=")
+            # deferred: parent-block init built in _finalize
+            init_spec = (list(shape), float(value), dtype)
+            mem_shape = [-1] + list(shape)
+        else:
+            init_spec = None
+            mem_shape = list(init.shape) if init.shape else None
+            dtype = init.dtype
+        step = self._sub_block.create_var(
+            name=fw.unique_name("rnn.mem"),
+            shape=mem_shape,
+            dtype=dtype,
+        )
+        self._memories.append([step, init, None, init_spec])
+        return step
+
+    def update_memory(self, mem, new_val):
+        self._require_in_step()
+        for m in self._memories:
+            if m[0] is mem or m[0].name == mem.name:
+                m[2] = new_val
+                return
+        raise ValueError(f"update_memory: {mem.name} is not a memory")
+
+    def _materialize_inits(self, parent):
+        """Create deferred constant inits in the parent block (batch dim
+        follows the first sequence input at runtime)."""
+        from . import nn as _nn  # noqa: F401  (ensures layer registry)
+
+        seq0 = self._step_inputs[0][0]
+        for m in self._memories:
+            if m[1] is None:
+                shape, value, dtype = m[3]
+                m[1] = T.fill_constant_batch_size_like(
+                    seq0, [ -1 ] + shape, dtype, value)
+
+    def step_output(self, out):
+        self._require_in_step()
+        self._outputs.append(out)
+
+    def output(self, *outs):
+        for o in outs:
+            self.step_output(o)
+
+    # -- finalize ---------------------------------------------------------
+
+    def _finalize(self):
+        if not self._step_inputs:
+            raise ValueError("StaticRNN needs at least one step_input")
+        for m in self._memories:
+            if m[2] is None:
+                raise ValueError(
+                    f"memory {m[0].name} never update_memory()'d")
+        sub = self._sub_block
+        parent = self.main_program.current_block()
+        self._materialize_inits(parent)
+
+        local = {s.name for _, s in self._step_inputs}
+        local |= {m[0].name for m in self._memories}
+        written = set()
+        for op in sub.ops:
+            written.update(n for n in op.output_arg_names() if n)
+        invariant_names = []
+        seen = set()
+        for op in sub.ops:
+            for n in op.input_arg_names():
+                if (n and n not in local and n not in written
+                        and n not in seen
+                        and parent._find_var_recursive(n) is not None):
+                    seen.add(n)
+                    invariant_names.append(n)
+
+        t_dim = self._step_inputs[0][0].shape[1]
+        outs = []
+        for o in self._outputs:
+            shape = None
+            if o.shape is not None:
+                shape = [o.shape[0], t_dim] + list(o.shape[1:])
+            outs.append(parent.create_var(
+                name=fw.unique_name(f"{o.name}.stacked"),
+                shape=shape, dtype=o.dtype))
+        out_mems = [
+            parent.create_var(
+                name=fw.unique_name(f"{m[1].name}.final"),
+                shape=list(m[1].shape) if m[1].shape else None,
+                dtype=m[1].dtype)
+            for m in self._memories
+        ]
+
+        inputs = {
+            "StepInputs": [x for x, _ in self._step_inputs],
+            "MemInits": [m[1] for m in self._memories],
+            "Invariants": invariant_names,
+        }
+        if self._seq_len_var is not None:
+            inputs["SeqLen"] = [self._seq_len_var]
+        parent.append_op(
+            "static_rnn",
+            inputs=inputs,
+            outputs={"Out": outs, "OutMems": out_mems},
+            attrs={
+                "sub_block": sub,
+                "step_input_names": [s.name for _, s in self._step_inputs],
+                "mem_step_names": [m[0].name for m in self._memories],
+                "mem_updated_names": [m[2].name for m in self._memories],
+                "output_names": [o.name for o in self._outputs],
+                "invariant_names": invariant_names,
+            },
+        )
+        self._result_vars = outs
+        self._final_mems = out_mems
+
+    def __call__(self):
+        if self._result_vars is None:
+            raise RuntimeError("StaticRNN called before its step() block")
+        if len(self._result_vars) == 1:
+            return self._result_vars[0]
+        return list(self._result_vars)
+
+
+class DynamicRNN(StaticRNN):
+    """Variable-length recurrent DSL (reference: control_flow.py
+    DynamicRNN over lod_rank_table + shrink_rnn_memory).
+
+    Dense TPU form: same scan as StaticRNN with a per-sequence length
+    vector — memories freeze and outputs zero past each sequence's length
+    (masked scan replaces the reference's sort-by-length batch shrinking).
+    Pass lengths to the constructor; `block()` aliases `step()`."""
+
+    def __init__(self, seq_len=None, name=None):
+        super().__init__(name=name)
+        self._seq_len_var = seq_len
+
+    def block(self):
+        return self.step()

@@ -259,3 +259,83 @@ def lower_beam_search_decode(ctx, ins):
         "SentenceIds": [sent],
         "SentenceScores": [scores],
     }
+
+
+@register("static_rnn")
+def lower_static_rnn(ctx, ins):
+    """Recurrent step-loop (reference: recurrent_op.cc:39 RecurrentOp with
+    per-step StepScopes; python StaticRNN/DynamicRNN in control_flow.py).
+
+    TPU-first: the step sub-block lowers to ONE lax.scan — no nested
+    executors or per-step scopes; memories are the scan carry, step inputs
+    are time-major xs slices, step outputs stack to [b, T, ...].  With a
+    SeqLen input (DynamicRNN), each sequence's memory freezes and outputs
+    zero past its length (masked scan replaces the reference's
+    lod_rank_table sort).
+
+    Inputs: StepInputs (sliced along time axis 1), MemInits (initial
+    memory values), Invariants (outer vars the step reads — parameters
+    included, so the generic vjp grad maker differentiates through the
+    scan into them), SeqLen (optional [b]).  Attrs: sub_block,
+    step_input_names, mem_step_names, mem_updated_names, output_names,
+    invariant_names.  Outputs: Out (stacked step outputs), OutMems (final
+    memories).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import executor as ex
+
+    sub_block = ctx.attr("sub_block")
+    step_in_names = ctx.attr("step_input_names")
+    mem_step_names = ctx.attr("mem_step_names")
+    mem_updated_names = ctx.attr("mem_updated_names")
+    out_names = ctx.attr("output_names")
+
+    invariant_names = ctx.attr("invariant_names", [])
+
+    seq_inputs = ins["StepInputs"]
+    mem_inits = ins["MemInits"]
+    seq_len = None
+    if ins.get("SeqLen") and ins["SeqLen"][0] is not None:
+        seq_len = ins["SeqLen"][0].reshape(-1).astype(jnp.int32)
+
+    t_max = seq_inputs[0].shape[1]
+    tctx = ctx.executor_ctx
+
+    invariant = dict(zip(invariant_names, ins.get("Invariants", [])))
+
+    # time-major xs for the scan
+    xs = tuple(
+        jnp.moveaxis(v, 1, 0) for v in seq_inputs
+    )
+
+    def step(carry, x_t):
+        mems, t = carry
+        env2 = dict(invariant)
+        env2.update(zip(mem_step_names, mems))
+        env2.update(zip(step_in_names, x_t))
+        ex.trace_block(sub_block, env2, tctx)
+        new_mems = tuple(env2[n] for n in mem_updated_names)
+        outs = tuple(env2[n] for n in out_names)
+        if seq_len is not None:
+            alive = (t < seq_len)  # [b]
+
+            def mask_like(new, old):
+                m = alive.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(m, new, old)
+
+            new_mems = tuple(
+                mask_like(n, o) for n, o in zip(new_mems, mems))
+            outs = tuple(
+                jnp.where(
+                    alive.reshape((-1,) + (1,) * (o.ndim - 1)),
+                    o, jnp.zeros_like(o))
+                for o in outs)
+        return (new_mems, t + 1), outs
+
+    (final_mems, _), stacked = jax.lax.scan(
+        step, (tuple(mem_inits), jnp.int32(0)), xs, length=t_max)
+    # back to batch-major [b, T, ...]
+    outs = [jnp.moveaxis(o, 0, 1) for o in stacked]
+    return {"Out": outs, "OutMems": list(final_mems)}
