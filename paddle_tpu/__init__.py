@@ -58,6 +58,11 @@ from . import metrics  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
+from .data_feed import (  # noqa: F401
+    AsyncExecutor,
+    DataFeedDesc,
+    MultiSlotDataFeed,
+)
 from . import profiler  # noqa: F401
 from . import amp  # noqa: F401
 from . import inference  # noqa: F401

@@ -1029,3 +1029,18 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
         },
     )
     return tuple(outs)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    """reference: layers/nn.py sigmoid_cross_entropy_with_logits,
+    sigmoid_cross_entropy_with_logits_op.cc."""
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "sigmoid_cross_entropy_with_logits",
+        inputs={"X": [x], "Label": [label]},
+        outputs={"Out": [out]},
+        attrs={"ignore_index": ignore_index, "normalize": normalize},
+    )
+    return out
