@@ -1,0 +1,170 @@
+"""Book-model integration tests (reference: tests/book/ — canonical small
+models trained a few iterations with loss thresholds: fit_a_line,
+image_classification, understand_sentiment, recommender_system; the other
+book models are covered by test_mnist.py (recognize_digits),
+test_beam_search.py (machine_translation), test_crf_nce.py (word2vec +
+label_semantic_roles), test_data_feed.py (CTR)).  All datasets run in
+synthetic offline mode."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+rng = np.random.RandomState(41)
+
+
+def _train(loss_var, feeder, batches, lr=0.01, opt=None):
+    (opt or pt.optimizer.AdamOptimizer(learning_rate=lr)).minimize(loss_var)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for feed in batches:
+        (lv,) = exe.run(feed=feed, fetch_list=[loss_var])
+        losses.append(float(np.asarray(lv)))
+    return losses
+
+
+def test_fit_a_line_uci_housing():
+    """reference tests/book/test_fit_a_line.py."""
+    data = list(pt.dataset.uci_housing.train(synthetic=True)())
+    x = layers.data(name="x", shape=[13], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square(pred - y))
+
+    def batches(n_epochs=40, bs=64):
+        for _ in range(n_epochs):
+            for i in range(0, len(data) - bs, bs):
+                chunk = data[i:i + bs]
+                yield {"x": np.stack([c[0] for c in chunk]),
+                       "y": np.stack([c[1] for c in chunk])}
+
+    losses = _train(loss, None, batches(), lr=0.5)
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_image_classification_cifar_resnet():
+    """reference tests/book/test_image_classification.py (resnet_cifar10)."""
+    from paddle_tpu.models import resnet as R
+
+    samples = list(pt.dataset.cifar.train10(synthetic=True)())
+    img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    predict = R.resnet_cifar10(img, class_dim=10, depth=20)
+    loss = layers.mean(layers.cross_entropy(input=predict, label=label))
+
+    def batches(n=30, bs=32):
+        idx = rng.permutation(len(samples))
+        for s in range(n):
+            take = idx[(s * bs) % (len(samples) - bs):][:bs]
+            yield {
+                "img": np.stack(
+                    [samples[i][0].reshape(3, 32, 32) for i in take]),
+                "label": np.array(
+                    [[samples[i][1]] for i in take], "int64"),
+            }
+
+    losses = _train(loss, None, batches(), lr=0.01)
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_understand_sentiment_imdb_lstm():
+    """reference tests/book/test_understand_sentiment.py (dynamic LSTM)."""
+    wd = pt.dataset.imdb.word_dict(synthetic=True)
+    samples = list(pt.dataset.imdb.train(wd, synthetic=True)())
+    t_max, vocab = 64, len(wd)
+
+    word = layers.data(name="word", shape=[t_max, 1], dtype="int64")
+    length = layers.data(name="len", shape=[1], dtype="int64")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    emb = layers.embedding(word, size=[vocab, 32])
+    # dynamic_lstm wants the pre-projected [B, T, 4*hidden] input
+    proj = layers.fc(emb, size=4 * 32, num_flatten_dims=2, bias_attr=False)
+    h, _cell = layers.dynamic_lstm(proj, size=4 * 32, length=length)
+    pooled = layers.sequence_pool(h, "last", length=length)
+    logits = layers.fc(pooled, size=2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        logits=logits, label=layers.reshape(label, [-1, 1])))
+
+    def batches(n=40, bs=32):
+        for s in range(n):
+            take = [samples[(s * bs + i) % len(samples)] for i in range(bs)]
+            w = np.zeros((bs, t_max, 1), "int64")
+            ln = np.zeros((bs,), "int64")
+            lb = np.zeros((bs, 1), "int64")
+            for i, (ids, y) in enumerate(take):
+                k = min(len(ids), t_max)
+                w[i, :k, 0] = ids[:k]
+                ln[i] = k
+                lb[i, 0] = y
+            yield {"word": w, "len": ln, "label": lb}
+
+    losses = _train(loss, None, batches(), lr=0.02)
+    assert losses[-1] < losses[0] * 0.75, (losses[0], losses[-1])
+
+
+def test_recommender_system_movielens():
+    """reference tests/book/test_recommender_system.py — user/movie feature
+    towers + fused features -> rating regression."""
+    samples = list(pt.dataset.movielens.train(synthetic=True)())
+    n_users = max(s[0] for s in samples) + 1
+    n_movies = max(s[4] for s in samples) + 1
+    n_cats = len(pt.dataset.movielens.movie_categories())
+
+    uid = layers.data(name="uid", shape=[1], dtype="int64")
+    gender = layers.data(name="gender", shape=[1], dtype="int64")
+    age = layers.data(name="age", shape=[1], dtype="int64")
+    job = layers.data(name="job", shape=[1], dtype="int64")
+    mid = layers.data(name="mid", shape=[1], dtype="int64")
+    cats = layers.data(name="cats", shape=[3, 1], dtype="int64")
+    cats_len = layers.data(name="cats__len", shape=[1], dtype="int64")
+    score = layers.data(name="score", shape=[1], dtype="float32")
+
+    def tower(parts, size=16):
+        feats = layers.concat(parts, axis=1)
+        return layers.fc(feats, size=size, act="tanh")
+
+    u = tower([
+        layers.reshape(layers.embedding(
+            layers.reshape(uid, [-1, 1, 1]), size=[n_users, 16]), [-1, 16]),
+        layers.reshape(layers.embedding(
+            layers.reshape(gender, [-1, 1, 1]), size=[2, 4]), [-1, 4]),
+        layers.reshape(layers.embedding(
+            layers.reshape(age, [-1, 1, 1]), size=[8, 4]), [-1, 4]),
+        layers.reshape(layers.embedding(
+            layers.reshape(job, [-1, 1, 1]), size=[21, 4]), [-1, 4]),
+    ])
+    cat_emb = layers.embedding(
+        layers.reshape(cats, [-1, 3, 1]), size=[n_cats, 8])
+    m = tower([
+        layers.reshape(layers.embedding(
+            layers.reshape(mid, [-1, 1, 1]), size=[n_movies, 16]), [-1, 16]),
+        layers.sequence_pool(cat_emb, "sum", length=cats_len),
+    ])
+    pred = layers.reduce_sum(
+        layers.elementwise_mul(u, m), dim=1, keep_dim=True)
+    loss = layers.mean(layers.square(pred - score))
+
+    def batches(n=60, bs=64):
+        for s in range(n):
+            take = [samples[(s * bs + i) % len(samples)] for i in range(bs)]
+            cat_arr = np.zeros((bs, 3, 1), "int64")
+            cat_len = np.zeros((bs,), "int64")
+            for i, smp in enumerate(take):
+                cs = smp[5][:3]
+                cat_arr[i, :len(cs), 0] = cs
+                cat_len[i] = len(cs)
+            yield {
+                "uid": np.array([[s[0]] for s in take], "int64"),
+                "gender": np.array([[s[1]] for s in take], "int64"),
+                "age": np.array([[s[2]] for s in take], "int64"),
+                "job": np.array([[s[3]] for s in take], "int64"),
+                "mid": np.array([[s[4]] for s in take], "int64"),
+                "cats": cat_arr,
+                "cats__len": cat_len,
+                "score": np.array([[s[7]] for s in take], "float32"),
+            }
+
+    losses = _train(loss, None, batches(), lr=0.05)
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
