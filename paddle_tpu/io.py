@@ -216,3 +216,92 @@ def load_inference_model(
         for n in program.fetch_var_names
     ]
     return program, list(program.feed_var_names), fetch_vars
+
+
+class CheckpointManager:
+    """Interval auto-checkpointing with resume-latest (reference: the Go
+    pserver's fault-tolerance design — checkpoint to disk on an interval
+    with integrity checks + load-on-restart, go/pserver/service.go:119-156,
+    174-205; SURVEY §5.3 maps elasticity on TPU to
+    restart-from-checkpoint).
+
+        mgr = io.CheckpointManager(dirname, exe, interval_steps=100)
+        start = mgr.resume()              # 0 if no checkpoint yet
+        for step in range(start, n):
+            ... train ...
+            mgr.on_step(step)             # saves every interval
+    """
+
+    def __init__(self, dirname, executor, interval_steps=100,
+                 main_program=None, scope=None, keep_last=2):
+        import json
+
+        self.dirname = dirname
+        self.executor = executor
+        self.interval = max(1, int(interval_steps))
+        self.program = main_program or fw.default_main_program()
+        self.scope = scope
+        self.keep_last = keep_last
+        self._json = json
+        os.makedirs(dirname, exist_ok=True)
+
+    def _ckpt_dir(self, step):
+        return os.path.join(self.dirname, f"ckpt-{step}")
+
+    def _latest_path(self):
+        return os.path.join(self.dirname, "LATEST")
+
+    def save(self, step):
+        """Write a checkpoint for `step` (persistables incl. optimizer
+        accumulators) and atomically advance the LATEST pointer."""
+        d = self._ckpt_dir(step)
+        tmp = d + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        save_persistables(self.executor, tmp, self.program,
+                          scope=self.scope)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            self._json.dump({"step": int(step)}, f)
+        if os.path.exists(d):
+            import shutil
+
+            shutil.rmtree(d)
+        os.replace(tmp, d)
+        # atomic pointer: readers never see a half-written checkpoint
+        with open(self._latest_path() + ".tmp", "w") as f:
+            f.write(str(int(step)))
+        os.replace(self._latest_path() + ".tmp", self._latest_path())
+        self._gc()
+
+    def _gc(self):
+        import re
+        import shutil
+
+        steps = sorted(
+            int(m.group(1))
+            for m in (re.fullmatch(r"ckpt-(\d+)", n)
+                      for n in os.listdir(self.dirname))
+            if m
+        )
+        for s in steps[:-self.keep_last]:
+            shutil.rmtree(self._ckpt_dir(s), ignore_errors=True)
+
+    def on_step(self, step):
+        if (step + 1) % self.interval == 0:
+            self.save(step)
+
+    def latest_step(self):
+        try:
+            with open(self._latest_path()) as f:
+                return int(f.read().strip())
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def resume(self):
+        """Load the latest checkpoint into the scope; returns the next
+        step index to run (0 when starting fresh)."""
+        step = self.latest_step()
+        if step is None:
+            return 0
+        load_persistables(self.executor, self._ckpt_dir(step),
+                          self.program, scope=self.scope)
+        return step + 1

@@ -183,3 +183,52 @@ def print_op_table(trace_dir: str, top_k: int = 30):
     report = "\n".join(lines)
     print(report)
     return rows
+
+
+def export_chrome_trace(trace_dir: str, out_path: str, max_events=50000):
+    """Convert a captured xplane trace to chrome://tracing JSON (the
+    reference's tools/timeline.py role over its protobuf profile).  Each
+    device line becomes a tid; op events carry their XLA names."""
+    import glob
+    import json as _json
+
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception as e:  # pragma: no cover
+        raise RuntimeError(
+            f"export_chrome_trace needs the xplane protos ({e})")
+
+    files = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    if not files:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    events = []
+    pid = 0
+    for path in files:
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            space.ParseFromString(f.read())
+        for plane in space.planes:
+            pid += 1
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": plane.name}})
+            ev_names = {i: m.name for i, m in plane.event_metadata.items()}
+            for tid, line in enumerate(plane.lines):
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": line.name}})
+                base = line.timestamp_ns
+                for ev in line.events:
+                    if len(events) >= max_events:
+                        break
+                    events.append({
+                        "name": ev_names.get(ev.metadata_id, "?")[:96],
+                        "ph": "X",
+                        "pid": pid,
+                        "tid": tid,
+                        "ts": (base + ev.offset_ps / 1000) / 1000.0,
+                        "dur": ev.duration_ps / 1e6,
+                    })
+    with open(out_path, "w") as f:
+        _json.dump({"traceEvents": events}, f)
+    return len(events)
