@@ -45,7 +45,9 @@ def lower_fused_ln_gelu(ctx, ins):
         ctx.attr("begin_norm_axis", x.ndim - 1),
         ctx.attr("epsilon", 1e-5),
     )
-    return {"Out": [jax.nn.gelu(y)]}
+    # default matches the standalone gelu op (exact erf form)
+    approx = bool(ctx.attr("approximate", False))
+    return {"Out": [jax.nn.gelu(y, approximate=approx)]}
 
 
 def _ring_attention_infer(ctx):

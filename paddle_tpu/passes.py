@@ -1,0 +1,155 @@
+"""Graph pass framework: registry + pattern matching + fusion passes
+(reference: framework/ir/ — Pass::Apply + PassRegistry + REGISTER_PASS
+ir/pass.h:32,144,207; GraphPatternDetector ir/graph_pattern_detector.cc;
+the ~20 fuse passes like fc_fuse_pass.cc, conv_bn_fuse_pass.cc).
+
+TPU-first scope: XLA already performs producer-consumer fusion, so passes
+here exist for (a) rewrites XLA cannot do because they need parameter
+VALUES (conv+bn folding mutates weights), (b) mapping op chains onto
+hand-written Pallas kernels (layer_norm+gelu), (c) program hygiene.  The
+pattern matcher works on linear producer-consumer chains — the shape every
+reference fuse pass in scope actually matches.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .core import framework as fw
+
+_PASS_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    """REGISTER_PASS parity (ir/pass.h:207): decorator for
+    fn(program, scope) -> int (number of rewrites applied)."""
+
+    def deco(fn):
+        if name in _PASS_REGISTRY:
+            raise ValueError(f"pass {name!r} already registered")
+        _PASS_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def list_passes() -> List[str]:
+    return sorted(_PASS_REGISTRY)
+
+
+def apply_pass(name: str, program: fw.Program, scope=None) -> int:
+    """Pass::Apply parity: run one registered pass; returns its rewrite
+    count."""
+    if name not in _PASS_REGISTRY:
+        raise KeyError(f"unknown pass {name!r} (have {list_passes()})")
+    return _PASS_REGISTRY[name](program, scope)
+
+
+def apply_passes(names: Sequence[str], program: fw.Program,
+                 scope=None) -> Dict[str, int]:
+    """BuildStrategy-style pass pipeline."""
+    return {n: apply_pass(n, program, scope) for n in names}
+
+
+# ---------------------------------------------------------------------------
+# pattern matching (GraphPatternDetector's role for linear chains)
+# ---------------------------------------------------------------------------
+
+
+def consumers(block: fw.Block, name: str) -> List[fw.Operator]:
+    return [op for op in block.ops if name in op.input_arg_names()]
+
+
+def find_chains(block: fw.Block, types: Sequence[str],
+                link_slots: Optional[Sequence[str]] = None):
+    """Find op chains op0 -> op1 -> ... where opK's type is types[K] and
+    each link variable (opK's first output, or link_slots[K]) feeds ONLY
+    op{K+1}.  Returns a list of lists of (index, op) pairs, in program
+    order of the chain head."""
+    producers = {}
+    for i, op in enumerate(block.ops):
+        for n in op.output_arg_names():
+            producers[n] = (i, op)
+
+    chains = []
+    for i, op in enumerate(block.ops):
+        if op.type != types[-1]:
+            continue
+        chain = [(i, op)]
+        ok = True
+        cur = op
+        for k in range(len(types) - 2, -1, -1):
+            in_names = cur.input_arg_names()
+            prev = None
+            for n in in_names:
+                p = producers.get(n)
+                if p is not None and p[1].type == types[k]:
+                    # the link var must feed only `cur`
+                    if len(consumers(block, n)) == 1:
+                        prev = p
+                        break
+            if prev is None:
+                ok = False
+                break
+            chain.append(prev)
+            cur = prev[1]
+        if ok:
+            chains.append(list(reversed(chain)))
+    return chains
+
+
+# ---------------------------------------------------------------------------
+# built-in passes
+# ---------------------------------------------------------------------------
+
+
+@register_pass("conv_bn_fuse")
+def _conv_bn_fuse(program: fw.Program, scope) -> int:
+    """Folds inference-mode batch_norm into conv2d/mul weights — needs the
+    parameter VALUES, so it lives at the program level (reference
+    conv_bn_fuse_pass.cc / inference_transpiler.py)."""
+    from .inference import inference_transpile
+
+    if scope is None:
+        raise ValueError("conv_bn_fuse needs a scope (it folds weights)")
+    return inference_transpile(program, scope)
+
+
+@register_pass("layer_norm_gelu_fuse")
+def _layer_norm_gelu_fuse(program: fw.Program, scope=None) -> int:
+    """Rewrites layer_norm -> gelu chains into the Pallas-backed
+    fused_layer_norm_gelu op (the reference's fuse-pass tier, e.g.
+    fuse_elewise_add_act; here the fused op is the hand-written kernel
+    target)."""
+    block = program.global_block()
+    n = 0
+    changed = True
+    while changed:
+        changed = False
+        for chain in find_chains(block, ["layer_norm", "gelu"]):
+            (i_ln, ln), (i_act, act) = chain
+            inputs = {"X": ln.input("X")}
+            if ln.input("Scale"):
+                inputs["Scale"] = ln.input("Scale")
+            if ln.input("Bias"):
+                inputs["Bias"] = ln.input("Bias")
+            out_name = act.output("Out")[0]
+            attrs = {
+                "begin_norm_axis": ln.attr("begin_norm_axis", 1),
+                "epsilon": ln.attr("epsilon", 1e-5),
+                "approximate": act.attr("approximate", False),
+            }
+            # remove the higher index first so the lower stays valid
+            for idx in sorted((i_ln, i_act), reverse=True):
+                block.remove_op(idx)
+            block.insert_op(
+                min(i_ln, i_act),
+                "fused_layer_norm_gelu",
+                inputs=inputs,
+                outputs={"Out": [out_name]},
+                attrs=attrs,
+            )
+            n += 1
+            changed = True
+            break
+    return n

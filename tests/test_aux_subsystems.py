@@ -171,3 +171,40 @@ def test_checkpoint_manager_interval_and_resume(tmp_path):
         (lv,) = exe.run(feed=batch(step), fetch_list=[loss])
         resumed.append(float(np.asarray(lv)))
     np.testing.assert_allclose(resumed, losses[6:], rtol=1e-6)
+
+
+def test_pass_registry_and_layer_norm_gelu_fuse():
+    """Pass registry + pattern-matched fusion (ir/pass.h REGISTER_PASS +
+    GraphPatternDetector parity)."""
+    assert "layer_norm_gelu_fuse" in pt.passes.list_passes()
+    x = layers.data(name="x", shape=[8, 16], dtype="float32")
+    ln = layers.layer_norm(x, begin_norm_axis=2)
+    act = layers.gelu(ln)
+    out = layers.reduce_sum(act)
+    prog = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    xv = np.random.RandomState(3).randn(2, 8, 16).astype("float32")
+    (ref,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+
+    n = pt.passes.apply_pass("layer_norm_gelu_fuse", prog)
+    assert n == 1
+    types = [op.type for op in prog.global_block().ops]
+    assert "fused_layer_norm_gelu" in types
+    assert "gelu" not in types and "layer_norm" not in types
+    (fused,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv_bn_fuse_registered_as_pass():
+    img = layers.data(name="img", shape=[1, 6, 6], dtype="float32")
+    conv = layers.conv2d(img, num_filters=2, filter_size=3, padding=1,
+                         bias_attr=False)
+    bn = layers.batch_norm(conv)
+    out = layers.reduce_sum(bn)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    prog = pt.default_main_program().clone(for_test=True)
+    n = pt.passes.apply_pass("conv_bn_fuse", prog, pt.global_scope())
+    assert n == 1
