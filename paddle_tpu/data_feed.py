@@ -94,7 +94,9 @@ class MultiSlotDataFeed:
             if slot.type == "float":
                 vals.append(np.asarray(raw, dtype=np.float32))
             else:
-                vals.append(np.asarray(raw, dtype=np.int64))
+                # ids are uint64 on the wire (reference MultiSlot format);
+                # np.int64 would OverflowError on hashed ids >= 2^63
+                vals.append(np.asarray(raw, dtype=np.uint64))
         return vals
 
     def _batch_to_feed(self, rows: List[List[np.ndarray]]) -> Dict[str, np.ndarray]:
@@ -110,8 +112,10 @@ class MultiSlotDataFeed:
                     arr[i, :min(len(c), slot.dim)] = c[:slot.dim]
                 feed[slot.name] = arr
             else:
-                # padded ids + length vector (dense LoD replacement)
-                arr = np.zeros((len(cols), slot.max_len), "int64")
+                # padded ids + length vector (dense LoD replacement);
+                # uint64 batch so upper-range hashed ids survive (embedding
+                # tables index mod table-size anyway)
+                arr = np.zeros((len(cols), slot.max_len), "uint64")
                 lens = np.zeros((len(cols),), "int64")
                 for i, c in enumerate(cols):
                     k = min(len(c), slot.max_len)
@@ -167,7 +171,11 @@ class AsyncExecutor:
         feed_parser = MultiSlotDataFeed(data_feed_desc)
         q: "queue.Queue" = queue.Queue(maxsize=queue_capacity)
         end = object()
-        errors: List[BaseException] = []
+
+        class _Err:
+            def __init__(self, exc):
+                self.exc = exc
+
         thread_num = max(1, min(thread_num, len(filelist)))
 
         def worker(shard: List[str]):
@@ -175,8 +183,11 @@ class AsyncExecutor:
                 for path in shard:
                     for feed in feed_parser.read_file(path):
                         q.put(feed)
-            except BaseException as e:  # surfaced in the consumer
-                errors.append(e)
+            except BaseException as e:
+                # promptly surfaced: the consumer stops at the NEXT batch
+                # instead of silently training through a full pass and
+                # discarding every result at the end
+                q.put(_Err(e))
             finally:
                 q.put(end)
 
@@ -195,11 +206,11 @@ class AsyncExecutor:
             if item is end:
                 done += 1
                 continue
+            if isinstance(item, _Err):
+                raise item.exc
             outs = self.executor.run(
                 program, feed=item, fetch_list=fetch_list, scope=scope)
             results.append([float(np.asarray(o).reshape(-1)[0])
                             if np.asarray(o).size == 1 else np.asarray(o)
                             for o in outs])
-        if errors:
-            raise errors[0]
         return results

@@ -366,7 +366,10 @@ class StaticRNN:
             if shape is None:
                 raise ValueError("memory() needs init= or shape=")
             # deferred: parent-block init built in _finalize
-            init_spec = (list(shape), float(value), dtype)
+            # remember which sequence the batch dim should follow: a step
+            # var from step_input(), or (default) the first step input
+            ref_name = batch_ref.name if batch_ref is not None else None
+            init_spec = (list(shape), float(value), dtype, ref_name)
             mem_shape = [-1] + list(shape)
         else:
             init_spec = None
@@ -390,15 +393,16 @@ class StaticRNN:
 
     def _materialize_inits(self, parent):
         """Create deferred constant inits in the parent block (batch dim
-        follows the first sequence input at runtime)."""
-        from . import nn as _nn  # noqa: F401  (ensures layer registry)
-
+        follows batch_ref's sequence, or the first step input)."""
+        step_to_outer = {step.name: outer
+                         for outer, step in self._step_inputs}
         seq0 = self._step_inputs[0][0]
         for m in self._memories:
             if m[1] is None:
-                shape, value, dtype = m[3]
+                shape, value, dtype, ref_name = m[3]
+                ref = step_to_outer.get(ref_name, seq0)
                 m[1] = T.fill_constant_batch_size_like(
-                    seq0, [ -1 ] + shape, dtype, value)
+                    ref, [-1] + shape, dtype, value)
 
     def step_output(self, out):
         self._require_in_step()

@@ -13,7 +13,7 @@ reference fuse pass in scope actually matches.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Sequence
 
 from .core import framework as fw
 
@@ -60,16 +60,25 @@ def consumers(block: fw.Block, name: str) -> List[fw.Operator]:
     return [op for op in block.ops if name in op.input_arg_names()]
 
 
-def find_chains(block: fw.Block, types: Sequence[str],
-                link_slots: Optional[Sequence[str]] = None):
+def consumer_counts(block: fw.Block) -> Dict[str, int]:
+    """One-pass name -> number of consuming ops map."""
+    counts: Dict[str, int] = {}
+    for op in block.ops:
+        for n in set(op.input_arg_names()):
+            counts[n] = counts.get(n, 0) + 1
+    return counts
+
+
+def find_chains(block: fw.Block, types: Sequence[str]):
     """Find op chains op0 -> op1 -> ... where opK's type is types[K] and
-    each link variable (opK's first output, or link_slots[K]) feeds ONLY
-    op{K+1}.  Returns a list of lists of (index, op) pairs, in program
-    order of the chain head."""
+    each link variable feeds ONLY op{K+1}.  Returns a list of lists of
+    (index, op) pairs, in program order of the chain head.  Builds its
+    producer/consumer indexes in one pass each (O(ops))."""
     producers = {}
     for i, op in enumerate(block.ops):
         for n in op.output_arg_names():
             producers[n] = (i, op)
+    counts = consumer_counts(block)
 
     chains = []
     for i, op in enumerate(block.ops):
@@ -79,13 +88,12 @@ def find_chains(block: fw.Block, types: Sequence[str],
         ok = True
         cur = op
         for k in range(len(types) - 2, -1, -1):
-            in_names = cur.input_arg_names()
             prev = None
-            for n in in_names:
+            for n in cur.input_arg_names():
                 p = producers.get(n)
                 if p is not None and p[1].type == types[k]:
                     # the link var must feed only `cur`
-                    if len(consumers(block, n)) == 1:
+                    if counts.get(n, 0) == 1:
                         prev = p
                         break
             if prev is None:
@@ -122,12 +130,24 @@ def _layer_norm_gelu_fuse(program: fw.Program, scope=None) -> int:
     fuse_elewise_add_act; here the fused op is the hand-written kernel
     target)."""
     block = program.global_block()
+    fetch_names = set(getattr(program, "fetch_var_names", []) or [])
     n = 0
     changed = True
     while changed:
         changed = False
+        counts = consumer_counts(block)
         for chain in find_chains(block, ["layer_norm", "gelu"]):
             (i_ln, ln), (i_act, act) = chain
+            # the rewrite deletes layer_norm's Y/Mean/Variance vars: bail
+            # if any is a fetch target or has consumers beyond the gelu
+            aux_used = any(
+                counts.get(o, 0) > 0
+                for slot in ("Mean", "Variance")
+                for o in ln.output(slot)
+            )
+            removed_outs = set(ln.output_arg_names())
+            if aux_used or (removed_outs & fetch_names):
+                continue
             inputs = {"X": ln.input("X")}
             if ln.input("Scale"):
                 inputs["Scale"] = ln.input("Scale")
@@ -151,5 +171,5 @@ def _layer_norm_gelu_fuse(program: fw.Program, scope=None) -> int:
             )
             n += 1
             changed = True
-            break
+            break  # indices shifted: rescan (one O(ops) pass per rewrite)
     return n

@@ -97,3 +97,16 @@ def test_async_executor_trains_ctr_model(tmp_path):
             fetch_list=[loss])
         all_losses.append(float(np.mean([r[0] for r in res])))
     assert all_losses[-1] < all_losses[0] * 0.7, all_losses
+
+
+def test_multislot_uint64_ids(tmp_path):
+    """Hashed CTR ids live in the full uint64 range (reference MultiSlot
+    uses uint64 slots); the parser must not overflow."""
+    path = tmp_path / "u64.txt"
+    big = 2**64 - 1
+    path.write_text(f"2 {big} 7 1 0.5 1 1.0\n")
+    feed = list(pt.MultiSlotDataFeed(_desc(batch_size=1)).read_file(
+        str(path)))[0]
+    assert feed["ids"].dtype == np.uint64
+    assert feed["ids"][0, 0] == np.uint64(big)
+    assert feed["ids__len"][0] == 2
