@@ -5,8 +5,11 @@ PADDLE_TPU_SYNTH_DATA=1) for zero-egress environments."""
 from . import (  # noqa: F401
     cifar,
     common,
+    conll05,
     imdb,
+    imikolov,
     mnist,
     movielens,
     uci_housing,
+    wmt16,
 )

@@ -1,0 +1,71 @@
+"""CoNLL-2005 SRL dataset (reference: python/paddle/dataset/conll05.py —
+word/predicate/label dicts + test() reader yielding the 9-slot SRL sample
+the label_semantic_roles book model consumes).
+
+The real corpus is license-restricted (the reference downloads only the
+test split); the synthetic mode generates IOB-tagged predicate/argument
+structures with learnable word->role structure."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+_WORDS = 800
+_LABELS = ["B-A0", "I-A0", "B-A1", "I-A1", "B-V", "O"]
+
+
+def word_dict(synthetic=True):
+    return {f"w{i}": i for i in range(_WORDS)} | {"<unk>": _WORDS}
+
+
+def verb_dict(synthetic=True):
+    return {f"v{i}": i for i in range(50)}
+
+
+def label_dict(synthetic=True):
+    return {l: i for i, l in enumerate(_LABELS)}
+
+
+def get_dict(synthetic=True):
+    """reference conll05.get_dict(): (word_dict, verb_dict, label_dict)."""
+    return word_dict(synthetic), verb_dict(synthetic), label_dict(synthetic)
+
+
+def test(synthetic=True, n_samples=300):
+    """Yields (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_id,
+    mark, label_ids) — the 9 feature slots of the reference SRL pipeline
+    (predicate-context windows + predicate mark)."""
+
+    def reader():
+        rng = np.random.RandomState(25)
+        wd = word_dict(synthetic)
+        ld = label_dict(synthetic)
+        for _ in range(n_samples):
+            ln = int(rng.randint(5, 18))
+            words = rng.randint(0, _WORDS, ln).tolist()
+            v_pos = int(rng.randint(0, ln))
+            verb = words[v_pos] % 50
+            labels = ["O"] * ln
+            labels[v_pos] = "B-V"
+            # A0 span before the verb, A1 span after (when room): role
+            # derivable from position relative to the predicate -> learnable
+            if v_pos >= 2:
+                labels[v_pos - 2] = "B-A0"
+                labels[v_pos - 1] = "I-A0"
+            if v_pos + 2 < ln:
+                labels[v_pos + 1] = "B-A1"
+                labels[v_pos + 2] = "I-A1"
+
+            def ctx(off):
+                i = min(max(v_pos + off, 0), ln - 1)
+                return [words[i]] * ln
+
+            mark = [1 if i == v_pos else 0 for i in range(ln)]
+            yield (
+                words, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                [verb] * ln, mark, [ld[l] for l in labels],
+            )
+
+    return reader

@@ -1,0 +1,90 @@
+"""PTB/imikolov language-model dataset (reference:
+python/paddle/dataset/imikolov.py — build_dict + train/test readers
+yielding n-gram tuples or sequences; the word2vec book model's data).
+
+Offline fallback: synthetic text from a Zipfian unigram model with
+order-2 Markov structure, so n-gram models actually learn."""
+
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from . import common
+
+URL = "https://raw.githubusercontent.com/wojzaremba/lstm/master/data/ptb.train.txt"
+_VOCAB = 2000
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def _synthetic_tokens(seed, n_sentences=400):
+    rng = np.random.RandomState(seed)
+    # order-2 structure: next word depends on previous via a shift pattern
+    for _ in range(n_sentences):
+        ln = int(rng.randint(5, 25))
+        w = int(rng.zipf(1.3)) % _VOCAB
+        sent = []
+        for _ in range(ln):
+            sent.append(f"w{w}")
+            w = (w * 31 + int(rng.zipf(1.3))) % _VOCAB
+        yield sent
+
+
+def _real_sentences(path):
+    with open(path) as f:
+        for line in f:
+            toks = line.strip().split()
+            if toks:
+                yield toks
+
+
+def build_dict(min_word_freq=50, synthetic=False):
+    """word -> id, frequency-sorted, '<unk>' last (reference
+    imikolov.build_dict)."""
+    freq = {}
+    if common.use_synthetic(synthetic):
+        sents = _synthetic_tokens(3)
+    else:
+        sents = _real_sentences(common.download(URL, "imikolov", None))
+    for sent in sents:
+        for w in sent:
+            freq[w] = freq.get(w, 0) + 1
+    if common.use_synthetic(synthetic):
+        min_word_freq = 1
+    words = sorted(
+        (w for w, c in freq.items() if c >= min_word_freq),
+        key=lambda w: (-freq[w], w))
+    d = {w: i for i, w in enumerate(words)}
+    d["<unk>"] = len(d)
+    return d
+
+
+def _reader(word_idx, n, data_type, seed, synthetic):
+    def reader():
+        unk = word_idx["<unk>"]
+        if common.use_synthetic(synthetic):
+            sents = _synthetic_tokens(seed)
+        else:
+            sents = _real_sentences(common.download(URL, "imikolov", None))
+        for sent in sents:
+            ids = [word_idx.get(w, unk) for w in ["<s>"] + sent + ["<e>"]]
+            if data_type == DataType.NGRAM:
+                if len(ids) >= n:
+                    for i in range(n, len(ids) + 1):
+                        yield tuple(ids[i - n:i])
+            else:
+                yield ids
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM, synthetic=False):
+    return _reader(word_idx, n, data_type, 11, synthetic)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM, synthetic=False):
+    return _reader(word_idx, n, data_type, 12, synthetic)

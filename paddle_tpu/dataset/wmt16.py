@@ -1,0 +1,69 @@
+"""WMT16 En-De translation dataset (reference:
+python/paddle/dataset/wmt16.py — BPE-tokenized parallel corpus with
+get_dict + train/test/validation readers yielding (src_ids, trg_ids,
+trg_next_ids); the transformer/machine-translation workload's data).
+
+Offline fallback: a synthetic 'translation' task — the target is a
+deterministic per-token mapping of the source plus a reversal flag — so
+seq2seq models trained on it genuinely learn a transduction."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+_SRC_VOCAB = 1000
+_TRG_VOCAB = 1000
+BOS, EOS, UNK = 0, 1, 2
+
+
+def get_dict(lang, dict_size, reverse=False, synthetic=True):
+    """reference wmt16.get_dict: token<->id for 'en'/'de'."""
+    size = min(dict_size, _SRC_VOCAB if lang == "en" else _TRG_VOCAB)
+    d = {"<s>": BOS, "<e>": EOS, "<unk>": UNK}
+    for i in range(3, size):
+        d[f"{lang}{i}"] = i
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _synthetic_pairs(seed, n_samples, src_dict_size, trg_dict_size):
+    rng = np.random.RandomState(seed)
+    for _ in range(n_samples):
+        ln = int(rng.randint(4, 16))
+        src = rng.randint(3, src_dict_size, ln)
+        # deterministic transduction: affine token map (mod vocab-3)
+        trg = 3 + (src * 7 + 3) % (trg_dict_size - 3)
+        yield src.tolist(), trg.tolist()
+
+
+def _reader(seed, n_samples, src_dict_size, trg_dict_size, synthetic):
+    def reader():
+        if not common.use_synthetic(synthetic):
+            raise RuntimeError(
+                "wmt16: real-corpus mode needs the tar at the dataset "
+                "cache path (zero-egress image) — use synthetic=True")
+        for src, trg in _synthetic_pairs(seed, n_samples, src_dict_size,
+                                         trg_dict_size):
+            src_ids = [BOS] + src + [EOS]
+            trg_ids = [BOS] + trg
+            trg_next = trg + [EOS]
+            yield src_ids, trg_ids, trg_next
+    return reader
+
+
+def train(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
+          src_lang="en", synthetic=True, n_samples=2000):
+    return _reader(31, n_samples, src_dict_size, trg_dict_size, synthetic)
+
+
+def test(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
+         src_lang="en", synthetic=True, n_samples=200):
+    return _reader(32, n_samples, src_dict_size, trg_dict_size, synthetic)
+
+
+def validation(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
+               src_lang="en", synthetic=True, n_samples=200):
+    return _reader(33, n_samples, src_dict_size, trg_dict_size, synthetic)

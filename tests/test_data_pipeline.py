@@ -174,3 +174,38 @@ def test_double_buffer_chunked_large_array():
 
     (got,) = list(decorator.double_buffer(src)())
     np.testing.assert_array_equal(np.asarray(got["x"]), big)
+
+
+def test_imikolov_synthetic():
+    wd = pt.dataset.imikolov.build_dict(synthetic=True)
+    assert "<unk>" in wd
+    grams = list(pt.dataset.imikolov.train(wd, 5, synthetic=True)())
+    assert len(grams) > 100
+    assert all(len(g) == 5 for g in grams[:20])
+    seqs = list(pt.dataset.imikolov.train(
+        wd, 5, pt.dataset.imikolov.DataType.SEQ, synthetic=True)())
+    assert all(isinstance(s, list) for s in seqs[:5])
+
+
+def test_conll05_synthetic():
+    wd, vd, ld = pt.dataset.conll05.get_dict()
+    samples = list(pt.dataset.conll05.test()())
+    assert len(samples) == 300
+    s = samples[0]
+    assert len(s) == 9  # 9 SRL feature slots
+    words, *ctx, verb, mark, labels = s
+    assert len(words) == len(labels) == len(mark)
+    assert sum(mark) == 1  # exactly one predicate
+    assert ld["B-V"] in labels
+
+
+def test_wmt16_synthetic():
+    samples = list(pt.dataset.wmt16.train(n_samples=50)())
+    assert len(samples) == 50
+    src, trg, trg_next = samples[0]
+    assert src[0] == pt.dataset.wmt16.BOS and src[-1] == pt.dataset.wmt16.EOS
+    assert trg[0] == pt.dataset.wmt16.BOS
+    assert trg_next[-1] == pt.dataset.wmt16.EOS
+    assert trg[1:] == trg_next[:-1]  # shifted pair
+    d = pt.dataset.wmt16.get_dict("en", 1000)
+    assert d["<s>"] == 0 and len(d) == 1000
