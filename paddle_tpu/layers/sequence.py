@@ -6,6 +6,7 @@ from __future__ import annotations
 
 from ..core import framework as fw
 from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
 
 
 def sequence_pool(input, pool_type, length=None):
@@ -258,3 +259,45 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
         },
     )
     return out
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", length=None, name=None):
+    """LSTM with recurrent projection (reference nn.py dynamic_lstmp,
+    lstmp_op.cc); `input` is [B, T, 4*hidden] pre-projected.  Returns
+    (projection [B, T, proj_size], cell [B, T, hidden])."""
+    helper = LayerHelper("lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = size // 4
+    w = helper.create_parameter(helper.param_attr(), shape=[proj_size, 4 * d],
+                                dtype=input.dtype)
+    w_proj = helper.create_parameter(
+        ParamAttr._to_attr(param_attr), shape=[d, proj_size],
+        dtype=input.dtype)
+    bias_size = 7 * d if use_peepholes else 4 * d
+    b = helper.create_parameter(helper.bias_attr(), shape=[1, bias_size],
+                                dtype=input.dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(input.dtype)
+    cell = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Input": [input], "Weight": [w], "ProjWeight": [w_proj],
+              "Bias": [b]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        "lstmp",
+        inputs=inputs,
+        outputs={"Projection": [proj], "Cell": [cell]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+            "proj_activation": proj_activation,
+        },
+    )
+    if input.shape:
+        proj.shape = (input.shape[0], input.shape[1], proj_size)
+        cell.shape = (input.shape[0], input.shape[1], d)
+    return proj, cell

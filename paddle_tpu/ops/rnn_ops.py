@@ -215,3 +215,69 @@ def lower_lstm_unit(ctx, ins):
     c = f * c_prev + i * jnp.tanh(gc)
     h = jax.nn.sigmoid(go) * jnp.tanh(c)
     return {"C": [c], "H": [h]}
+
+
+@register("lstmp")
+def lower_lstmp(ctx, ins):
+    """LSTM with a recurrent projection layer (reference lstmp_op.cc:
+    h_t = proj_act(P^T * o * act(c_t)); the recurrent matmul runs over the
+    PROJECTED state r, so Weight is [P, 4D]).  Same gate order c,i,f,o and
+    masking semantics as dynamic_lstm; one lax.scan."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["Input"][0]
+    w = ins["Weight"][0]          # [P, 4D]
+    w_proj = ins["ProjWeight"][0]  # [D, P]
+    bias = ins.get("Bias", [None])[0]
+    b, t, d4 = x.shape
+    d = d4 // 4
+    p_dim = w_proj.shape[1]
+    length = _length_mask(ins, x)
+    use_peep = ctx.attr("use_peepholes", False)
+    gate_act = _act(ctx.attr("gate_activation", "sigmoid"))
+    cell_act = _act(ctx.attr("cell_activation", "tanh"))
+    cand_act = _act(ctx.attr("candidate_activation", "tanh"))
+    proj_act = _act(ctx.attr("proj_activation", "tanh"))
+
+    if bias is not None:
+        x = x + bias.reshape(1, 1, -1)[:, :, : 4 * d]
+        if use_peep:
+            peep = bias.reshape(-1)[4 * d:]
+            w_ic, w_fc, w_oc = peep[:d], peep[d: 2 * d], peep[2 * d: 3 * d]
+        else:
+            w_ic = w_fc = w_oc = None
+    else:
+        w_ic = w_fc = w_oc = None
+
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, 4D]
+    step_ids = jnp.arange(t)
+    r_init = jnp.zeros((b, p_dim), x.dtype)
+    c_init = jnp.zeros((b, d), x.dtype)
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        xt, tid = inp
+        gates = xt + r_prev @ w  # [B, 4D], columns c,i,f,o
+        gc, gi, gf, go = jnp.split(gates, 4, axis=1)
+        if use_peep and w_ic is not None:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c = f * c_prev + i * cand_act(gc)
+        if use_peep and w_oc is not None:
+            go = go + c * w_oc
+        o = gate_act(go)
+        h = o * cell_act(c)
+        r = proj_act(h @ w_proj)  # [B, P]
+        valid = (tid < length)[:, None]
+        r = jnp.where(valid, r, r_prev)
+        c = jnp.where(valid, c, c_prev)
+        return (r, c), (r, c)
+
+    (_, _), (rs, cs) = jax.lax.scan(step, (r_init, c_init), (xs, step_ids))
+    return {
+        "Projection": [jnp.swapaxes(rs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+    }

@@ -93,3 +93,31 @@ def test_dynamic_rnn_masks_by_length():
     np.testing.assert_allclose(o[0, :, 0], [1, 2, 3, 4, 5, 6])
     np.testing.assert_allclose(o[1, :, 0], [1, 2, 3, 0, 0, 0])
     np.testing.assert_allclose(o[2, :, 0], [1, 0, 0, 0, 0, 0])
+
+
+def test_dynamic_lstmp_shapes_and_training():
+    """LSTM with recurrent projection (reference lstmp_op.cc): projection
+    output drives the recurrence; trains end-to-end."""
+    b, t, h, p = 8, 6, 16, 8
+    x = layers.data(name="x", shape=[t, 5], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    proj_in = layers.fc(x, size=4 * h, num_flatten_dims=2, bias_attr=False)
+    proj, cell = layers.dynamic_lstmp(proj_in, size=4 * h, proj_size=p)
+    pooled = layers.sequence_pool(proj, "last")
+    pred = layers.fc(pooled, size=1)
+    loss = layers.mean(layers.square(pred - y))
+    pt.optimizer.AdamOptimizer(learning_rate=0.02).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for _ in range(40):
+        xv = rng.randn(b, t, 5).astype("float32")
+        yv = xv.sum(axis=(1, 2), keepdims=False)[:, None].astype(
+            "float32") * 0.1
+        (lv,) = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    # shapes: projection [b, t, p], cell [b, t, h]
+    res = exe.run(feed={"x": xv, "y": yv}, fetch_list=[proj, cell])
+    assert np.asarray(res[0]).shape == (b, t, p)
+    assert np.asarray(res[1]).shape == (b, t, h)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
