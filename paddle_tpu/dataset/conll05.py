@@ -10,8 +10,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from . import common
-
 _WORDS = 800
 _LABELS = ["B-A0", "I-A0", "B-A1", "I-A1", "B-V", "O"]
 
@@ -37,6 +35,10 @@ def test(synthetic=True, n_samples=300):
     """Yields (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_id,
     mark, label_ids) — the 9 feature slots of the reference SRL pipeline
     (predicate-context windows + predicate mark)."""
+    if not synthetic:
+        raise RuntimeError(
+            "conll05: the real corpus is license-restricted and this image "
+            "has no egress — only synthetic mode is available")
 
     def reader():
         rng = np.random.RandomState(25)

@@ -7,8 +7,6 @@ order-2 Markov structure, so n-gram models actually learn."""
 
 from __future__ import annotations
 
-import tarfile
-
 import numpy as np
 
 from . import common
@@ -52,7 +50,9 @@ def build_dict(min_word_freq=50, synthetic=False):
     else:
         sents = _real_sentences(common.download(URL, "imikolov", None))
     for sent in sents:
-        for w in sent:
+        # sentence boundaries get real ids (reference imikolov counts
+        # <s>/<e> per sentence), so LM n-grams see true boundaries
+        for w in sent + ["<s>", "<e>"]:
             freq[w] = freq.get(w, 0) + 1
     if common.use_synthetic(synthetic):
         min_word_freq = 1

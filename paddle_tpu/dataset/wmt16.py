@@ -56,6 +56,8 @@ def _reader(seed, n_samples, src_dict_size, trg_dict_size, synthetic):
 
 def train(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
           src_lang="en", synthetic=True, n_samples=2000):
+    """src_lang is accepted for reference-signature parity; the synthetic
+    transduction is language-agnostic (ids only)."""
     return _reader(31, n_samples, src_dict_size, trg_dict_size, synthetic)
 
 

@@ -262,20 +262,30 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
 
 
 def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
-                  use_peepholes=True, gate_activation="sigmoid",
-                  cell_activation="tanh", candidate_activation="tanh",
-                  proj_activation="tanh", length=None, name=None):
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  h_0=None, c_0=None, length=None, name=None):
     """LSTM with recurrent projection (reference nn.py dynamic_lstmp,
     lstmp_op.cc); `input` is [B, T, 4*hidden] pre-projected.  Returns
     (projection [B, T, proj_size], cell [B, T, hidden])."""
+    import copy
+
     helper = LayerHelper("lstmp", param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
     d = size // 4
     w = helper.create_parameter(helper.param_attr(), shape=[proj_size, 4 * d],
                                 dtype=input.dtype)
+    # a fresh attr per parameter: ParamAttr._to_attr returns the SAME
+    # object for a ParamAttr arg, and reusing it would alias both weights
+    # onto one named variable
+    proj_attr = ParamAttr._to_attr(param_attr)
+    if proj_attr not in (None, False):
+        proj_attr = copy.deepcopy(proj_attr)
+        if proj_attr.name:
+            proj_attr.name += "_proj"
     w_proj = helper.create_parameter(
-        ParamAttr._to_attr(param_attr), shape=[d, proj_size],
-        dtype=input.dtype)
+        proj_attr, shape=[d, proj_size], dtype=input.dtype)
     bias_size = 7 * d if use_peepholes else 4 * d
     b = helper.create_parameter(helper.bias_attr(), shape=[1, bias_size],
                                 dtype=input.dtype, is_bias=True)
@@ -283,6 +293,10 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
     cell = helper.create_variable_for_type_inference(input.dtype)
     inputs = {"Input": [input], "Weight": [w], "ProjWeight": [w_proj],
               "Bias": [b]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
     if length is not None:
         inputs["Length"] = [length]
     helper.append_op(
@@ -291,6 +305,7 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
         outputs={"Projection": [proj], "Cell": [cell]},
         attrs={
             "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
             "gate_activation": gate_activation,
             "cell_activation": cell_activation,
             "candidate_activation": candidate_activation,

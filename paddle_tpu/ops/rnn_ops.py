@@ -49,14 +49,12 @@ def _reverse_valid(x, length):
     return jnp.take_along_axis(x, idx, axis=1)
 
 
-@register("dynamic_lstm")
-def lower_dynamic_lstm(ctx, ins):
-    """Input: [B, T, 4D] pre-projected gates input (reference lstm_op.cc
-    expects x already times W_x); Weight [D, 4D] recurrent; Bias [1, 4D]
-    (+ peephole terms if use_peepholes).  Gate column order c,i,f,o —
-    candidate first, matching the reference weight layout
-    (math/detail/lstm_kernel.h; nn.py:397 documents {W_ch, W_ih, W_fh,
-    W_oh}) so reference-trained weights port unchanged."""
+def _lstm_scan(ctx, ins, proj=None):
+    """Shared LSTM machinery (bias/peephole slicing, activations, length
+    masking, is_reverse, H0/C0, the c,i,f,o gate step, one lax.scan).
+    `proj`: optional (w_proj, proj_act) — the LSTMP recurrent projection
+    applied to h before it becomes the carried state (lstmp_op.cc).
+    Returns (states [B, T, state_dim], cells [B, T, D])."""
     import jax
 
     jnp = _jnp()
@@ -86,9 +84,10 @@ def lower_dynamic_lstm(ctx, ins):
     xs = jnp.swapaxes(xs, 0, 1)  # [T, B, 4D]
     step_ids = jnp.arange(t)
 
+    state_dim = proj[0].shape[1] if proj is not None else d
     h0 = ins.get("H0", [None])[0]
     c0 = ins.get("C0", [None])[0]
-    h_init = h0 if h0 is not None else jnp.zeros((b, d), x.dtype)
+    h_init = h0 if h0 is not None else jnp.zeros((b, state_dim), x.dtype)
     c_init = c0 if c0 is not None else jnp.zeros((b, d), x.dtype)
 
     def step(carry, inp):
@@ -106,18 +105,32 @@ def lower_dynamic_lstm(ctx, ins):
             go = go + c * w_oc
         o = gate_act(go)
         h = o * cell_act(c)
+        if proj is not None:
+            w_proj, proj_act = proj
+            h = proj_act(h @ w_proj)  # [B, P]
         valid = (tid < length)[:, None]
         h = jnp.where(valid, h, h_prev)
         c = jnp.where(valid, c, c_prev)
         return (h, c), (h, c)
 
-    (h_last, c_last), (hs, cs) = jax.lax.scan(step, (h_init, c_init),
-                                              (xs, step_ids))
+    _, (hs, cs) = jax.lax.scan(step, (h_init, c_init), (xs, step_ids))
     hs = jnp.swapaxes(hs, 0, 1)
     cs = jnp.swapaxes(cs, 0, 1)
     if is_reverse:
         hs = _reverse_valid(hs, length)
         cs = _reverse_valid(cs, length)
+    return hs, cs
+
+
+@register("dynamic_lstm")
+def lower_dynamic_lstm(ctx, ins):
+    """Input: [B, T, 4D] pre-projected gates input (reference lstm_op.cc
+    expects x already times W_x); Weight [D, 4D] recurrent; Bias [1, 4D]
+    (+ peephole terms if use_peepholes).  Gate column order c,i,f,o —
+    candidate first, matching the reference weight layout
+    (math/detail/lstm_kernel.h; nn.py:397 documents {W_ch, W_ih, W_fh,
+    W_oh}) so reference-trained weights port unchanged."""
+    hs, cs = _lstm_scan(ctx, ins)
     return {"Hidden": [hs], "Cell": [cs]}
 
 
@@ -220,64 +233,10 @@ def lower_lstm_unit(ctx, ins):
 @register("lstmp")
 def lower_lstmp(ctx, ins):
     """LSTM with a recurrent projection layer (reference lstmp_op.cc:
-    h_t = proj_act(P^T * o * act(c_t)); the recurrent matmul runs over the
-    PROJECTED state r, so Weight is [P, 4D]).  Same gate order c,i,f,o and
-    masking semantics as dynamic_lstm; one lax.scan."""
-    import jax
-
-    jnp = _jnp()
-    x = ins["Input"][0]
-    w = ins["Weight"][0]          # [P, 4D]
-    w_proj = ins["ProjWeight"][0]  # [D, P]
-    bias = ins.get("Bias", [None])[0]
-    b, t, d4 = x.shape
-    d = d4 // 4
-    p_dim = w_proj.shape[1]
-    length = _length_mask(ins, x)
-    use_peep = ctx.attr("use_peepholes", False)
-    gate_act = _act(ctx.attr("gate_activation", "sigmoid"))
-    cell_act = _act(ctx.attr("cell_activation", "tanh"))
-    cand_act = _act(ctx.attr("candidate_activation", "tanh"))
+    r_t = proj_act(h_t @ P); the recurrence runs over the PROJECTED state,
+    so Weight is [P, 4D]).  Shares the gate/peephole/masking/is_reverse
+    core with dynamic_lstm (_lstm_scan)."""
+    w_proj = ins["ProjWeight"][0]
     proj_act = _act(ctx.attr("proj_activation", "tanh"))
-
-    if bias is not None:
-        x = x + bias.reshape(1, 1, -1)[:, :, : 4 * d]
-        if use_peep:
-            peep = bias.reshape(-1)[4 * d:]
-            w_ic, w_fc, w_oc = peep[:d], peep[d: 2 * d], peep[2 * d: 3 * d]
-        else:
-            w_ic = w_fc = w_oc = None
-    else:
-        w_ic = w_fc = w_oc = None
-
-    xs = jnp.swapaxes(x, 0, 1)  # [T, B, 4D]
-    step_ids = jnp.arange(t)
-    r_init = jnp.zeros((b, p_dim), x.dtype)
-    c_init = jnp.zeros((b, d), x.dtype)
-
-    def step(carry, inp):
-        r_prev, c_prev = carry
-        xt, tid = inp
-        gates = xt + r_prev @ w  # [B, 4D], columns c,i,f,o
-        gc, gi, gf, go = jnp.split(gates, 4, axis=1)
-        if use_peep and w_ic is not None:
-            gi = gi + c_prev * w_ic
-            gf = gf + c_prev * w_fc
-        i = gate_act(gi)
-        f = gate_act(gf)
-        c = f * c_prev + i * cand_act(gc)
-        if use_peep and w_oc is not None:
-            go = go + c * w_oc
-        o = gate_act(go)
-        h = o * cell_act(c)
-        r = proj_act(h @ w_proj)  # [B, P]
-        valid = (tid < length)[:, None]
-        r = jnp.where(valid, r, r_prev)
-        c = jnp.where(valid, c, c_prev)
-        return (r, c), (r, c)
-
-    (_, _), (rs, cs) = jax.lax.scan(step, (r_init, c_init), (xs, step_ids))
-    return {
-        "Projection": [jnp.swapaxes(rs, 0, 1)],
-        "Cell": [jnp.swapaxes(cs, 0, 1)],
-    }
+    rs, cs = _lstm_scan(ctx, ins, proj=(w_proj, proj_act))
+    return {"Projection": [rs], "Cell": [cs]}
