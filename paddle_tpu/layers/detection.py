@@ -7,6 +7,8 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = [
+    "anchor_generator",
+    "box_clip",
     "prior_box",
     "box_coder",
     "iou_similarity",
@@ -149,3 +151,39 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1,
     return _roi("roi_align", input, rois, pooled_height, pooled_width,
                 spatial_scale, batch_idx,
                 {"sampling_ratio": sampling_ratio}, name)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variance=None,
+                     stride=None, offset=0.5, name=None):
+    """RPN anchors in pixel coords (reference detection.py anchor_generator,
+    anchor_generator_op.h)."""
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference("float32")
+    var = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={
+            "anchor_sizes": list(anchor_sizes),
+            "aspect_ratios": list(aspect_ratios),
+            "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+            "stride": list(stride or [16.0, 16.0]),
+            "offset": offset,
+        },
+    )
+    anchors.stop_gradient = True
+    var.stop_gradient = True
+    return anchors, var
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (reference detection.py box_clip)."""
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "box_clip",
+        inputs={"Input": [input], "ImInfo": [im_info]},
+        outputs={"Output": [out]},
+    )
+    return out

@@ -394,3 +394,68 @@ def lower_roi_pool(ctx, ins):
 
     out = jax.vmap(one)(rois, bidx)
     return {"Out": [out]}
+
+
+@register("anchor_generator", no_grad=True)
+def lower_anchor_generator(ctx, ins):
+    """RPN anchor generation (reference anchor_generator_op.h:26): per
+    feature cell, one anchor per (aspect_ratio, anchor_size) pair in PIXEL
+    (unnormalized) coordinates.  Outputs Anchors/Variances
+    [H, W, num_anchors, 4]."""
+    jnp = _jnp()
+    feat = ins["Input"][0]
+    sizes = [float(s) for s in ctx.attr("anchor_sizes")]
+    ratios = [float(r) for r in ctx.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in ctx.attr("stride")]
+    offset = ctx.attr("offset", 0.5)
+    fh, fw = feat.shape[2], feat.shape[3]
+    sw, sh = stride[0], stride[1]
+
+    # static per-cell half-extents, reference loop order (ratio, size)
+    whs = []
+    for ar in ratios:
+        base_w = round(math.sqrt(sw * sh / ar))
+        base_h = round(base_w * ar)
+        for sz in sizes:
+            whs.append((sz / sw * base_w, sz / sh * base_h))
+    wh = jnp.asarray(whs, jnp.float32)  # [A, 2]
+
+    cx = jnp.arange(fw, dtype=jnp.float32) * sw + offset * (sw - 1)
+    cy = jnp.arange(fh, dtype=jnp.float32) * sh + offset * (sh - 1)
+    a = wh.shape[0]
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, a))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, a))
+    aw = wh[None, None, :, 0]
+    ah = wh[None, None, :, 1]
+    anchors = jnp.stack([
+        cxg - 0.5 * (aw - 1), cyg - 0.5 * (ah - 1),
+        cxg + 0.5 * (aw - 1), cyg + 0.5 * (ah - 1),
+    ], axis=-1)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), anchors.shape)
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+@register("box_clip", no_grad=True)
+def lower_box_clip(ctx, ins):
+    """Clip boxes to image bounds (reference box_clip_op.h): ImInfo rows
+    are (height, width, scale); boxes clip to [0, dim - 1]."""
+    jnp = _jnp()
+    boxes = ins["Input"][0]  # [b, M, 4] or [M, 4]
+    im_info = ins["ImInfo"][0].reshape(-1, 3)
+    squeeze = boxes.ndim == 2
+    if squeeze:
+        boxes = boxes[None]
+    h = im_info[:, 0].reshape(-1, 1, 1) - 1.0
+    w = im_info[:, 1].reshape(-1, 1, 1) - 1.0
+    out = jnp.concatenate([
+        jnp.minimum(jnp.clip(boxes[..., 0:1], 0.0, None), w),
+        jnp.minimum(jnp.clip(boxes[..., 1:2], 0.0, None), h),
+        jnp.minimum(jnp.clip(boxes[..., 2:3], 0.0, None), w),
+        jnp.minimum(jnp.clip(boxes[..., 3:4], 0.0, None), h),
+    ], axis=-1)
+    if squeeze:
+        out = out[0]
+    return {"Output": [out]}

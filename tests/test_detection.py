@@ -175,3 +175,33 @@ def test_roi_align_is_differentiable():
     g = jax.grad(f)(xv)
     assert np.isfinite(np.asarray(g)).all()
     assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_anchor_generator_matches_reference_math():
+    feat = layers.data(name="feat", shape=[8, 2, 2], dtype="float32")
+    anchors, var = layers.anchor_generator(
+        feat, anchor_sizes=[32.0], aspect_ratios=[1.0],
+        stride=[16.0, 16.0])
+    (a, v) = _run([anchors, var], {
+        "feat": rng.rand(1, 8, 2, 2).astype("float32")})
+    a = np.asarray(a)
+    assert a.shape == (2, 2, 1, 4)
+    # cell (0,0): ctr = 0.5*15 = 7.5; base 16x16 scaled by 32/16 -> 32x32
+    np.testing.assert_allclose(
+        a[0, 0, 0], [7.5 - 15.5, 7.5 - 15.5, 7.5 + 15.5, 7.5 + 15.5])
+    # cell (0,1): ctr_x shifts by stride 16
+    np.testing.assert_allclose(a[0, 1, 0][0], 16 + 7.5 - 15.5)
+    np.testing.assert_allclose(np.asarray(v)[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_clip():
+    boxes = np.array([[[-5.0, -3.0, 50.0, 20.0],
+                       [10.0, 10.0, 100.0, 90.0]]], "float32")
+    im = np.array([[40.0, 60.0, 1.0]], "float32")  # h=40, w=60
+    bi = layers.data(name="b", shape=[2, 4], dtype="float32")
+    ii = layers.data(name="im", shape=[3], dtype="float32")
+    out = layers.box_clip(bi, ii)
+    (o,) = _run([out], {"b": boxes, "im": im})
+    np.testing.assert_allclose(
+        np.asarray(o)[0],
+        [[0.0, 0.0, 50.0, 20.0], [10.0, 10.0, 59.0, 39.0]])
