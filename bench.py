@@ -23,8 +23,51 @@ import argparse
 import json
 import sys
 import time
+import traceback
 
 import numpy as np
+
+# Substrings identifying retryable transport failures (the tunnel's RPC
+# stream occasionally drops a response mid-read; the work itself is fine
+# and a retry succeeds — round 3 lost its bench record to exactly this).
+_TRANSIENT_ERR_MARKERS = (
+    "read body",
+    "remote_compile",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "Connection reset",
+    "Broken pipe",
+    "EOF",
+)
+
+
+def _is_transient(exc):
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(m in msg for m in _TRANSIENT_ERR_MARKERS)
+
+
+def run_guarded(name, fn, *args, retries=2):
+    """Run one workload; print its JSON line the moment it is measured.
+
+    A failure in one workload must never zero the others: exceptions are
+    caught, transient tunnel/RPC errors are retried (the whole workload is
+    re-run — compile caches make the retry cheap), and the error is
+    reported on stderr.  Returns True iff a metric line was printed.
+    """
+    for attempt in range(retries + 1):
+        try:
+            fn(*args)
+            return True
+        except Exception as e:  # noqa: BLE001 — bench must survive anything
+            transient = _is_transient(e)
+            print(f"[bench] {name} attempt {attempt + 1} failed "
+                  f"({'transient' if transient else 'fatal'}): "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            if not transient or attempt == retries:
+                traceback.print_exc(file=sys.stderr)
+                return False
+            time.sleep(5.0 * (attempt + 1))
+    return False
 
 REFERENCE_RESNET50_IMGS_PER_SEC = 84.08
 
@@ -190,6 +233,182 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
     return tps, flops_tok, float(np.asarray(losses)[-1])
 
 
+def bert_train_flops_per_token(n_layer, d_model, d_ff, seq_len, vocab):
+    """Analytic matmul FLOPs per token, encoder-only + MLM head (2 FLOPs
+    per MAC, train = 3x fwd)."""
+    attn = 4 * d_model * d_model + 2 * seq_len * d_model
+    fwd_macs = n_layer * (attn + 2 * d_model * d_ff) + d_model * vocab
+    return 3 * 2 * fwd_macs
+
+
+def bench_bert(batch_size=32, seq_len=128, scan_steps=8, calls=4, warmup=1,
+               amp=True, tiny=False, use_flash=True):
+    """BERT-base MLM pretraining step (BASELINE.md workload 4: the
+    layer_norm/gelu/fused-attention path)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert as B
+
+    cfg = dict(n_layer=2, n_head=4, d_model=128, d_ff=512,
+               vocab=1000) if tiny else dict(
+        n_layer=12, n_head=12, d_model=768, d_ff=3072, vocab=30522)
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        avg_loss, _ = B.build_pretrain_net(
+            vocab_size=cfg["vocab"], seq_len=seq_len, n_layer=cfg["n_layer"],
+            n_head=cfg["n_head"], d_model=cfg["d_model"], d_ff=cfg["d_ff"],
+            dropout_rate=0.1, use_flash=use_flash)
+    if amp:
+        pt.amp.enable(prog)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+
+    batches = [B.make_batch(batch_size, seq_len, cfg["vocab"],
+                            rng=np.random.RandomState(s))
+               for s in range(scan_steps)]
+    feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+
+    for _ in range(warmup):
+        exe.run_steps(prog, feed=feed, fetch_list=[avg_loss], scope=scope)
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        (losses,) = exe.run_steps(prog, feed=feed, fetch_list=[avg_loss],
+                                  scope=scope)
+    dt = time.perf_counter() - t0
+    tps = batch_size * seq_len * scan_steps * calls / dt
+    flops_tok = bert_train_flops_per_token(
+        cfg["n_layer"], cfg["d_model"], cfg["d_ff"], seq_len, cfg["vocab"])
+    return tps, flops_tok, float(np.asarray(losses)[-1])
+
+
+def bench_deepfm(batch_size=4096, scan_steps=8, calls=4, warmup=1,
+                 hash_dim=1000001, amp=False):
+    """DeepFM CTR step (BASELINE.md workload 5: sparse lookup_table).
+    hash_dim defaults to the reference dist_ctr_reader.py scale (1e6+1).
+    MFU is not meaningful for a sparse-dominated workload; reports
+    examples/sec."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import deepfm as D
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        avg_cost, _, _, _ = D.build_train_net(hash_dim=hash_dim)
+    if amp:
+        pt.amp.enable(prog)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+
+    batches = [D.make_batch(batch_size, hash_dim=hash_dim,
+                            rng=np.random.RandomState(s))
+               for s in range(scan_steps)]
+    feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+
+    for _ in range(warmup):
+        exe.run_steps(prog, feed=feed, fetch_list=[avg_cost], scope=scope)
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        (losses,) = exe.run_steps(prog, feed=feed, fetch_list=[avg_cost],
+                                  scope=scope)
+    dt = time.perf_counter() - t0
+    eps = batch_size * scan_steps * calls / dt
+    return eps, float(np.asarray(losses)[-1])
+
+
+def bench_mnist(batch_size=512, scan_steps=16, calls=2, warmup=1, amp=True):
+    """LeNet-5 MNIST train step (BASELINE.md workload 1) — smoke-scale."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import mnist as M
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        img, label, avg_cost, acc, _ = M.build_train_net()
+        pt.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    if amp:
+        pt.amp.enable(prog)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "pixel": rng.rand(scan_steps, batch_size, 1, 28, 28).astype("float32"),
+        "label": rng.randint(0, 10, (scan_steps, batch_size, 1)).astype("int64"),
+    }
+    for _ in range(warmup):
+        exe.run_steps(prog, feed=feed, fetch_list=[avg_cost], scope=scope)
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        (losses,) = exe.run_steps(prog, feed=feed, fetch_list=[avg_cost],
+                                  scope=scope)
+    dt = time.perf_counter() - t0
+    ips = batch_size * scan_steps * calls / dt
+    return ips, float(np.asarray(losses)[-1])
+
+
+def run_bert(args, peak):
+    bs = args.batch_size or (4 if args.smoke else 32)
+    seq = 64 if args.smoke else 128
+    tps, flops_tok, loss = bench_bert(
+        batch_size=bs, seq_len=seq,
+        scan_steps=args.scan_steps or (2 if args.smoke else 16),
+        calls=args.calls or (1 if args.smoke else 2),
+        amp=args.amp, tiny=args.smoke)
+    mfu = (tps * flops_tok / peak) if peak else None
+    print(json.dumps({
+        "metric": "bert_base_train_tokens_per_sec_per_chip",
+        "value": round(tps, 2),
+        "unit": "tokens/sec",
+        # no committed reference BERT number: ratio to the BASELINE.json
+        # north star (50% MFU on this chip)
+        "vs_baseline": round(mfu / 0.50, 3) if mfu is not None else 0.0,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "loss": round(loss, 4),
+        "config": {"bf16": args.amp, "batch": bs, "seq_len": seq,
+                   "tiny": args.smoke},
+    }), flush=True)
+
+
+def run_deepfm(args, peak):
+    bs = args.batch_size or (64 if args.smoke else 4096)
+    hash_dim = 10001 if args.smoke else 1000001
+    eps, loss = bench_deepfm(
+        batch_size=bs,
+        scan_steps=args.scan_steps or (2 if args.smoke else 8),
+        calls=args.calls or (1 if args.smoke else 2),
+        hash_dim=hash_dim)
+    print(json.dumps({
+        "metric": "deepfm_ctr_train_examples_per_sec_per_chip",
+        "value": round(eps, 2),
+        "unit": "examples/sec",
+        # the reference commits no CTR throughput number
+        # (dist_ctr.py is a correctness test); no ratio is defined
+        "vs_baseline": 0.0,
+        "mfu": None,
+        "loss": round(loss, 4),
+        "config": {"batch": bs, "hash_dim": hash_dim, "sparse": True},
+    }), flush=True)
+
+
+def run_mnist(args, peak):
+    bs = args.batch_size or (64 if args.smoke else 512)
+    ips, loss = bench_mnist(
+        batch_size=bs,
+        scan_steps=args.scan_steps or (2 if args.smoke else 16),
+        calls=args.calls or (1 if args.smoke else 2),
+        amp=args.amp)
+    print(json.dumps({
+        "metric": "mnist_lenet5_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        # the reference commits no MNIST throughput number
+        "vs_baseline": 0.0,
+        "mfu": None,
+        "loss": round(loss, 4),
+        "config": {"bf16": args.amp, "batch": bs},
+    }), flush=True)
+
+
 def run_resnet50(args, peak):
         if args.smoke:
             bs = args.batch_size or 8
@@ -218,7 +437,7 @@ def run_resnet50(args, peak):
             "mfu": round(mfu, 4) if mfu is not None else None,
             "loss": round(loss, 4),
             "config": config,
-        }))
+        }), flush=True)
 
 
 def run_transformer(args, peak):
@@ -242,13 +461,14 @@ def run_transformer(args, peak):
             "loss": round(loss, 4),
             "config": {"bf16": args.amp, "batch": bs, "seq_len": seq,
                        "tiny": args.smoke},
-        }))
+        }), flush=True)
 
 
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="all",
-                   choices=["all", "resnet50", "transformer"])
+                   choices=["all", "resnet50", "transformer", "bert",
+                            "deepfm", "mnist"])
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for a fast correctness pass")
     p.add_argument("--no-amp", dest="amp", action="store_false")
@@ -266,13 +486,33 @@ def main():
     args = p.parse_args()
 
     peak = _peak_flops()
-    # Default run prints both metric lines; the driver parses the LAST line,
-    # so resnet50 (the metric tracked since round 1) stays last.
+    # Default run prints one metric line per workload, each emitted the
+    # moment it is measured (a crash in one workload cannot zero the rest).
+    # The driver parses the LAST line, so resnet50 (the metric tracked
+    # since round 1) stays last.
+    ran = []
+    if args.model in ("all", "mnist"):
+        ran.append(run_guarded("mnist", run_mnist, args, peak))
+    if args.model in ("all", "deepfm"):
+        ran.append(run_guarded("deepfm", run_deepfm, args, peak))
+    if args.model in ("all", "bert"):
+        ran.append(run_guarded("bert", run_bert, args, peak))
     if args.model in ("all", "transformer"):
-        run_transformer(args, peak)
+        ran.append(run_guarded("transformer", run_transformer, args, peak))
     if args.model in ("all", "resnet50"):
-        run_resnet50(args, peak)
-    return 0
+        ok = run_guarded("resnet50", run_resnet50, args, peak)
+        if not ok:
+            # the driver records the LAST line as the round-tracked
+            # resnet50 metric: on failure emit an explicit null line so a
+            # different workload's number is never mis-attributed to it
+            print(json.dumps({
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": None, "unit": "images/sec", "vs_baseline": 0.0,
+                "error": "workload failed after retries (see stderr)",
+            }), flush=True)
+        ran.append(ok)
+    # exit 0 if ANY workload produced a number
+    return 0 if any(ran) else 1
 
 
 if __name__ == "__main__":

@@ -356,11 +356,14 @@ class _CompiledEntry:
     """
 
     __slots__ = ("fn", "rw_state", "ro_state", "state_writes", "needs_key",
-                 "nan_check_ops")
+                 "nan_check_ops", "jitted")
 
     def __init__(self, fn, rw_state, ro_state, state_writes, needs_key,
-                 nan_check_ops=None):
+                 nan_check_ops=None, jitted=None):
         self.fn = fn
+        # the underlying jax.jit-wrapped callable, for AOT introspection
+        # (profiler tooling lowers it to optimized HLO)
+        self.jitted = jitted
         self.rw_state = rw_state
         self.ro_state = ro_state
         self.state_writes = state_writes
@@ -658,6 +661,7 @@ class Executor:
             lambda f, rw, ro, key: jitted(f, rw, ro, key),
             rw_state, ro_state, state_writes, True,
             nan_check_ops=nan_check_ops if check else None,
+            jitted=jitted,
         )
 
     # -- internals -------------------------------------------------------

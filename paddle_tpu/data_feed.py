@@ -26,10 +26,11 @@ import numpy as np
 
 
 class Slot:
-    __slots__ = ("name", "type", "is_dense", "is_used", "dim", "max_len")
+    __slots__ = ("name", "type", "is_dense", "is_used", "dim", "max_len",
+                 "id_space", "_warned")
 
     def __init__(self, name, type="uint64", is_dense=False, is_used=True,
-                 dim=1, max_len=64):
+                 dim=1, max_len=64, id_space=None):
         if type not in ("uint64", "float"):
             raise ValueError(f"slot type must be uint64|float, got {type!r}")
         self.name = name
@@ -38,6 +39,17 @@ class Slot:
         self.is_used = is_used
         self.dim = dim          # dense: values per instance
         self.max_len = max_len  # sparse: pad/truncate length
+        # sparse: SET THIS TO THE EMBEDDING TABLE SIZE.  uint64 wire ids
+        # are reduced mod id_space ON THE HOST (with jax x64 off, device
+        # transfer would silently truncate uint64 -> uint32, corrupting
+        # ids >= 2^32).  lookup_table CLAMPS out-of-range ids to the last
+        # row (jnp.take mode="clip") rather than wrapping, so ids must
+        # arrive already in-range — id_space is the mechanism.  None ->
+        # 2^31-1 (int32-transfer-safe only; a one-time warning fires if
+        # ids actually needed reducing, since clamp-collapse at the
+        # lookup is then likely).
+        self.id_space = id_space
+        self._warned = False
 
 
 class DataFeedDesc:
@@ -112,15 +124,30 @@ class MultiSlotDataFeed:
                     arr[i, :min(len(c), slot.dim)] = c[:slot.dim]
                 feed[slot.name] = arr
             else:
-                # padded ids + length vector (dense LoD replacement);
-                # uint64 batch so upper-range hashed ids survive (embedding
-                # tables index mod table-size anyway)
-                arr = np.zeros((len(cols), slot.max_len), "uint64")
+                # padded ids + length vector (dense LoD replacement).
+                # Reduce the uint64 wire ids into the table's id space on
+                # the HOST: with x64 disabled the device transfer would
+                # downcast uint64 -> uint32, silently truncating hashed
+                # ids >= 2^32 (round-3 advisor finding).
+                space = np.uint64(slot.id_space or 0x7FFFFFFF)
+                arr = np.zeros((len(cols), slot.max_len), "int64")
                 lens = np.zeros((len(cols),), "int64")
+                reduced = False
                 for i, c in enumerate(cols):
                     k = min(len(c), slot.max_len)
-                    arr[i, :k] = c[:k]
+                    reduced = reduced or bool((c[:k] >= space).any())
+                    arr[i, :k] = (c[:k] % space).astype("int64")
                     lens[i] = k
+                if reduced and slot.id_space is None and not slot._warned:
+                    import warnings
+
+                    warnings.warn(
+                        f"MultiSlot slot {slot.name!r}: ids exceeded the "
+                        "default id_space (2^31-1) and were reduced mod "
+                        "it; lookup_table CLAMPS out-of-range ids, so set "
+                        "Slot(id_space=<embedding table size>) to get "
+                        "well-distributed in-range ids.")
+                    slot._warned = True
                 feed[slot.name] = arr
                 feed[slot.name + "__len"] = lens
         return feed

@@ -48,7 +48,7 @@ class EagerSession:
         import jax
 
         self.values: Dict[str, object] = {}
-        self.tape: List[tuple] = []  # (opdef, op, ctx, in_names)
+        self.tape: List[tuple] = []  # (opdef, op, ctx, input-value snapshot)
         self.grads: Dict[str, object] = {}
         self.is_test = False
         self.mesh = None
@@ -94,7 +94,10 @@ def _run_op(session: EagerSession, block, op):
             if n and i < len(vals):
                 session.values[n] = vals[i]
     if not opdef.no_grad:
-        session.tape.append((opdef, op, ctx))
+        # snapshot the input VALUES at forward time: ops that write back to
+        # an input name (batch_norm running stats) or any later name reuse
+        # must not change what the backward vjp re-executes against
+        session.tape.append((opdef, op, ctx, ins))
 
 
 def _eager_hook(block, op):
@@ -161,7 +164,7 @@ def backward(loss_var):
     session.grads = {loss_var.name: jnp.ones_like(loss_val)}
     grads = session.grads
 
-    for opdef, op, ctx in reversed(session.tape):
+    for opdef, op, ctx, in_struct in reversed(session.tape):
         out_slots = {
             slot: [n for n in names]
             for slot, names in op.outputs.items()
@@ -171,10 +174,6 @@ def backward(loss_var):
             n in grads for names in out_slots.values() for n in names if n
         ):
             continue
-        in_struct = {
-            slot: [session.values.get(n) if n else None for n in names]
-            for slot, names in op.inputs.items()
-        }
 
         def fwd(diff_ins):
             merged = {

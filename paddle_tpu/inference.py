@@ -36,8 +36,13 @@ def _fold_bn_into(block, scope, idx, bn_op, prod_op) -> bool:
     """Fold `bn_op` (at op index `idx`) into its producer conv2d/mul.
     Returns True on success; mutates program + scope."""
     if prod_op.type == "conv2d":
+        # the BN must normalize the conv's channel axis: its data_layout
+        # has to agree with the conv's data_format
+        if (bn_op.attr("data_layout", "NCHW")
+                != prod_op.attr("data_format", "NCHW")):
+            return False
         w_name = prod_op.input("Filter")[0]
-        out_axis = 0  # OIHW
+        out_axis = 0  # filter is OIHW for either data_format
     elif prod_op.type == "mul":
         w_name = prod_op.input("Y")[0]
         out_axis = 1  # [in, out]
@@ -76,8 +81,12 @@ def _fold_bn_into(block, scope, idx, bn_op, prod_op) -> bool:
     y_name = bn_op.output("Y")[0]
     x_name = bn_op.input("X")[0]
     block.remove_op(idx)
-    # channel axis: conv2d output is NCHW -> axis 1; mul output [.., C] -> -1
-    axis = 1 if prod_op.type == "conv2d" else -1
+    # channel axis of the producer's output: conv2d NCHW -> 1, NHWC -> -1;
+    # mul output [.., C] -> -1
+    if prod_op.type == "conv2d":
+        axis = -1 if prod_op.attr("data_format", "NCHW") == "NHWC" else 1
+    else:
+        axis = -1
     block.insert_op(
         idx,
         "elementwise_add",

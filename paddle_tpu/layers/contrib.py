@@ -6,8 +6,12 @@ from ..layer_helper import LayerHelper
 
 
 def fused_attention(q, k, v, bias=None, scale=1.0, causal=False,
-                    dropout_rate=0.0, block_q=512, block_k=512, name=None):
-    """Flash-attention layer over [B,H,T,D] tensors (Pallas kernel on TPU).
+                    dropout_rate=0.0, block_q=512, block_k=512,
+                    fmt="bhtd", name=None):
+    """Flash-attention layer (Pallas kernel on TPU) over [B,H,T,D] tensors
+    (fmt="bhtd") or [B,T,H,D] tensors (fmt="bthd" — the transpose-free
+    convention: reshape the projection output [B,T,H*D] to [B,T,H,D] and
+    skip split/merge-head transposes entirely).
 
     NOTE: with dropout_rate > 0 this applies dropout to the attention
     *output* (flash-style), not to the attention weights like the unfused
@@ -27,6 +31,7 @@ def fused_attention(q, k, v, bias=None, scale=1.0, causal=False,
             "causal": causal,
             "block_q": block_q,
             "block_k": block_k,
+            "fmt": fmt,
         },
     )
     out.shape = q.shape

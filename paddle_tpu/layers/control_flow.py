@@ -264,8 +264,6 @@ class IfElse:
         self._outputs[self._in_branch].extend(outs)
 
     def __call__(self):
-        from . import tensor as T
-
         t_outs = self._outputs[True]
         f_outs = self._outputs[False]
         if len(t_outs) != len(f_outs):
@@ -273,15 +271,19 @@ class IfElse:
                 f"IfElse: true block registered {len(t_outs)} outputs, "
                 f"false block {len(f_outs)}")
         helper = self.helper
-        cond_f = T.cast(self.cond, "float32")
         merged = []
         for tv, fv in zip(t_outs, f_outs):
-            # out = cond * true + (1 - cond) * false ([b,1] broadcasts)
-            not_cond = T.elementwise_sub(
-                T.fill_constant([1], "float32", 1.0), cond_f)
-            a = T.elementwise_mul(tv, cond_f)
-            b = T.elementwise_mul(fv, not_cond)
-            merged.append(T.elementwise_add(a, b))
+            # per-row select keyed on the bool cond ([b,1] broadcasts):
+            # unlike the arithmetic cond*t + (1-cond)*f merge, a select
+            # keeps integer dtypes and blocks NaN/Inf leaking from the
+            # untaken branch (both branches run densely on the full batch)
+            out = helper.create_variable_for_type_inference(dtype=tv.dtype)
+            helper.append_op(
+                "where",
+                inputs={"Condition": [self.cond], "X": [tv], "Y": [fv]},
+                outputs={"Out": [out]},
+            )
+            merged.append(out)
         return merged
 
 

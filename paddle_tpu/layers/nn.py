@@ -580,6 +580,20 @@ def cos_sim(X, Y, name=None):
     return out
 
 
+def where(condition, x, y, name=None):
+    """Ternary select: out = condition ? x : y, with broadcasting on
+    condition (TPU-native addition — modern paddle.where semantics; used
+    internally by IfElse's merge).  Differentiable in x/y."""
+    helper = LayerHelper("where", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "where",
+        inputs={"Condition": [condition], "X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
 def multiplex(inputs, index, name=None):
     helper = LayerHelper("multiplex", name=name)
     out = helper.create_variable_for_type_inference(inputs[0].dtype)

@@ -9,7 +9,9 @@ from ..core.registry import register
 
 @register("fused_attention")
 def lower_fused_attention(ctx, ins):
-    """Flash attention over [B,H,T,D] q/k/v with optional additive bias.
+    """Flash attention over [B,H,T,D] (fmt "bhtd") or [B,T,H,D] (fmt
+    "bthd") q/k/v with optional additive bias.  "bthd" is the
+    transpose-free convention — see kernels/attention.py.
 
     No dropout inside the op: attention-weight dropout is not expressible in
     the streaming kernel, and in-op randomness would break the generic vjp
@@ -25,6 +27,7 @@ def lower_fused_attention(ctx, ins):
         causal=ctx.attr("causal", False),
         block_q=ctx.attr("block_q", 512),
         block_k=ctx.attr("block_k", 512),
+        fmt=ctx.attr("fmt", "bhtd"),
     )
     return {"Out": [out]}
 

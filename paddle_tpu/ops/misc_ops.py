@@ -277,6 +277,19 @@ def lower_multiplex(ctx, ins):
     return {"Out": [xs[ids, rows]]}
 
 
+@register("where", infer_shape=_same_infer())
+def lower_where(ctx, ins):
+    """Ternary select Out = Condition ? X : Y (modern paddle.where
+    semantics — a TPU-native addition used by IfElse's merge so the
+    untaken branch cannot poison the output via 0*NaN and integer
+    outputs keep their dtype).  Condition broadcasts against X/Y.
+    Differentiable in X/Y via the vjp grad maker (grad w.r.t. the
+    boolean Condition is zero/undefined, as in the reference)."""
+    jnp = _jnp()
+    cond = ins["Condition"][0].astype(bool)
+    return {"Out": [jnp.where(cond, ins["X"][0], ins["Y"][0])]}
+
+
 @register("affine_channel", infer_shape=_same_infer())
 def lower_affine_channel(ctx, ins):
     """reference detection/affine_channel_op.cc: x*scale+bias per channel."""

@@ -87,6 +87,46 @@ def test_bn_fold_preserves_outputs(tmp_path):
                                rtol=1e-4, atol=1e-4)
 
 
+def test_bn_fold_nhwc_conv(tmp_path):
+    """NHWC conv + NHWC batch_norm must fold with the bias on the last
+    axis (round-3 advisor finding: the fold hardcoded axis=1)."""
+    img = layers.data(name="img", shape=[6, 6, 3], dtype="float32")
+    conv = layers.conv2d(img, num_filters=5, filter_size=3, padding=1,
+                         bias_attr=False, data_format="NHWC")
+    bn = layers.batch_norm(conv, data_layout="NHWC")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    # non-trivial BN stats so the fold actually changes W/bias
+    scope = pt.global_scope()
+    scope.set_var("batch_norm_0.w_0_mean",
+                  rng.randn(5).astype("float32") * 0.1)
+    scope.set_var("batch_norm_0.w_0_variance",
+                  (1 + rng.rand(5)).astype("float32"))
+
+    prog = pt.default_main_program().clone(for_test=True)
+    feed = {"img": rng.randn(4, 6, 6, 3).astype("float32")}
+    (ref,) = exe.run(prog, feed=feed, fetch_list=[bn])
+
+    n = inference_transpile(prog, scope)
+    assert n == 1
+    assert "batch_norm" not in [op.type for op in prog.global_block().ops]
+    (out,) = exe.run(prog, feed=feed, fetch_list=[bn])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bn_fold_skips_layout_mismatch(tmp_path):
+    """NHWC conv feeding an NCHW-labeled BN must not fold."""
+    img = layers.data(name="img", shape=[4, 4, 2], dtype="float32")
+    conv = layers.conv2d(img, num_filters=2, filter_size=3, padding=1,
+                         bias_attr=False, data_format="NHWC")
+    layers.batch_norm(conv)  # default data_layout NCHW: mismatched
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    prog = pt.default_main_program().clone(for_test=True)
+    assert inference_transpile(prog, pt.global_scope()) == 0
+
+
 def test_bn_fold_skips_shared_conv_output(tmp_path):
     """A conv output consumed by BN *and* something else must not fold."""
     img = layers.data(name="img", shape=[1, 4, 4], dtype="float32")

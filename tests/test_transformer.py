@@ -124,6 +124,75 @@ def test_flash_attention_grads_match_reference(name, tq, tk, bias_shape,
             err_msg=name)
 
 
+@pytest.mark.parametrize(
+    "name,tq,tk,bias_shape,causal",
+    [
+        ("plain", 128, 128, None, False),
+        ("causal", 128, 128, None, True),
+        ("full_bias", 128, 128, (2, 2, 128, 128), False),
+        ("pad_mask_bias", 128, 128, (2, 1, 1, 128), False),
+        ("cross", 64, 128, None, False),
+    ],
+)
+def test_flash_attention_bthd_format(name, tq, tk, bias_shape, causal):
+    """The transpose-free [B,T,H,D] calling convention must match the
+    [B,H,T,D] reference in outputs AND gradients (it is the layout the
+    bench transformer runs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.attention import (
+        flash_attention,
+        reference_attention,
+    )
+
+    d = 64
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 2, tq, d).astype("float32"))
+    k = jnp.asarray(rng.randn(2, 2, tk, d).astype("float32"))
+    v = jnp.asarray(rng.randn(2, 2, tk, d).astype("float32"))
+    args = (q, k, v)
+    if bias_shape is not None:
+        args = args + (jnp.asarray(
+            0.3 * rng.randn(*bias_shape).astype("float32")),)
+    scale = 1.0 / np.sqrt(d)
+
+    def loss_ref(*a):
+        bias = a[3] if len(a) > 3 else None
+        out = reference_attention(a[0], a[1], a[2], bias, scale=scale,
+                                  causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_bthd(*a):
+        bias = a[3] if len(a) > 3 else None
+        out = flash_attention(
+            a[0].transpose(0, 2, 1, 3), a[1].transpose(0, 2, 1, 3),
+            a[2].transpose(0, 2, 1, 3), bias, scale=scale, causal=causal,
+            block_q=64, block_k=64, fmt="bthd")
+        return jnp.sum(out * jnp.cos(out))
+
+    argnums = tuple(range(len(args)))
+    with jax.default_matmul_precision("highest"):
+        out_b = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            args[3] if len(args) > 3 else None,
+            scale=scale, causal=causal, block_q=64, block_k=64, fmt="bthd")
+        out_r = reference_attention(q, k, v,
+                                    args[3] if len(args) > 3 else None,
+                                    scale=scale, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out_b.transpose(0, 2, 1, 3)), np.asarray(out_r),
+            atol=1e-5, err_msg=name)
+        grads_b = jax.grad(loss_bthd, argnums)(*args)
+        grads_r = jax.grad(loss_ref, argnums)(*args)
+    for gb, gr in zip(grads_b, grads_r):
+        assert np.all(np.isfinite(np.asarray(gb))), name
+        np.testing.assert_allclose(
+            np.asarray(gb), np.asarray(gr), atol=2e-4, rtol=1e-3,
+            err_msg=name)
+
+
 def test_fused_attention_layer_in_program():
     from paddle_tpu import layers
 

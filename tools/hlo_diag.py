@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Dump + analyze the optimized HLO of a bench workload's compiled scan
+step: counts copy/transpose/custom-call instructions by shape and locates
+them relative to the flash-attention custom-calls.  Perf tooling for
+PERF.md leads 1-2 (attention layout copies, scan-carry copies).
+
+Usage: python tools/hlo_diag.py [transformer|resnet50|bert] [out.txt]
+"""
+
+import os
+import re
+import sys
+import collections
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def compile_transformer(scan_steps=8, batch_size=64, seq_len=256,
+                        use_flash=True):
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as T
+
+    cfg = dict(n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+               d_inner_hid=2048, vocab=32000)
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        avg_cost, _, feeds = T.transformer(
+            src_vocab_size=cfg["vocab"], trg_vocab_size=cfg["vocab"],
+            max_length=seq_len, n_layer=cfg["n_layer"], n_head=cfg["n_head"],
+            d_key=cfg["d_key"], d_value=cfg["d_value"], d_model=cfg["d_model"],
+            d_inner_hid=cfg["d_inner_hid"], dropout_rate=0.1,
+            src_seq_len=seq_len, trg_seq_len=seq_len, use_flash=use_flash,
+        )
+        pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    pt.amp.enable(prog)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    batches = [
+        T.make_batch(batch_size, seq_len, seq_len, cfg["n_head"],
+                     cfg["vocab"], cfg["vocab"], rng=np.random.RandomState(s))
+        for s in range(scan_steps)
+    ]
+    feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+    return exe, prog, feed, [avg_cost], scope
+
+
+def lower_entry(exe, prog, feed, fetch_list, scope):
+    """Compile via run_steps (populates the cache), then AOT-lower the
+    cached jitted fn on the same args to get optimized HLO text."""
+    exe.run_steps(prog, feed=feed, fetch_list=fetch_list, scope=scope)
+    (entry,) = [e for e in exe._cache.values() if e.jitted is not None]
+    rw = [scope.find_var(n) for n in entry.rw_state]
+    ro = [scope.find_var(n) for n in entry.ro_state]
+    import jax
+
+    feed_names = sorted(feed)
+    feed_vals = [exe._to_device_array(prog, n, feed[n]) for n in feed_names]
+    key = jax.random.PRNGKey(0)
+    lowered = entry.jitted.lower(feed_vals, rw, ro, key)
+    return lowered.compile().as_text()
+
+
+INSTR_RE = re.compile(
+    r"%?([\w.-]+) = ([a-z0-9]+)\[([\d,]*)\](\S*) ([\w-]+)\(")
+DT_BYTES = {"bf16": 2, "f32": 4, "s32": 4, "u32": 4, "pred": 1,
+            "f16": 2, "s8": 1, "u8": 1, "u64": 8, "s64": 8}
+
+
+def analyze(txt):
+    lines = txt.splitlines()
+    copies = collections.Counter()
+    copy_bytes = collections.Counter()
+    copy_src = collections.Counter()
+    custom_calls = collections.Counter()
+    transposes = collections.Counter()
+    for ln in lines:
+        s = ln.strip()
+        m = INSTR_RE.match(s)
+        if not m:
+            continue
+        name, dt, dims, layout, opcode = m.groups()
+        shape = f"{dt}[{dims}]{layout or ''}"
+        nbytes = DT_BYTES.get(dt, 4) * int(
+            np.prod([int(x) for x in dims.split(",") if x] or [1]))
+        if opcode == "copy":
+            copies[shape] += 1
+            copy_bytes[shape] += nbytes
+            sm = re.search(r'op_name="([^"]+)"', s)
+            srcm = re.search(r'source_file="[^"]*/([\w.]+)" source_line=(\d+)',
+                             s)
+            label = (sm.group(1).split("/")[-1] if sm else "?")
+            src = f"{srcm.group(1)}:{srcm.group(2)}" if srcm else "?"
+            copy_src[(label, src)] += nbytes
+        elif opcode == "transpose":
+            transposes[shape] += 1
+        elif opcode == "custom-call":
+            cm = re.search(r'custom_call_target="([^"]+)"', s)
+            custom_calls[(cm.group(1) if cm else "?", shape)] += 1
+    out = []
+    out.append("== copy instructions (count x shape, total MB) ==")
+    for shape, n in copies.most_common(30):
+        out.append(f"  {n:4d} x {shape}  ({copy_bytes[shape] / 1e6:.1f} MB)")
+    out.append(f"  TOTAL copies: {sum(copies.values())} "
+               f"({sum(copy_bytes.values()) / 1e6:.1f} MB static)")
+    out.append("== copy bytes by op_name/source ==")
+    for (label, src), b in copy_src.most_common(25):
+        out.append(f"  {b / 1e6:8.1f} MB  {label}  {src}")
+    out.append("== transpose instructions ==")
+    for shape, n in transposes.most_common(15):
+        out.append(f"  {n:4d} x {shape}")
+    out.append(f"  TOTAL transposes: {sum(transposes.values())}")
+    out.append("== custom calls ==")
+    for (tgt, shape), n in custom_calls.most_common(20):
+        out.append(f"  {n:4d} x {tgt} -> {shape}")
+    return "\n".join(out)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "transformer"
+    out_path = sys.argv[2] if len(sys.argv) > 2 else f"/tmp/hlo_{which}.txt"
+    if which == "transformer":
+        args = compile_transformer()
+    elif which == "transformer_noflash":
+        args = compile_transformer(use_flash=False)
+    else:
+        raise SystemExit(f"unknown workload {which}")
+    txt = lower_entry(*args)
+    with open(out_path, "w") as f:
+        f.write(txt)
+    print(f"[hlo_diag] optimized HLO -> {out_path} ({len(txt)} bytes)")
+    print(analyze(txt))
+
+
+if __name__ == "__main__":
+    main()
