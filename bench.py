@@ -69,6 +69,33 @@ def run_guarded(name, fn, *args, retries=2):
             time.sleep(5.0 * (attempt + 1))
     return False
 
+def timed_steps(exe, prog, feed, fetch, scope, warmup, calls):
+    """Shared warmup + timing loop: returns (seconds, last_loss)."""
+    for _ in range(warmup):
+        exe.run_steps(prog, feed=feed, fetch_list=fetch, scope=scope)
+    t0 = time.perf_counter()
+    losses = None
+    for _ in range(calls):
+        (losses,) = exe.run_steps(prog, feed=feed, fetch_list=fetch,
+                                  scope=scope)
+    dt = time.perf_counter() - t0
+    return dt, float(np.asarray(losses)[-1])
+
+
+def emit_metric(metric, value, unit, vs_baseline, mfu, loss, config):
+    """The ONE-json-line contract; printed the moment a workload finishes
+    so a later workload's crash can never zero this one."""
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(vs_baseline, 3) if vs_baseline is not None else 0.0,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "loss": round(loss, 4),
+        "config": config,
+    }), flush=True)
+
+
 REFERENCE_RESNET50_IMGS_PER_SEC = 84.08
 
 # ResNet-50 @224: 4.089 GMACs forward (standard torchvision/paper count,
@@ -218,19 +245,13 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
     ]
     feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
 
-    for _ in range(warmup):
-        exe.run_steps(prog, feed=feed, fetch_list=[avg_cost], scope=scope)
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        (losses,) = exe.run_steps(prog, feed=feed, fetch_list=[avg_cost],
-                                  scope=scope)
-    dt = time.perf_counter() - t0
+    dt, last_loss = timed_steps(exe, prog, feed, [avg_cost], scope, warmup, calls)
     # tokens counted on the decoded (trg) stream, the convention for MT
     tps = batch_size * seq_len * scan_steps * calls / dt
     flops_tok = transformer_train_flops_per_token(
         cfg["n_layer"], cfg["d_model"], cfg["d_inner_hid"], cfg["n_head"],
         cfg["d_key"], seq_len, cfg["vocab"])
-    return tps, flops_tok, float(np.asarray(losses)[-1])
+    return tps, flops_tok, last_loss
 
 
 def bert_train_flops_per_token(n_layer, d_model, d_ff, seq_len, vocab):
@@ -268,17 +289,11 @@ def bench_bert(batch_size=32, seq_len=128, scan_steps=8, calls=4, warmup=1,
                for s in range(scan_steps)]
     feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
 
-    for _ in range(warmup):
-        exe.run_steps(prog, feed=feed, fetch_list=[avg_loss], scope=scope)
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        (losses,) = exe.run_steps(prog, feed=feed, fetch_list=[avg_loss],
-                                  scope=scope)
-    dt = time.perf_counter() - t0
+    dt, last_loss = timed_steps(exe, prog, feed, [avg_loss], scope, warmup, calls)
     tps = batch_size * seq_len * scan_steps * calls / dt
     flops_tok = bert_train_flops_per_token(
         cfg["n_layer"], cfg["d_model"], cfg["d_ff"], seq_len, cfg["vocab"])
-    return tps, flops_tok, float(np.asarray(losses)[-1])
+    return tps, flops_tok, last_loss
 
 
 def bench_deepfm(batch_size=4096, scan_steps=8, calls=4, warmup=1,
@@ -304,15 +319,9 @@ def bench_deepfm(batch_size=4096, scan_steps=8, calls=4, warmup=1,
                for s in range(scan_steps)]
     feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
 
-    for _ in range(warmup):
-        exe.run_steps(prog, feed=feed, fetch_list=[avg_cost], scope=scope)
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        (losses,) = exe.run_steps(prog, feed=feed, fetch_list=[avg_cost],
-                                  scope=scope)
-    dt = time.perf_counter() - t0
+    dt, last_loss = timed_steps(exe, prog, feed, [avg_cost], scope, warmup, calls)
     eps = batch_size * scan_steps * calls / dt
-    return eps, float(np.asarray(losses)[-1])
+    return eps, last_loss
 
 
 def bench_mnist(batch_size=512, scan_steps=16, calls=2, warmup=1, amp=True):
@@ -335,15 +344,9 @@ def bench_mnist(batch_size=512, scan_steps=16, calls=2, warmup=1, amp=True):
         "pixel": rng.rand(scan_steps, batch_size, 1, 28, 28).astype("float32"),
         "label": rng.randint(0, 10, (scan_steps, batch_size, 1)).astype("int64"),
     }
-    for _ in range(warmup):
-        exe.run_steps(prog, feed=feed, fetch_list=[avg_cost], scope=scope)
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        (losses,) = exe.run_steps(prog, feed=feed, fetch_list=[avg_cost],
-                                  scope=scope)
-    dt = time.perf_counter() - t0
+    dt, last_loss = timed_steps(exe, prog, feed, [avg_cost], scope, warmup, calls)
     ips = batch_size * scan_steps * calls / dt
-    return ips, float(np.asarray(losses)[-1])
+    return ips, last_loss
 
 
 def run_bert(args, peak):
@@ -355,18 +358,12 @@ def run_bert(args, peak):
         calls=args.calls or (1 if args.smoke else 2),
         amp=args.amp, tiny=args.smoke)
     mfu = (tps * flops_tok / peak) if peak else None
-    print(json.dumps({
-        "metric": "bert_base_train_tokens_per_sec_per_chip",
-        "value": round(tps, 2),
-        "unit": "tokens/sec",
-        # no committed reference BERT number: ratio to the BASELINE.json
-        # north star (50% MFU on this chip)
-        "vs_baseline": round(mfu / 0.50, 3) if mfu is not None else 0.0,
-        "mfu": round(mfu, 4) if mfu is not None else None,
-        "loss": round(loss, 4),
-        "config": {"bf16": args.amp, "batch": bs, "seq_len": seq,
-                   "tiny": args.smoke},
-    }), flush=True)
+    # no committed reference BERT number: vs_baseline is the ratio to the
+    # BASELINE.json north star (50% MFU on this chip)
+    emit_metric("bert_base_train_tokens_per_sec_per_chip", tps, "tokens/sec",
+                mfu / 0.50 if mfu is not None else None, mfu, loss,
+                {"bf16": args.amp, "batch": bs, "seq_len": seq,
+                 "tiny": args.smoke})
 
 
 def run_deepfm(args, peak):
@@ -377,17 +374,11 @@ def run_deepfm(args, peak):
         scan_steps=args.scan_steps or (2 if args.smoke else 8),
         calls=args.calls or (1 if args.smoke else 2),
         hash_dim=hash_dim)
-    print(json.dumps({
-        "metric": "deepfm_ctr_train_examples_per_sec_per_chip",
-        "value": round(eps, 2),
-        "unit": "examples/sec",
-        # the reference commits no CTR throughput number
-        # (dist_ctr.py is a correctness test); no ratio is defined
-        "vs_baseline": 0.0,
-        "mfu": None,
-        "loss": round(loss, 4),
-        "config": {"batch": bs, "hash_dim": hash_dim, "sparse": True},
-    }), flush=True)
+    # the reference commits no CTR throughput number (dist_ctr.py is a
+    # correctness test); no ratio is defined
+    emit_metric("deepfm_ctr_train_examples_per_sec_per_chip", eps,
+                "examples/sec", None, None, loss,
+                {"batch": bs, "hash_dim": hash_dim, "sparse": True})
 
 
 def run_mnist(args, peak):
@@ -397,16 +388,10 @@ def run_mnist(args, peak):
         scan_steps=args.scan_steps or (2 if args.smoke else 16),
         calls=args.calls or (1 if args.smoke else 2),
         amp=args.amp)
-    print(json.dumps({
-        "metric": "mnist_lenet5_train_images_per_sec_per_chip",
-        "value": round(ips, 2),
-        "unit": "images/sec",
-        # the reference commits no MNIST throughput number
-        "vs_baseline": 0.0,
-        "mfu": None,
-        "loss": round(loss, 4),
-        "config": {"bf16": args.amp, "batch": bs},
-    }), flush=True)
+    # the reference commits no MNIST throughput number
+    emit_metric("mnist_lenet5_train_images_per_sec_per_chip", ips,
+                "images/sec", None, None, loss,
+                {"bf16": args.amp, "batch": bs})
 
 
 def run_resnet50(args, peak):
@@ -429,15 +414,9 @@ def run_resnet50(args, peak):
             config = {"bf16": args.amp, "batch": bs, "image": 224,
                       "depth": 50, "stream": args.stream,
                       "data_format": args.data_format}
-        print(json.dumps({
-            "metric": "resnet50_train_images_per_sec_per_chip",
-            "value": round(ips, 2),
-            "unit": "images/sec",
-            "vs_baseline": round(ips / REFERENCE_RESNET50_IMGS_PER_SEC, 3),
-            "mfu": round(mfu, 4) if mfu is not None else None,
-            "loss": round(loss, 4),
-            "config": config,
-        }), flush=True)
+        emit_metric("resnet50_train_images_per_sec_per_chip", ips,
+                    "images/sec", ips / REFERENCE_RESNET50_IMGS_PER_SEC,
+                    mfu, loss, config)
 
 
 def run_transformer(args, peak):
@@ -450,18 +429,13 @@ def run_transformer(args, peak):
             amp=args.amp, tiny=args.smoke)
         # flops_tok matches the model actually run (tiny config in smoke)
         mfu = (tps * flops_tok / peak) if peak else None
-        print(json.dumps({
-            "metric": "transformer_base_train_tokens_per_sec_per_chip",
-            "value": round(tps, 2),
-            "unit": "tokens/sec",
-            # no committed reference transformer number exists: ratio to the
-            # BASELINE.json north star (50% MFU on this chip)
-            "vs_baseline": round(mfu / 0.50, 3) if mfu is not None else 0.0,
-            "mfu": round(mfu, 4) if mfu is not None else None,
-            "loss": round(loss, 4),
-            "config": {"bf16": args.amp, "batch": bs, "seq_len": seq,
-                       "tiny": args.smoke},
-        }), flush=True)
+        # no committed reference transformer number exists: vs_baseline is
+        # the ratio to the BASELINE.json north star (50% MFU on this chip)
+        emit_metric("transformer_base_train_tokens_per_sec_per_chip", tps,
+                    "tokens/sec", mfu / 0.50 if mfu is not None else None,
+                    mfu, loss,
+                    {"bf16": args.amp, "batch": bs, "seq_len": seq,
+                     "tiny": args.smoke})
 
 
 def main():

@@ -316,3 +316,66 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
         proj.shape = (input.shape[0], input.shape[1], proj_size)
         cell.shape = (input.shape[0], input.shape[1], d)
     return proj, cell
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """CTC loss with integrated softmax (reference layers/nn.py:4866,
+    operators/warpctc_op.cc). Padded idiom: input [B, T, C] raw logits,
+    label [B, L] int; optional per-example lengths. Returns [B, 1] loss."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference("float32")
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["Logits_length"] = [input_length]
+    if label_length is not None:
+        inputs["Label_length"] = [label_length]
+    helper.append_op(
+        "warpctc",
+        inputs=inputs,
+        outputs={"Loss": [loss]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    if input.shape:
+        loss.shape = (input.shape[0], 1)
+    return loss
+
+
+def ctc_align(input, blank=0, padding_value=0, length=None):
+    """Merge repeats then drop blanks (reference ctc_align_op.cc)."""
+    helper = LayerHelper("ctc_align")
+    out = helper.create_variable_for_type_inference("int32")
+    out_len = helper.create_variable_for_type_inference("int32")
+    inputs = {"Input": [input]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        "ctc_align",
+        inputs=inputs,
+        outputs={"Output": [out], "OutLength": [out_len]},
+        attrs={"blank": blank, "padding_value": padding_value},
+    )
+    if input.shape:
+        # the lowering squeezes a trailing [,1] dim: output is always [B, T]
+        out.shape = tuple(input.shape[:2])
+    return out, out_len
+
+
+def ctc_greedy_decoder(input, blank, padding_value=0, length=None, name=None):
+    """Greedy CTC decode: per-step argmax then collapse (reference
+    layers/nn.py:4783). input: [B, T, C] probabilities or logits."""
+    helper = LayerHelper("ctc_greedy_decoder")
+    out = helper.create_variable_for_type_inference("int32")
+    out_len = helper.create_variable_for_type_inference("int32")
+    inputs = {"Input": [input]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        "ctc_greedy_decoder",
+        inputs=inputs,
+        outputs={"Output": [out], "OutLength": [out_len]},
+        attrs={"blank": blank, "padding_value": padding_value},
+    )
+    if input.shape:
+        out.shape = tuple(input.shape[:2])
+    return out, out_len

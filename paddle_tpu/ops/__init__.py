@@ -15,4 +15,5 @@ from . import (  # noqa: F401
     misc_ops,
     quant_ops,
     detection_ops,
+    ctc_ops,
 )

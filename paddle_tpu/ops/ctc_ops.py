@@ -1,0 +1,168 @@
+"""CTC (Connectionist Temporal Classification) op family.
+
+Capability parity with the reference's warp-ctc integration
+(paddle/fluid/operators/warpctc_op.cc — external Baidu warp-ctc library) and
+ctc_align (paddle/fluid/operators/ctc_align_op.cc), rebuilt TPU-first:
+
+  * The loss is a log-space alpha (forward-variable) recursion expressed as ONE
+    `lax.scan` over time, vectorized over the batch and the extended-label axis
+    — static shapes, no host library, fully differentiable, so the backward
+    pass comes from `jax.vjp` via the registry's default grad maker instead of
+    warp-ctc's hand-written beta recursion.
+  * Ragged sequences use the repo-wide padded+Length idiom (SURVEY §5.7): the
+    reference's LoD inputs ([Lp, C] logits / [Lg, 1] labels) become
+    [B, T, C] logits + Logits_length and [B, L] labels + Label_length.
+  * ctc_align's compaction (merge repeats, drop blanks) is a masked
+    cumsum+scatter — a static-shape TPU formulation of the reference's
+    per-sequence CPU loop (ctc_align_op.h:41-77).
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _ctc_loss_padded(log_probs, labels, logit_lens, label_lens, blank):
+    """log_probs: [B, T, C] (log-softmaxed), labels: [B, L] int32,
+    logit_lens/label_lens: [B] int32. Returns per-example loss [B]."""
+    import jax
+    import jax.numpy as jnp
+
+    B, T, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+
+    # Extended label sequence: blank, l1, blank, l2, ..., blank  -> [B, S]
+    ext = jnp.full((B, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+
+    neg_inf = jnp.asarray(-1e30, dtype=log_probs.dtype)
+    s_idx = jnp.arange(S)[None, :]                       # [1, S]
+    valid_s = s_idx < (2 * label_lens[:, None] + 1)      # [B, S]
+
+    # Transition structure: alpha[s] can come from s, s-1, and s-2 when
+    # ext[s] != blank and ext[s] != ext[s-2] (the classic CTC skip rule).
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_m2)          # [B, S]
+
+    def emit(t):
+        # log P(ext[s] at time t) gathered per batch: [B, S]
+        return jnp.take_along_axis(log_probs[:, t, :], ext, axis=1)
+
+    # alpha_0: only s=0 (blank) and s=1 (first label) are reachable.
+    alpha0 = jnp.where(s_idx < 2, emit(0), neg_inf)
+    alpha0 = jnp.where(valid_s, alpha0, neg_inf)
+
+    def shift1(a):
+        return jnp.pad(a, ((0, 0), (1, 0)), constant_values=neg_inf)[:, :S]
+
+    def step(alpha, t):
+        a0 = alpha
+        a1 = shift1(alpha)
+        a2 = jnp.where(can_skip, shift1(shift1(alpha)), neg_inf)
+        stacked = jnp.stack([a0, a1, a2], axis=0)        # [3, B, S]
+        merged = jax.scipy.special.logsumexp(stacked, axis=0)
+        new = merged + emit(t)
+        new = jnp.where(valid_s, new, neg_inf)
+        # Frozen past each sequence's end so the final read sees alpha at len.
+        new = jnp.where((t < logit_lens)[:, None], new, alpha)
+        return new, None
+
+    alpha_T, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+
+    # Loss = -logsumexp(alpha[2*Llen], alpha[2*Llen - 1])
+    last = 2 * label_lens                                # [B] (blank slot)
+    a_last = jnp.take_along_axis(alpha_T, last[:, None], axis=1)[:, 0]
+    prev = jnp.maximum(last - 1, 0)
+    a_prev = jnp.take_along_axis(alpha_T, prev[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(label_lens > 0, a_prev, neg_inf)
+    total = jax.scipy.special.logsumexp(jnp.stack([a_last, a_prev]), axis=0)
+    return -total
+
+
+@register("warpctc")
+def lower_warpctc(ctx, ins):
+    """CTC loss with integrated softmax (reference warpctc_op.cc:1; layer
+    nn.py:4866). Logits: [B, T, C] raw scores; Label: [B, L] int.
+    Optional Logits_length / Label_length: [B] (default: full)."""
+    import jax.numpy as jnp
+
+    logits = ins["Logits"][0]
+    labels = ins["Label"][0]
+    if labels.ndim == 3:  # tolerate [B, L, 1]
+        labels = labels[..., 0]
+    B, T, C = logits.shape
+    L = labels.shape[1]
+    llen = ins.get("Logits_length", [None])[0]
+    tlen = ins.get("Label_length", [None])[0]
+    llen = (jnp.full((B,), T, jnp.int32) if llen is None
+            else llen.reshape(-1).astype(jnp.int32))
+    tlen = (jnp.full((B,), L, jnp.int32) if tlen is None
+            else tlen.reshape(-1).astype(jnp.int32))
+    blank = ctx.attr("blank", 0)
+    norm_by_times = ctx.attr("norm_by_times", False)
+
+    logp = logits.astype(jnp.float32)
+    logp = logp - jnp.max(logp, axis=-1, keepdims=True)
+    logp = logp - jnp.log(jnp.sum(jnp.exp(logp), axis=-1, keepdims=True))
+    loss = _ctc_loss_padded(logp, labels, llen, tlen, blank)
+    if norm_by_times:
+        loss = loss / jnp.maximum(llen.astype(loss.dtype), 1.0)
+    return {"Loss": [loss.reshape(B, 1)]}
+
+
+def _align_rows(tokens, lens, blank, pad_value):
+    """tokens: [B, T] int; merge adjacent repeats, drop blanks, left-compact.
+    Returns (aligned [B, T], out_lens [B])."""
+    import jax.numpy as jnp
+
+    B, T = tokens.shape
+    prev = jnp.pad(tokens, ((0, 0), (1, 0)), constant_values=-1)[:, :T]
+    in_range = jnp.arange(T)[None, :] < lens[:, None]
+    keep = (tokens != blank) & (tokens != prev) & in_range
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1   # target slot
+    # route dropped tokens to a scratch column T, then slice it off
+    pos = jnp.where(keep, pos, T)
+    out = jnp.full((B, T + 1), pad_value, dtype=tokens.dtype)
+    b_idx = jnp.arange(B)[:, None].repeat(T, axis=1)
+    out = out.at[b_idx.reshape(-1), pos.reshape(-1)].set(tokens.reshape(-1))
+    out_lens = jnp.sum(keep.astype(jnp.int32), axis=1)
+    return out[:, :T], out_lens
+
+
+@register("ctc_align", no_grad=True)
+def lower_ctc_align(ctx, ins):
+    """Merge repeated tokens then remove blanks (reference ctc_align_op.cc:1).
+    Input: [B, T] int token ids (+ optional Length). Output: padded [B, T]
+    (padding_value attr) + OutLength [B]."""
+    import jax.numpy as jnp
+
+    x = ins["Input"][0]
+    if x.ndim == 3:
+        x = x[..., 0]
+    B, T = x.shape
+    lens = ins.get("Length", [None])[0]
+    lens = (jnp.full((B,), T, jnp.int32) if lens is None
+            else lens.reshape(-1).astype(jnp.int32))
+    blank = ctx.attr("blank", 0)
+    pad_value = ctx.attr("padding_value", 0)
+    out, out_lens = _align_rows(x.astype(jnp.int32), lens, blank, pad_value)
+    return {"Output": [out], "OutLength": [out_lens]}
+
+
+@register("ctc_greedy_decoder", no_grad=True)
+def lower_ctc_greedy_decoder(ctx, ins):
+    """argmax over classes per step, then CTC collapse (reference layer
+    nn.py:4783: Step 1 argmax, Step 2 merge+deblank)."""
+    import jax.numpy as jnp
+
+    probs = ins["Input"][0]                              # [B, T, C]
+    B, T, _ = probs.shape
+    lens = ins.get("Length", [None])[0]
+    lens = (jnp.full((B,), T, jnp.int32) if lens is None
+            else lens.reshape(-1).astype(jnp.int32))
+    blank = ctx.attr("blank", 0)
+    pad_value = ctx.attr("padding_value", 0)
+    tokens = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    out, out_lens = _align_rows(tokens, lens, blank, pad_value)
+    return {"Output": [out], "OutLength": [out_lens]}

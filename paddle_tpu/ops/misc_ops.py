@@ -277,7 +277,24 @@ def lower_multiplex(ctx, ins):
     return {"Out": [xs[ids, rows]]}
 
 
-@register("where", infer_shape=_same_infer())
+def _where_infer(ctx):
+    # static out shape = broadcast(X, Y, Condition); X alone is wrong when it
+    # broadcasts up (e.g. ModelAverage's where(rotate, [1]-zero, param_sum))
+    import numpy as np
+
+    shapes = [ctx.input_shape(s) for s in ("X", "Y", "Condition")]
+    known = [s for s in shapes if s is not None and -1 not in tuple(s)]
+    if ctx.input_shape("X") is not None:
+        out = tuple(ctx.input_shape("X"))
+        for s in known:
+            try:
+                out = np.broadcast_shapes(out, tuple(s))
+            except ValueError:
+                pass
+        ctx.set_output("Out", list(out), ctx.input_dtype("X"))
+
+
+@register("where", infer_shape=_where_infer)
 def lower_where(ctx, ins):
     """Ternary select Out = Condition ? X : Y (modern paddle.where
     semantics — a TPU-native addition used by IfElse's merge so the

@@ -328,6 +328,8 @@ class AdamOptimizer(Optimizer):
                     and op.attr("beta1") == self._beta1
                     and op.attr("beta2") == self._beta2):
                 groups[op.input("LearningRate")[0]].append((i, op))
+        to_remove = []
+        to_append = []
         for lr_name, entries in groups.items():
             if len(entries) < 2:
                 continue
@@ -340,9 +342,14 @@ class AdamOptimizer(Optimizer):
                     merged[s].append(op.input(s)[0])
                 for s in outs:
                     outs[s].append(op.output(s)[0])
-            for i, _ in reversed(entries):
-                block.remove_op(i)
             merged["LearningRate"] = [lr_name]
+            to_remove.extend(i for i, _ in entries)
+            to_append.append((merged, outs))
+        # remove across ALL groups in one descending pass: removing inside the
+        # per-group loop would invalidate the indices recorded for later groups
+        for i in sorted(to_remove, reverse=True):
+            block.remove_op(i)
+        for merged, outs in to_append:
             block.append_op(
                 "adam_multi",
                 inputs=merged,

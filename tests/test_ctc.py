@@ -1,0 +1,202 @@
+"""CTC family: warpctc loss numerics vs torch's CPU CTC, gradient check,
+ctc_align / ctc_greedy_decoder vs brute force, and an OCR-style integration
+test (conv + GRU + CTC trained on synthetic strings; greedy decode recovers
+the planted string). Reference: operators/warpctc_op.cc, ctc_align_op.cc,
+layers/nn.py:4783 (ctc_greedy_decoder), :4866 (warpctc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+def _torch_ctc(logits, labels, llens, tlens, blank):
+    torch = pytest.importorskip("torch")
+    lg = torch.tensor(logits, dtype=torch.float64, requires_grad=True)
+    logp = torch.nn.functional.log_softmax(lg, dim=-1)
+    # torch wants [T, B, C]
+    loss = torch.nn.functional.ctc_loss(
+        logp.transpose(0, 1),
+        torch.tensor(labels, dtype=torch.long),
+        torch.tensor(llens, dtype=torch.long),
+        torch.tensor(tlens, dtype=torch.long),
+        blank=blank,
+        reduction="none",
+        zero_infinity=False,
+    )
+    loss.sum().backward()
+    return loss.detach().numpy(), lg.grad.numpy()
+
+
+class TestWarpCTC(OpTest):
+    op_type = "warpctc"
+
+    def test_loss_matches_torch(self):
+        B, T, C, L = 4, 12, 6, 5
+        logits = rng.randn(B, T, C).astype("float32")
+        labels = rng.randint(1, C, (B, L)).astype("int32")
+        llens = np.array([12, 9, 7, 12], "int32")
+        tlens = np.array([5, 3, 2, 4], "int32")
+        expected, _ = _torch_ctc(logits, labels, llens, tlens, blank=0)
+        self.check_output(
+            inputs={
+                "Logits": [("lg", logits)],
+                "Label": [("lb", labels)],
+                "Logits_length": [("ll", llens)],
+                "Label_length": [("tl", tlens)],
+            },
+            outputs={"Loss": [("loss", expected.reshape(B, 1))]},
+            attrs={"blank": 0, "norm_by_times": False},
+            atol=1e-4, rtol=1e-4,
+        )
+
+    def test_nonzero_blank_and_full_lengths(self):
+        B, T, C, L = 3, 8, 5, 3
+        blank = C - 1
+        logits = rng.randn(B, T, C).astype("float32")
+        labels = rng.randint(0, C - 1, (B, L)).astype("int32")
+        llens = np.full((B,), T, "int32")
+        tlens = np.full((B,), L, "int32")
+        expected, _ = _torch_ctc(logits, labels, llens, tlens, blank=blank)
+        self.check_output(
+            inputs={"Logits": [("lg", logits)], "Label": [("lb", labels)]},
+            outputs={"Loss": [("loss", expected.reshape(B, 1))]},
+            attrs={"blank": blank, "norm_by_times": False},
+            atol=1e-4, rtol=1e-4,
+        )
+
+    def test_grad_matches_torch(self):
+        """Analytic vjp gradient wrt raw logits vs torch autograd."""
+        B, T, C, L = 3, 10, 5, 4
+        logits = rng.randn(B, T, C).astype("float32")
+        labels = rng.randint(1, C, (B, L)).astype("int32")
+        llens = np.array([10, 8, 6], "int32")
+        tlens = np.array([4, 2, 3], "int32")
+        _, expected_grad = _torch_ctc(logits, labels, llens, tlens, blank=0)
+
+        from paddle_tpu.core import framework as fw
+        prog = fw.Program()
+        startup = fw.Program()
+        with fw.program_guard(prog, startup):
+            lg = layers.data(name="lg", shape=[T, C], dtype="float32")
+            lg.stop_gradient = False
+            lb = layers.data(name="lb", shape=[L], dtype="int32")
+            ll = layers.data(name="ll", shape=[], dtype="int32")
+            tl = layers.data(name="tl", shape=[], dtype="int32")
+            loss = layers.warpctc(lg, lb, blank=0, input_length=ll,
+                                  label_length=tl)
+            total = layers.reduce_sum(loss)
+            grads = pt.calc_gradient(total, [lg])
+        exe = pt.Executor(pt.CPUPlace())
+        (g,) = exe.run(
+            prog,
+            feed={"lg": logits, "lb": labels, "ll": llens, "tl": tlens},
+            fetch_list=[grads[0]],
+        )
+        np.testing.assert_allclose(np.asarray(g), expected_grad,
+                                   atol=2e-4, rtol=1e-3)
+
+
+def _align_ref(tokens, lens, blank):
+    out = []
+    for row, ln in zip(tokens, lens):
+        cur, prev = [], None
+        for tok in row[:ln]:
+            if tok != blank and tok != prev:
+                cur.append(int(tok))
+            prev = tok
+        out.append(cur)
+    return out
+
+
+class TestCtcAlign(OpTest):
+    op_type = "ctc_align"
+
+    def test_align(self):
+        B, T = 5, 9
+        x = rng.randint(0, 4, (B, T)).astype("int32")
+        lens = np.array([9, 7, 4, 9, 1], "int32")
+        ref = _align_ref(x, lens, blank=0)
+        expected = np.zeros((B, T), "int32")
+        for i, r in enumerate(ref):
+            expected[i, : len(r)] = r
+        got = self.check_output(
+            inputs={"Input": [("x", x)], "Length": [("l", lens)]},
+            outputs={"Output": [("o", expected)],
+                     "OutLength": [("ol", np.array([len(r) for r in ref],
+                                                   "int32"))]},
+            attrs={"blank": 0, "padding_value": 0},
+        )
+        assert got is not None
+
+
+def test_ctc_greedy_decoder_layer():
+    B, T, C = 3, 6, 4
+    probs = rng.rand(B, T, C).astype("float32")
+    inp = layers.data(name="p", shape=[T, C], dtype="float32")
+    dec, dec_len = layers.ctc_greedy_decoder(inp, blank=0)
+    exe = pt.Executor(pt.CPUPlace())
+    o, ol = exe.run(feed={"p": probs}, fetch_list=[dec, dec_len])
+    tokens = probs.argmax(-1)
+    ref = _align_ref(tokens, [T] * B, blank=0)
+    for i, r in enumerate(ref):
+        assert list(np.asarray(o)[i, : len(r)]) == r
+        assert int(np.asarray(ol)[i]) == len(r)
+
+
+def test_ocr_ctc_trains_and_decodes():
+    """conv + GRU + CTC on synthetic 'images' whose columns encode a token
+    string; loss decreases and greedy decode recovers the planted string."""
+    B, T, H, C = 8, 12, 8, 5  # C classes incl. blank 0
+    rs = np.random.RandomState(3)
+    # each class c gets a distinctive column pattern
+    patterns = rs.randn(C, H).astype("float32") * 2.0
+
+    def make_batch():
+        lab = rs.randint(1, C, (B, 4)).astype("int32")
+        img = np.zeros((B, 1, H, T), "float32")
+        for i in range(B):
+            # paint each token over 3 columns
+            for j, c in enumerate(lab[i]):
+                img[i, 0, :, 3 * j : 3 * j + 3] = patterns[c][:, None]
+        img += rs.randn(*img.shape).astype("float32") * 0.1
+        return img, lab
+
+    img = layers.data(name="img", shape=[1, H, T], dtype="float32")
+    lab = layers.data(name="lab", shape=[4], dtype="int32")
+    conv = layers.conv2d(img, num_filters=16, filter_size=3, padding=1,
+                         act="relu")                       # [B,16,H,T]
+    feat = layers.transpose(conv, [0, 3, 1, 2])            # [B,T,16,H]
+    feat = layers.reshape(feat, [-1, T, 16 * H])
+    gru = layers.dynamic_gru(layers.fc(feat, size=3 * 32, num_flatten_dims=2),
+                             size=32)
+    logits = layers.fc(gru, size=C, num_flatten_dims=2)    # [B,T,C]
+    loss = layers.warpctc(logits, lab, blank=0)
+    avg = layers.mean(loss)
+    dec, dec_len = layers.ctc_greedy_decoder(logits, blank=0)
+    pt.optimizer.AdamOptimizer(learning_rate=0.01).minimize(avg)
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for _ in range(120):
+        x, y = make_batch()
+        (lv,) = exe.run(feed={"img": x, "lab": y}, fetch_list=[avg])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    test_prog = pt.default_main_program().clone(for_test=True)
+    x, y = make_batch()
+    o, ol = exe.run(test_prog, feed={"img": x, "lab": y},
+                    fetch_list=[dec, dec_len])
+    o, ol = np.asarray(o), np.asarray(ol)
+    hits = sum(
+        1 for i in range(B)
+        if ol[i] == 4 and list(o[i, :4]) == list(y[i])
+    )
+    assert hits >= B - 2, (hits, o[:, :6], y)

@@ -4,7 +4,7 @@ step: counts copy/transpose/custom-call instructions by shape and locates
 them relative to the flash-attention custom-calls.  Perf tooling for
 PERF.md leads 1-2 (attention layout copies, scan-carry copies).
 
-Usage: python tools/hlo_diag.py [transformer|resnet50|bert] [out.txt]
+Usage: python tools/hlo_diag.py [transformer|transformer_noflash] [out.txt]
 """
 
 import os
