@@ -1058,3 +1058,406 @@ def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
         attrs={"ignore_index": ignore_index, "normalize": normalize},
     )
     return out
+
+
+# ---------------------------------------------------------------------------
+# registry-parity wrappers (round 4): every registered op reachable from the
+# DSL (tests/test_registry_coverage.py enforces this)
+# ---------------------------------------------------------------------------
+
+from .tensor import (  # noqa: E402
+    concat,
+    one_hot,
+    reduce_sum,
+    reduce_mean,
+    scale,
+    ones,
+    fill_constant,
+    elementwise_add,
+    elementwise_sub,
+    elementwise_mul,
+    elementwise_div,
+)
+
+
+def maxout(x, groups, name=None):
+    """Max across `groups` channel slices (reference maxout_op.cc,
+    layers/nn.py maxout)."""
+    helper = LayerHelper("maxout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("maxout", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"groups": groups})
+    if x.shape:
+        out.shape = (x.shape[0], x.shape[1] // groups) + tuple(x.shape[2:])
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """Parametric ReLU; mode in {all, channel, element} sizes the learned
+    Alpha (reference prelu_op.cc, layers/nn.py prelu)."""
+    helper = LayerHelper("prelu", param_attr=param_attr, name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [1, x.shape[1], 1, 1]
+    elif mode == "element":
+        alpha_shape = [1] + list(x.shape[1:])
+    else:
+        raise ValueError("prelu mode must be all/channel/element")
+    alpha = helper.create_parameter(
+        helper.param_attr(), shape=alpha_shape, dtype=x.dtype,
+        default_initializer=ConstantInitializer(0.25),
+    )
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    out.shape = x.shape
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead (row) convolution over time (reference row_conv_op.cc,
+    layers/nn.py row_conv)."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        helper.param_attr(), shape=[future_context_size + 1, d],
+        dtype=input.dtype,
+    )
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("row_conv", inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]})
+    out.shape = input.shape
+    return helper.append_activation(out)
+
+
+def conv_shift(x, y, name=None):
+    """Circular correlation (reference conv_shift_op.cc)."""
+    helper = LayerHelper("conv_shift", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("conv_shift", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    out.shape = x.shape
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="avg", name=None):
+    """Adaptive avg/max pool to a fixed output grid (reference
+    adaptive pooling path of pool_op.cc)."""
+    helper = LayerHelper("adaptive_pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "adaptive_pool2d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"pooling_size": list(pool_size), "pooling_type": pool_type},
+    )
+    if input.shape:
+        out.shape = tuple(input.shape[:2]) + tuple(pool_size)
+    return out
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step: fc([x_t, h_prev]) -> lstm_unit op (reference
+    layers/nn.py lstm_unit / lstm_unit_op.cc). Returns (hidden, cell)."""
+    helper = LayerHelper("lstm_unit", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = cell_t_prev.shape[-1]
+    concat_in = concat([x_t, hidden_t_prev], axis=1)
+    # bias_attr=None means the reference default: a trainable zero bias
+    gates = fc(concat_in, size=4 * d, param_attr=param_attr,
+               bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(
+        "lstm_unit",
+        inputs={"X": [gates], "C_prev": [cell_t_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": float(forget_bias)},
+    )
+    c.shape = cell_t_prev.shape
+    h.shape = cell_t_prev.shape
+    return h, c
+
+
+def unpool(x, indices, ksize, strides=None, output_size=None, name=None):
+    """Max-unpooling with saved flat indices (reference unpool_op.cc)."""
+    if strides is not None and list(strides) != list(ksize):
+        raise NotImplementedError(
+            "unpool: the lowering assumes strides == ksize "
+            f"(got strides={strides}, ksize={ksize})")
+    helper = LayerHelper("unpool", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {"ksize": list(ksize)}
+    if output_size:
+        attrs["output_size"] = list(output_size)
+    helper.append_op("unpool", inputs={"X": [x], "Indices": [indices]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    """Rearrange spatial blocks into channels (reference
+    space_to_depth_op.cc)."""
+    helper = LayerHelper("space_to_depth", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("space_to_depth", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"blocksize": blocksize})
+    if x.shape:
+        n, c, h, w = x.shape
+        out.shape = (n, c * blocksize * blocksize,
+                     h // blocksize, w // blocksize)
+    return out
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    """Pad H/W dims with constant/reflect/edge modes (reference
+    pad2d_op.cc)."""
+    helper = LayerHelper("pad2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pad2d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"paddings": list(paddings), "mode": mode,
+               "pad_value": float(pad_value), "data_format": data_format},
+    )
+    return out
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 name=None):
+    """Resize [N,C,H,W] images (reference layers/nn.py:6526 image_resize,
+    bilinear_interp_op.cc / nearest_interp_op.cc)."""
+    if resample.upper() not in ("BILINEAR", "NEAREST"):
+        raise ValueError("image_resize resample must be BILINEAR or NEAREST")
+    if out_shape is None:
+        if scale is None:
+            raise ValueError("one of out_shape/scale is required")
+        out_shape = [int(input.shape[2] * scale), int(input.shape[3] * scale)]
+    op_type = ("bilinear_interp" if resample.upper() == "BILINEAR"
+               else "nearest_interp")
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        op_type,
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"out_h": int(out_shape[0]), "out_w": int(out_shape[1])},
+    )
+    if input.shape:
+        out.shape = tuple(input.shape[:2]) + (int(out_shape[0]),
+                                              int(out_shape[1]))
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, "BILINEAR", name)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, "NEAREST", name)
+
+
+def grid_sampler(x, grid, name=None):
+    """Bilinear spatial sampling of x at grid coords (reference
+    layers/nn.py:9266 grid_sampler, grid_sampler_op.cc)."""
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("grid_sampler", inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]})
+    if x.shape and grid.shape:
+        out.shape = tuple(x.shape[:2]) + tuple(grid.shape[1:3])
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    """Generate a sampling grid from batched 2x3 affine matrices (reference
+    layers/nn.py:7239 affine_grid, affine_grid_op.cc). out_shape must be a
+    static [N,C,H,W] list on TPU."""
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    helper.append_op(
+        "affine_grid",
+        inputs={"Theta": [theta]},
+        outputs={"Output": [out]},
+        attrs={"output_shape": [int(s) for s in out_shape]},
+    )
+    if theta.shape:
+        out.shape = (theta.shape[0], int(out_shape[-2]), int(out_shape[-1]), 2)
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    """Per-instance random crop of the trailing dims (reference
+    layers/nn.py:6944 random_crop, random_crop_op.cc; the seed rides the
+    executor's threefry key)."""
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "random_crop",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"shape": [int(s) for s in shape]},
+    )
+    if x.shape:
+        out.shape = tuple(x.shape[: len(x.shape) - len(shape)]) + tuple(shape)
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """Hash integer id rows num_hash times into [0, hash_size) (reference
+    layers/nn.py:9196 hash, hash_op.cc)."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "hash",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"num_hash": num_hash, "mod_by": hash_size},
+    )
+    if input.shape:
+        out.shape = (input.shape[0], num_hash, 1)
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Dice coefficient loss for segmentation (reference layers/nn.py:6485
+    dice_loss — a composition, as in the reference)."""
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = reduce_sum(elementwise_mul(input, label), dim=reduce_dims)
+    dice_denominator = elementwise_add(
+        reduce_sum(input, dim=reduce_dims),
+        reduce_sum(label, dim=reduce_dims),
+    )
+    dice_score = elementwise_sub(
+        ones([1], input.dtype),
+        elementwise_div(
+            scale(inse, scale=2.0),
+            elementwise_add(dice_denominator,
+                            fill_constant([1], input.dtype, epsilon)),
+        ),
+    )
+    return reduce_mean(dice_score)
+
+
+def square_error_cost(input, label):
+    """(input - label)^2 (reference squared_l2 square_error_cost layer,
+    operators/squared_l2_... / square_error_cost in layers/nn.py)."""
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "square_error_cost",
+        inputs={"X": [input], "Y": [label]},
+        outputs={"Out": [out]},
+    )
+    out.shape = input.shape
+    return out
+
+
+def squared_l2_distance(x, y, name=None):
+    """Row-wise squared euclidean distance (reference
+    squared_l2_distance_op.h)."""
+    helper = LayerHelper("squared_l2_distance", name=name)
+    sub = helper.create_variable_for_type_inference(x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "squared_l2_distance",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"sub_result": [sub], "Out": [out]},
+    )
+    if x.shape:
+        out.shape = (x.shape[0], 1)
+    return out
+
+
+def modified_huber_loss(input, label, name=None):
+    """Classification huber variant (reference modified_huber_loss_op.h)."""
+    helper = LayerHelper("modified_huber_loss", name=name)
+    inter = helper.create_variable_for_type_inference(input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "modified_huber_loss",
+        inputs={"X": [input], "Y": [label]},
+        outputs={"IntermediateVal": [inter], "Out": [out]},
+    )
+    out.shape = input.shape
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label,
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """Distillation CTR loss (reference
+    teacher_student_sigmoid_loss_op.cc)."""
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "teacher_student_sigmoid_loss",
+        inputs={"X": [input], "Label": [label]},
+        outputs={"Y": [out]},
+        attrs={"soft_max_up_bound": soft_max_up_bound,
+               "soft_max_lower_bound": soft_max_lower_bound},
+    )
+    out.shape = input.shape
+    return out
+
+
+def l1_norm(x, name=None):
+    """sum(|x|) as a [1] tensor (reference l1_norm_op.cc)."""
+    helper = LayerHelper("l1_norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("l1_norm", inputs={"X": [x]}, outputs={"Out": [out]})
+    out.shape = (1,)
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    """Sample a class id per row from probabilities (reference
+    sampling_id_op.cc; randomness from the executor key)."""
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("sampling_id", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    if x.shape:
+        out.shape = (x.shape[0],)
+    return out
+
+
+def shuffle_batch(x, name=None):
+    """Shuffle rows of a batch on-device (reference shuffle_batch_op.cc).
+    Returns (shuffled, shuffle_idx)."""
+    helper = LayerHelper("shuffle_batch", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    idx = helper.create_variable_for_type_inference("int64")
+    helper.append_op("shuffle_batch", inputs={"X": [x]},
+                     outputs={"Out": [out], "ShuffleIdx": [idx]})
+    out.shape = x.shape
+    return out, idx
+
+
+def precision_recall(input, label, class_number, weights=None,
+                     states_info=None, name=None):
+    """Multi-class precision/recall/F1 metric op (reference
+    metrics/precision_recall_op.cc). Returns (batch_metrics [6],
+    accum_metrics [6], accum_states [C,4])."""
+    helper = LayerHelper("precision_recall", name=name)
+    batch_m = helper.create_variable_for_type_inference("float32")
+    accum_m = helper.create_variable_for_type_inference("float32")
+    accum_s = helper.create_variable_for_type_inference("float32")
+    inputs = {"Indices": [input], "Labels": [label]}
+    if weights is not None:
+        inputs["Weights"] = [weights]
+    if states_info is not None:
+        inputs["StatesInfo"] = [states_info]
+    helper.append_op(
+        "precision_recall",
+        inputs=inputs,
+        outputs={"BatchMetrics": [batch_m], "AccumMetrics": [accum_m],
+                 "AccumStatesInfo": [accum_s]},
+        attrs={"class_number": class_number},
+    )
+    return batch_m, accum_m, accum_s

@@ -379,3 +379,56 @@ def ctc_greedy_decoder(input, blank, padding_value=0, length=None, name=None):
     if input.shape:
         out.shape = tuple(input.shape[:2])
     return out, out_len
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Tile x rows along y's time dim (reference sequence_expand_op.cc;
+    padded-world semantics: x [B, D] -> [B, T, D] with T from y)."""
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_expand", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    if x.shape and y.shape:
+        out.shape = (x.shape[0], y.shape[1]) + tuple(x.shape[1:])
+    return out
+
+
+def sequence_pad(x, pad_value=None, maxlen=None, length=None, name=None):
+    """Dense passthrough + int64 Length (reference sequence_pad_op.cc:
+    LoD->padded; the padded world is already dense). Returns (out, length)."""
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    inputs = {"X": [x]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("sequence_pad", inputs=inputs,
+                     outputs={"Out": [out], "Length": [out_len]})
+    out.shape = x.shape
+    return out, out_len
+
+
+def sequence_unpad(x, length=None, name=None):
+    """Inverse of sequence_pad (dense passthrough; reference
+    sequence_unpad_op.cc)."""
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("sequence_unpad", inputs=inputs,
+                     outputs={"Out": [out]})
+    out.shape = x.shape
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    """Zero out listed token ids (reference sequence_erase_op.cc removes
+    them via LoD shrink; dense variant masks them)."""
+    helper = LayerHelper("sequence_erase", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_erase", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"tokens": list(tokens)})
+    out.shape = input.shape
+    return out

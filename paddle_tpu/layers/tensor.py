@@ -482,3 +482,240 @@ def fill_constant_batch_size_like(input, shape, dtype, value,
         },
     )
     return out
+
+
+def not_equal(x, y, cond=None):
+    helper = LayerHelper("not_equal")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op("not_equal", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def greater_than(x, y, cond=None):
+    helper = LayerHelper("greater_than")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op("greater_than", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def greater_equal(x, y, cond=None):
+    helper = LayerHelper("greater_equal")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op("greater_equal", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def less_equal(x, y, cond=None):
+    helper = LayerHelper("less_equal")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op("less_equal", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def _logical(op, x, y, out=None):
+    helper = LayerHelper(op)
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool")
+    inputs = {"X": [x]} if y is None else {"X": [x], "Y": [y]}
+    helper.append_op(op, inputs=inputs, outputs={"Out": [out]})
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    return _logical("logical_and", x, y, out)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical("logical_or", x, y, out)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical("logical_xor", x, y, out)
+
+
+def logical_not(x, out=None, name=None):
+    return _logical("logical_not", x, None, out)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_floordiv", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mod", x, y, axis, act, name)
+
+
+def minus(x, y, name=None):
+    """reference minus_op.cc (the v2-era x - y)."""
+    helper = LayerHelper("minus", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("minus", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    out.shape = x.shape
+    return out
+
+
+def sign(x, name=None):
+    helper = LayerHelper("sign", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sign", inputs={"X": [x]}, outputs={"Out": [out]})
+    out.shape = x.shape
+    return out
+
+
+def expand_as(x, target_tensor, name=None):
+    """Tile x to target_tensor's shape (reference expand_as_op.cc)."""
+    helper = LayerHelper("expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "expand_as",
+        inputs={"X": [x], "target_tensor": [target_tensor]},
+        outputs={"Out": [out]},
+    )
+    out.shape = target_tensor.shape
+    return out
+
+
+def fill(shape, dtype="float32", value=0.0, name=None):
+    """reference fill_op.cc: `value` is a flat element list sized to
+    `shape`; a scalar here is expanded to the full size."""
+    import numpy as _np
+
+    helper = LayerHelper("fill", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    n = int(_np.prod(shape))
+    vals = ([float(value)] * n if _np.isscalar(value)
+            else [float(v) for v in _np.asarray(value).ravel()])
+    helper.append_op(
+        "fill",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": dtype, "value": vals},
+    )
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    """Flatten to 2D at `axis` (reference flatten_op.cc; emits flatten2
+    like reshape->reshape2)."""
+    helper = LayerHelper("flatten2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype,
+                                                       stop_gradient=True)
+    helper.append_op(
+        "flatten2",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "XShape": [xshape]},
+        attrs={"axis": axis},
+    )
+    if x.shape:
+        import numpy as _np
+
+        lead = int(_np.prod(x.shape[:axis])) if axis > 0 else 1
+        tail = int(_np.prod(x.shape[axis:])) if axis < len(x.shape) else 1
+        out.shape = (lead, tail)
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    """reference pad_op.cc: paddings = [before0, after0, before1, ...]."""
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "pad",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"paddings": list(paddings), "pad_value": float(pad_value)},
+    )
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape (reference pad_constant_like_op.cc)."""
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op(
+        "pad_constant_like",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"pad_value": float(pad_value)},
+    )
+    out.shape = x.shape
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    """Split x along axis into unstacked pieces (reference unstack_op.cc)."""
+    helper = LayerHelper("unstack")
+    if num is None:
+        if not x.shape or x.shape[axis] in (None, -1):
+            raise ValueError("unstack needs a static dim or explicit num")
+        num = x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(num)]
+    helper.append_op(
+        "unstack",
+        inputs={"X": [x]},
+        outputs={"Y": outs},
+        attrs={"axis": axis, "num": num},
+    )
+    return outs
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Recompute ids for a vocabulary shard (reference shard_index_op.cc)."""
+    helper = LayerHelper("shard_index")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "shard_index",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"index_num": index_num, "nshards": nshards,
+               "shard_id": shard_id, "ignore_value": ignore_value},
+    )
+    out.shape = input.shape
+    return out
+
+
+def is_empty(x, cond=None):
+    """True iff x has zero elements (reference is_empty_op.cc)."""
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op("is_empty", inputs={"X": [x]}, outputs={"Out": [cond]})
+    return cond
+
+
+def isfinite(x):
+    """True iff all elements are finite (reference isfinite_op.cc)."""
+    helper = LayerHelper("isfinite")
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op("isfinite", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """Densify a SelectedRows variable (reference
+    get_tensor_from_selected_rows_op.cc)."""
+    helper = LayerHelper("get_tensor_from_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("get_tensor_from_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def merge_selected_rows(x, name=None):
+    """Sum duplicate rows of a SelectedRows (reference
+    merge_selected_rows_op.cc)."""
+    helper = LayerHelper("merge_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("merge_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out

@@ -16,4 +16,5 @@ from . import (  # noqa: F401
     quant_ops,
     detection_ops,
     ctc_ops,
+    image_ops,
 )

@@ -1,0 +1,149 @@
+"""Image-space ops: affine_grid, grid_sampler, random_crop, hash.
+
+Capability parity with the reference's affine_grid_op.cc, grid_sampler_op.cc
+(cuDNN spatial-transformer path), random_crop_op.cc and hash_op.cc (xxhash),
+rebuilt TPU-first: everything is a static-shape gather/interpolation XLA
+lowering; random_crop draws its offsets from the executor's threefry key
+(no host RNG round-trip); hash is a splitmix-style integer mix instead of a
+dlopen'd xxhash (deterministic across hosts, vectorizes on VPU).
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _affine_infer(ctx):
+    ts = ctx.input_shape("Theta")
+    shape = ctx.attr("output_shape")
+    if ts is not None and shape:
+        n = ts[0]
+        ctx.set_output("Output", [n, shape[-2], shape[-1], 2],
+                       ctx.input_dtype("Theta"))
+
+
+@register("affine_grid", infer_shape=_affine_infer)
+def lower_affine_grid(ctx, ins):
+    """Theta [N,2,3] + output_shape attr [N,C,H,W] -> sampling grid
+    [N,H,W,2] of (x,y) in [-1,1] (reference affine_grid_op.cc / layer
+    nn.py:7239; align_corners=True semantics of fluid 1.2)."""
+    jnp = _jnp()
+    theta = ins["Theta"][0]
+    shape = ins.get("OutputShape", [None])[0]
+    if shape is not None:
+        # dynamic shape input unsupported on TPU (static shapes); require attr
+        raise ValueError("affine_grid: pass output_shape as a static attr")
+    out_shape = ctx.attr("output_shape")
+    h, w = int(out_shape[-2]), int(out_shape[-1])
+    xs = jnp.linspace(-1.0, 1.0, w, dtype=theta.dtype)
+    ys = jnp.linspace(-1.0, 1.0, h, dtype=theta.dtype)
+    gx, gy = jnp.meshgrid(xs, ys)                      # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)          # [H, W, 3]
+    # grid[n,h,w,k] = sum_j theta[n,k,j] * base[h,w,j]
+    grid = jnp.einsum("nkj,hwj->nhwk", theta, base)
+    return {"Output": [grid]}
+
+
+@register("grid_sampler")
+def lower_grid_sampler(ctx, ins):
+    """Bilinear sampling of X [N,C,H,W] at Grid [N,Hg,Wg,2] ((x,y) in
+    [-1,1]); out-of-bounds reads contribute zero (reference
+    grid_sampler_op.cc zeros-padding mode, align_corners=True)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    grid = ins["Grid"][0]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) / 2.0 * (w - 1)          # [N,Hg,Wg]
+    gy = (grid[..., 1] + 1.0) / 2.0 * (h - 1)
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    batch = jnp.arange(n)[:, None, None]
+
+    def tap(yi, xi):
+        wgt = (1.0 - jnp.abs(gx - xi)) * (1.0 - jnp.abs(gy - yi))
+        inb = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        v = x[batch, :, yc, xc]                        # [N,Hg,Wg,C]
+        wgt = jnp.where(inb, wgt, 0.0).astype(x.dtype)
+        return v * wgt[..., None]
+
+    out = (tap(y0, x0) + tap(y0, x0 + 1) + tap(y0 + 1, x0)
+           + tap(y0 + 1, x0 + 1))                      # [N,Hg,Wg,C]
+    return {"Output": [jnp.transpose(out, (0, 3, 1, 2))]}
+
+
+def _crop_infer(ctx):
+    xs = ctx.input_shape("X")
+    shape = ctx.attr("shape")
+    if xs is not None and shape:
+        k = len(shape)
+        ctx.set_output("Out", list(xs[: len(xs) - k]) + list(shape),
+                       ctx.input_dtype("X"))
+
+
+@register("random_crop", no_grad=True, infer_shape=_crop_infer)
+def lower_random_crop(ctx, ins):
+    """Crop a random window of attr `shape` from each instance's trailing
+    dims (reference random_crop_op.cc/.h RandomCropFunctor; the Seed
+    input/attr is replaced by the executor's per-op threefry key — listed
+    in the executor's _RANDOM_OPS set)."""
+    import jax
+    jnp = _jnp()
+
+    x = ins["X"][0]
+    crop = [int(s) for s in ctx.attr("shape")]
+    k = len(crop)
+    lead = x.shape[: x.ndim - k]
+    tail = x.shape[x.ndim - k:]
+    key = ctx.next_rng_key()
+    batch = 1
+    for d in lead:
+        batch *= d
+    xf = x.reshape((batch,) + tuple(tail))
+    # draw per-instance, per-dim offsets in one batched call
+    maxs = jnp.asarray([tail[j] - crop[j] + 1 for j in range(k)])
+    u = jax.random.uniform(key, (batch, k))
+    starts = jnp.floor(u * maxs[None, :]).astype(jnp.int32)
+    starts = jnp.minimum(starts, maxs[None, :] - 1)
+
+    def slice_one(xi, si):
+        return jax.lax.dynamic_slice(xi, tuple(si[j] for j in range(k)),
+                                     crop)
+
+    out = jax.vmap(slice_one)(xf, starts)
+    return {"Out": [out.reshape(tuple(lead) + tuple(crop))]}
+
+
+@register("hash", no_grad=True)
+def lower_hash(ctx, ins):
+    """Hash each input row num_hash times into [0, mod_by) (reference
+    hash_op.cc uses xxhash over the row bytes; here a splitmix32-style
+    avalanche mix seeded per hash index — deterministic, vectorized)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    num_hash = ctx.attr("num_hash", 1)
+    mod_by = ctx.attr("mod_by", 1)
+    ids = x.reshape(x.shape[0], -1).astype(jnp.uint32)
+
+    def mix(v):
+        v = (v ^ (v >> 16)) * jnp.uint32(0x7FEB352D)
+        v = (v ^ (v >> 15)) * jnp.uint32(0x846CA68B)
+        return v ^ (v >> 16)
+
+    outs = []
+    for i in range(num_hash):
+        seed = (0x9E3779B9 * (i + 1)) & 0xFFFFFFFF
+        acc = jnp.full((ids.shape[0],), jnp.uint32(seed))
+        for j in range(ids.shape[1]):
+            acc = mix(acc ^ ids[:, j])
+        outs.append((acc % jnp.uint32(mod_by)).astype(jnp.int32))
+    out = jnp.stack(outs, axis=1)[..., None]           # [N, num_hash, 1]
+    return {"Out": [out]}

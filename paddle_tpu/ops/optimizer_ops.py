@@ -340,6 +340,32 @@ def lower_ftrl(ctx, ins):
     }
 
 
+@register("proximal_adagrad", no_grad=True)
+def lower_proximal_adagrad(ctx, ins):
+    """reference proximal_adagrad_op.h: m += g^2;
+    prox = p - lr*g/sqrt(m); p = soft-threshold(prox, lr*l1)/(1+lr*l2)."""
+    jnp = _jnp()
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr = _lr(ins)
+    m_out = m + g * g
+    # g==0 with zero accumulator is the 0/0 corner (reference Eigen code
+    # produces NaN there); take the correct g->0 limit of 0 instead
+    step = jnp.where(m_out > 0.0, g / jnp.sqrt(jnp.maximum(m_out, 1e-30)),
+                     jnp.zeros_like(g))
+    prox = p - lr * step
+    if l1 > 0:
+        p_out = (
+            jnp.sign(prox)
+            * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+            / (1.0 + lr * l2)
+        )
+    else:
+        p_out = prox / (1.0 + lr * l2)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
 @register("proximal_gd", no_grad=True)
 def lower_proximal_gd(ctx, ins):
     jnp = _jnp()

@@ -527,6 +527,66 @@ class RMSPropOptimizer(Optimizer):
         )
 
 
+class ProximalGDOptimizer(Optimizer):
+    """Proximal gradient descent w/ l1/l2 (reference optimizer.py
+    ProximalGD / operators/optimizers/proximal_gd_op.cc)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "proximal_gd"
+        self._l1 = l1
+        self._l2 = l2
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "proximal_gd",
+            inputs={
+                "Param": [p],
+                "Grad": [g],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [p.name]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Optimize},
+        )
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """Adagrad with proximal l1/l2 regularization (reference optimizer.py
+    ProximalAdagrad / operators/optimizers/proximal_adagrad_op.h)."""
+
+    def __init__(self, learning_rate, initial_accumulator_value=0.0,
+                 l1=0.0, l2=0.0, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "proximal_adagrad"
+        self._l1 = l1
+        self._l2 = l2
+        self._initial_accumulator_value = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p,
+                                  fill_value=self._initial_accumulator_value)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        moment = self._get_accumulator("moment", p)
+        return block.append_op(
+            "proximal_adagrad",
+            inputs={
+                "Param": [p],
+                "Grad": [g],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [p.name], "MomentOut": [moment.name]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Optimize},
+        )
+
+
 class FtrlOptimizer(Optimizer):
     def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
                  regularization=None, name=None):
@@ -574,6 +634,8 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer
 LarsMomentum = LarsMomentumOptimizer
 
 

@@ -1,0 +1,166 @@
+"""affine_grid / grid_sampler vs torch, random_crop, hash, image_resize,
+and the new proximal optimizers (reference affine_grid_op.cc,
+grid_sampler_op.cc, random_crop_op.cc, hash_op.cc,
+optimizers/proximal_adagrad_op.h)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+rng = np.random.RandomState(5)
+
+
+def test_affine_grid_and_grid_sampler_match_torch():
+    torch = pytest.importorskip("torch")
+    N, C, H, W = 2, 3, 5, 7
+    theta = (np.eye(2, 3)[None].repeat(N, 0)
+             + 0.1 * rng.randn(N, 2, 3)).astype("float32")
+    x = rng.randn(N, C, H, W).astype("float32")
+
+    tg = torch.nn.functional.affine_grid(
+        torch.tensor(theta), (N, C, H, W), align_corners=True)
+    ts = torch.nn.functional.grid_sample(
+        torch.tensor(x), tg, mode="bilinear", padding_mode="zeros",
+        align_corners=True)
+
+    th = layers.data(name="theta", shape=[2, 3], dtype="float32")
+    xv = layers.data(name="x", shape=[C, H, W], dtype="float32")
+    grid = layers.affine_grid(th, [N, C, H, W])
+    out = layers.grid_sampler(xv, grid)
+    exe = pt.Executor(pt.CPUPlace())
+    g, o = exe.run(feed={"theta": theta, "x": x}, fetch_list=[grid, out])
+    np.testing.assert_allclose(np.asarray(g), tg.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(o), ts.numpy(), atol=1e-4)
+
+
+def test_grid_sampler_zeros_outside():
+    N, C, H, W = 1, 1, 4, 4
+    x = np.ones((N, C, H, W), "float32")
+    # grid far outside [-1,1] everywhere -> all zeros
+    grid = np.full((N, 3, 3, 2), 5.0, "float32")
+    xv = layers.data(name="xs", shape=[C, H, W], dtype="float32")
+    gv = layers.data(name="gs", shape=[3, 3, 2], dtype="float32")
+    out = layers.grid_sampler(xv, gv)
+    exe = pt.Executor(pt.CPUPlace())
+    (o,) = exe.run(feed={"xs": x, "gs": grid}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), 0.0)
+
+
+def test_random_crop_shape_and_content():
+    B, C, H, W = 4, 2, 10, 12
+    ch, cw = 6, 7
+    x = rng.rand(B, C, H, W).astype("float32")
+    xv = layers.data(name="xc", shape=[C, H, W], dtype="float32")
+    out = layers.random_crop(xv, shape=[ch, cw])
+    assert tuple(out.shape)[-2:] == (ch, cw), out.shape
+    exe = pt.Executor(pt.CPUPlace())
+    (o,) = exe.run(feed={"xc": x}, fetch_list=[out])
+    o = np.asarray(o).reshape(B, C, ch, cw)
+    # every cropped window must literally appear in its source instance
+    for b in range(2):
+        found = any(
+            np.allclose(o[b, 0], x[b, 0, i : i + ch, j : j + cw])
+            for i in range(H - ch + 1)
+            for j in range(W - cw + 1)
+        )
+        assert found, "crop is not a window of the source"
+
+
+def test_hash_deterministic_in_range():
+    n, mod = 64, 1000
+    ids = rng.randint(0, 2**31 - 1, (n, 2)).astype("int64")
+    xv = layers.data(name="ids", shape=[2], dtype="int64")
+    out = layers.hash(xv, hash_size=mod, num_hash=3)
+    exe = pt.Executor(pt.CPUPlace())
+    (o1,) = exe.run(feed={"ids": ids}, fetch_list=[out])
+    (o2,) = exe.run(feed={"ids": ids}, fetch_list=[out])
+    o1, o2 = np.asarray(o1), np.asarray(o2)
+    assert o1.shape == (n, 3, 1)
+    np.testing.assert_array_equal(o1, o2)
+    assert o1.min() >= 0 and o1.max() < mod
+    # different hash indices should disagree somewhere
+    assert not np.array_equal(o1[:, 0], o1[:, 1])
+    # hashing must spread: no single bucket dominates
+    assert len(np.unique(o1[:, 0, 0])) > n // 4
+
+
+def test_image_resize_matches_jax():
+    import jax
+
+    N, C, H, W = 2, 3, 8, 8
+    x = rng.rand(N, C, H, W).astype("float32")
+    xv = layers.data(name="xr", shape=[C, H, W], dtype="float32")
+    up = layers.resize_bilinear(xv, out_shape=[16, 16])
+    nn_ = layers.resize_nearest(xv, scale=2.0)
+    exe = pt.Executor(pt.CPUPlace())
+    o1, o2 = exe.run(feed={"xr": x}, fetch_list=[up, nn_])
+    ref_b = jax.image.resize(x, (N, C, 16, 16), "bilinear")
+    ref_n = jax.image.resize(x, (N, C, 16, 16), "nearest")
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(ref_b), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(o2), np.asarray(ref_n), atol=1e-6)
+
+
+def _train_quadratic(opt):
+    """Minimize ||Wx - y||^2 with the given optimizer; return final loss."""
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt.minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    w = rng.randn(4, 1).astype("float32")
+    losses = []
+    for _ in range(60):
+        xb = rng.randn(32, 4).astype("float32")
+        yb = xb @ w
+        (lv,) = exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    return losses
+
+
+def test_proximal_gd_trains():
+    losses = _train_quadratic(pt.optimizer.ProximalGD(learning_rate=0.05,
+                                                      l1=1e-4, l2=1e-4))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_proximal_adagrad_trains_and_matches_reference_math():
+    losses = _train_quadratic(
+        pt.optimizer.ProximalAdagrad(learning_rate=0.5, l1=1e-4, l2=1e-4))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+    # single-step numeric check vs proximal_adagrad_op.h formulas
+    p0 = np.array([0.5, -0.3], "float32")
+    g0 = np.array([0.2, 0.1], "float32")
+    m0 = np.array([0.1, 0.2], "float32")
+    lr, l1, l2 = 0.1, 0.01, 0.02
+    m1 = m0 + g0 * g0
+    prox = p0 - lr * g0 / np.sqrt(m1)
+    expect = np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0) / (1 + lr * l2)
+
+    from paddle_tpu.core import framework as fw
+    prog, startup = fw.Program(), fw.Program()
+    with fw.program_guard(prog, startup):
+        blk = prog.global_block()
+        for nm, val in [("p", p0), ("g", g0), ("m", m0),
+                        ("lr", np.array([lr], "float32"))]:
+            blk.create_var(name=nm, shape=val.shape, dtype="float32",
+                           is_data=True)
+        blk.create_var(name="p_out", dtype="float32")
+        blk.create_var(name="m_out", dtype="float32")
+        blk.append_op(
+            "proximal_adagrad",
+            inputs={"Param": ["p"], "Grad": ["g"], "Moment": ["m"],
+                    "LearningRate": ["lr"]},
+            outputs={"ParamOut": ["p_out"], "MomentOut": ["m_out"]},
+            attrs={"l1": l1, "l2": l2},
+        )
+    exe = pt.Executor(pt.CPUPlace())
+    po, mo = exe.run(prog, feed={"p": p0, "g": g0, "m": m0,
+                                 "lr": np.array([lr], "float32")},
+                     fetch_list=["p_out", "m_out"])
+    np.testing.assert_allclose(np.asarray(po), expect, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(mo), m1, atol=1e-6)
