@@ -221,20 +221,64 @@ def backward(loss_var):
 
 class Layer:
     """Dygraph layer base (reference: python fluid/imperative/layers.py).
-    Subclass and implement forward(); parameters() returns the Parameter
-    vars created by layers.* calls inside."""
+    Subclass and implement forward(); parameters() returns this layer's
+    own tracked parameters plus those of sub-Layers found on attributes."""
 
     def __init__(self, name_scope=None):
         self._name_scope = name_scope
+        self._own_params: List[fw.Variable] = []
+
+    def _track(self, *params):
+        for p in params:
+            if p is not None:
+                self._own_params.append(p)
 
     def __call__(self, *args, **kwargs):
-        return self.forward(*args, **kwargs)
+        # adopt parameters created DURING forward (functional layers.*
+        # calls create their weights on first use; without adoption a
+        # layer mixing build-once sub-Layers with functional calls would
+        # silently drop the functional weights from parameters())
+        before = {p.name for p in fw.default_main_program().all_parameters()}
+        out = self.forward(*args, **kwargs)
+        for p in fw.default_main_program().all_parameters():
+            if p.name not in before:
+                self._track(p)
+        return out
 
     def forward(self, *args, **kwargs):
         raise NotImplementedError
 
+    def sublayers(self):
+        subs = []
+        for v in vars(self).values():
+            if isinstance(v, Layer):
+                subs.append(v)
+            elif isinstance(v, (list, tuple)):
+                subs.extend(x for x in v if isinstance(x, Layer))
+        return subs
+
+    def _tracked_parameters(self):
+        params = list(getattr(self, "_own_params", []))
+        for sub in self.sublayers():
+            params.extend(sub._tracked_parameters())
+        return params
+
     def parameters(self):
-        return list(fw.default_main_program().all_parameters())
+        # dedup by name: a lazily-built sub-Layer weight is tracked by the
+        # sub-Layer AND adopted by the enclosing __call__
+        seen, params = set(), []
+        for p in self._tracked_parameters():
+            if p.name not in seen:
+                seen.add(p.name)
+                params.append(p)
+        if not params:
+            # functional-style dygraph (layers.* calls in forward) on a
+            # never-called layer; fall back to every program parameter
+            return list(fw.default_main_program().all_parameters())
+        return params
+
+    def clear_gradients(self):
+        clear_gradients()
 
 
 def parameters():
@@ -285,3 +329,7 @@ def _var_backward(self):
 fw.Variable.numpy = _var_numpy
 fw.Variable.gradient = _var_gradient
 fw.Variable.backward = _var_backward
+
+
+# imported at the bottom: nn's Layer classes subclass Layer defined above
+from . import nn  # noqa: E402,F401

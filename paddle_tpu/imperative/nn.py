@@ -1,0 +1,241 @@
+"""Dygraph nn Layer classes (reference: python/paddle/fluid/imperative/
+nn.py — Conv2D:33, Pool2D:146, FC:208; Embedding/BatchNorm follow the same
+build-once pattern).
+
+Each Layer creates its parameters ONCE (eagerly initialized, since the
+startup initializer op executes immediately under imperative.guard()) and
+its forward() appends only compute ops bound to those stored parameters —
+so repeated calls reuse weights instead of re-creating them the way the
+functional layers.* API would."""
+
+from __future__ import annotations
+
+from ..core import framework as fw
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+from . import Layer
+
+
+def _pair(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x, x]
+
+
+class Conv2D(Layer):
+    """reference imperative/nn.py:33."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, act=None,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 name_scope=None):
+        super().__init__(name_scope)
+        import numpy as np
+
+        from ..initializer import NormalInitializer
+
+        self._act = act
+        self._stride = _pair(stride)
+        self._padding = _pair(padding)
+        self._dilation = _pair(dilation)
+        self._groups = groups or 1
+        fs = _pair(filter_size)
+        helper = LayerHelper("eager_conv2d", param_attr=param_attr,
+                             bias_attr=bias_attr)
+        fan_in = (num_channels // self._groups) * fs[0] * fs[1]
+        std = float(np.sqrt(2.0 / fan_in))
+        self._filter = helper.create_parameter(
+            helper.param_attr(),
+            shape=[num_filters, num_channels // self._groups] + fs,
+            dtype=dtype,
+            default_initializer=NormalInitializer(0.0, std),
+        )
+        self._bias = (None if bias_attr is False else helper.create_parameter(
+            helper.bias_attr(), shape=[num_filters], dtype=dtype,
+            is_bias=True))
+        self._track(self._filter, self._bias)
+
+    def forward(self, input):
+        helper = LayerHelper("eager_conv2d", act=self._act)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(
+            "conv2d",
+            inputs={"Input": [input], "Filter": [self._filter]},
+            outputs={"Output": [out]},
+            attrs={"strides": self._stride, "paddings": self._padding,
+                   "dilations": self._dilation, "groups": self._groups,
+                   "data_format": "NCHW"},
+        )
+        if self._bias is not None:
+            pre = helper.create_variable_for_type_inference(input.dtype)
+            helper.append_op(
+                "elementwise_add",
+                inputs={"X": [out], "Y": [self._bias]},
+                outputs={"Out": [pre]},
+                attrs={"axis": 1},
+            )
+            out = pre
+        return helper.append_activation(out)
+
+
+class Pool2D(Layer):
+    """reference imperative/nn.py:146 (stateless)."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True, name_scope=None):
+        super().__init__(name_scope)
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": _pair(pool_size),
+            "strides": _pair(pool_stride),
+            "paddings": _pair(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+            "data_format": "NCHW",
+        }
+
+    def forward(self, input):
+        helper = LayerHelper("eager_pool2d")
+        out = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("pool2d", inputs={"X": [input]},
+                         outputs={"Out": [out]}, attrs=dict(self._attrs))
+        return out
+
+
+class FC(Layer):
+    """reference imperative/nn.py:208 — weight built lazily on the first
+    forward (the input feature size is only known then)."""
+
+    def __init__(self, size, num_flatten_dims=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", name_scope=None):
+        super().__init__(name_scope)
+        self._size = size
+        self._nfd = num_flatten_dims
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self._dtype = dtype
+        self._w = None
+        self._b = None
+
+    def _build_once(self, input):
+        helper = LayerHelper("eager_fc", param_attr=self._param_attr,
+                             bias_attr=self._bias_attr)
+        in_features = 1
+        for d in input.shape[self._nfd:]:
+            in_features *= d
+        self._w = helper.create_parameter(
+            helper.param_attr(), shape=[in_features, self._size],
+            dtype=self._dtype)
+        self._b = (None if self._bias_attr is False
+                   else helper.create_parameter(
+                       helper.bias_attr(), shape=[self._size],
+                       dtype=self._dtype, is_bias=True))
+        self._track(self._w, self._b)
+
+    def forward(self, input):
+        if self._w is None:
+            self._build_once(input)
+        helper = LayerHelper("eager_fc", act=self._act)
+        out = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            "mul",
+            inputs={"X": [input], "Y": [self._w]},
+            outputs={"Out": [out]},
+            attrs={"x_num_col_dims": self._nfd, "y_num_col_dims": 1},
+        )
+        if self._b is not None:
+            pre = helper.create_variable_for_type_inference(self._dtype)
+            helper.append_op(
+                "elementwise_add",
+                inputs={"X": [out], "Y": [self._b]},
+                outputs={"Out": [pre]},
+                attrs={"axis": -1},
+            )
+            out = pre
+        return helper.append_activation(out)
+
+
+class Embedding(Layer):
+    """Eager lookup table (reference fluid layers embedding + the dygraph
+    Embedding of the following release; build-once table)."""
+
+    def __init__(self, size, is_sparse=False, padding_idx=None,
+                 param_attr=None, dtype="float32", name_scope=None):
+        super().__init__(name_scope)
+        helper = LayerHelper("eager_embedding", param_attr=param_attr)
+        self._table = helper.create_parameter(
+            helper.param_attr(), shape=list(size), dtype=dtype)
+        self._padding_idx = (-1 if padding_idx is None else padding_idx
+                             if padding_idx >= 0 else size[0] + padding_idx)
+        self._is_sparse = is_sparse
+        self._track(self._table)
+
+    def forward(self, input):
+        helper = LayerHelper("eager_embedding")
+        out = helper.create_variable_for_type_inference(self._table.dtype)
+        helper.append_op(
+            "lookup_table",
+            inputs={"Ids": [input], "W": [self._table]},
+            outputs={"Out": [out]},
+            attrs={"is_sparse": self._is_sparse,
+                   "padding_idx": self._padding_idx},
+        )
+        return out
+
+
+class BatchNorm(Layer):
+    """Eager batch norm with running stats (reference fluid layers
+    batch_norm:2714 built build-once for dygraph)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, data_layout="NCHW",
+                 dtype="float32", name_scope=None):
+        super().__init__(name_scope)
+        from ..initializer import ConstantInitializer
+
+        helper = LayerHelper("eager_bn", param_attr=param_attr,
+                             bias_attr=bias_attr)
+        self._act = act
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._layout = data_layout
+        shape = [num_channels]
+        self._scale = helper.create_parameter(
+            helper.param_attr(), shape=shape, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        self._bias = helper.create_parameter(
+            helper.bias_attr(), shape=shape, dtype=dtype, is_bias=True)
+        self._mean = helper.create_global_variable(
+            persistable=True, name=fw.unique_name("eager_bn_mean"),
+            shape=shape, dtype=dtype)
+        helper.set_variable_initializer(self._mean, ConstantInitializer(0.0))
+        self._var = helper.create_global_variable(
+            persistable=True, name=fw.unique_name("eager_bn_var"),
+            shape=shape, dtype=dtype)
+        helper.set_variable_initializer(self._var, ConstantInitializer(1.0))
+        self._mean.stop_gradient = True
+        self._var.stop_gradient = True
+        self._track(self._scale, self._bias)
+
+    def forward(self, input):
+        from . import _require_session
+
+        helper = LayerHelper("eager_bn", act=self._act)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        saved_mean = helper.create_variable_for_type_inference(input.dtype)
+        saved_var = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(
+            "batch_norm",
+            inputs={"X": [input], "Scale": [self._scale],
+                    "Bias": [self._bias], "Mean": [self._mean],
+                    "Variance": [self._var]},
+            outputs={"Y": [out], "MeanOut": [self._mean.name],
+                     "VarianceOut": [self._var.name],
+                     "SavedMean": [saved_mean.name],
+                     "SavedVariance": [saved_var.name]},
+            attrs={"momentum": self._momentum, "epsilon": self._epsilon,
+                   "data_layout": self._layout,
+                   "is_test": _require_session().is_test},
+        )
+        return helper.append_activation(out)

@@ -1,8 +1,11 @@
 """Detection layers (reference: python/paddle/fluid/layers/detection.py —
 prior_box, box_coder, iou_similarity, bipartite_match, multiclass_nms,
-roi_pool, roi_align)."""
+roi_pool, roi_align, target_assign, ssd_loss:779, detection_output:201,
+multi_box_head:1259, density_prior_box:1133, detection_map:515)."""
 
 from __future__ import annotations
+
+import math
 
 from ..layer_helper import LayerHelper
 
@@ -16,7 +19,26 @@ __all__ = [
     "multiclass_nms",
     "roi_pool",
     "roi_align",
+    "target_assign",
+    "ssd_loss",
+    "detection_output",
+    "multi_box_head",
+    "density_prior_box",
+    "detection_map",
+    "yolov3_loss",
+    "generate_proposals",
+    "rpn_target_assign",
+    "polygon_box_transform",
+    "roi_perspective_transform",
+    "psroi_pool",
 ]
+
+
+def _expand_ratios_static(ratios, flip):
+    # must agree EXACTLY with lower_prior_box's expansion: same function
+    from ..ops.detection_ops import _expand_aspect_ratios
+
+    return _expand_aspect_ratios(ratios, flip)
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
@@ -185,5 +207,439 @@ def box_clip(input, im_info, name=None):
         "box_clip",
         inputs={"Input": [input], "ImInfo": [im_info]},
         outputs={"Output": [out]},
+    )
+    return out
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """Assign per-prior targets from matched gt rows (reference
+    layers/detection.py target_assign / target_assign_op.h).  Dense idiom:
+    input [N, G, K] (or [N, G, P, K]), matched_indices [N, P],
+    negative_indices a dense [N, P] 0/1 mask.  Returns (out, out_weight)."""
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_wt = helper.create_variable_for_type_inference("float32")
+    inputs = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        inputs["NegIndices"] = [negative_indices]
+    helper.append_op(
+        "target_assign",
+        inputs=inputs,
+        outputs={"Out": [out], "OutWeight": [out_wt]},
+        attrs={"mismatch_value": mismatch_value},
+    )
+    out.stop_gradient = True
+    out_wt.stop_gradient = True
+    return out, out_wt
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None,
+             gt_count=None):
+    """SSD multibox loss (reference layers/detection.py:779 ssd_loss —
+    the same 5-step composition, over dense padded gt).
+
+    Dense idiom: gt_box [N, G, 4] / gt_label [N, G] padded; `gt_count`
+    [N] gives the valid prefix per image (padded rows are masked out of
+    matching).  location [N, P, 4], confidence [N, P, C],
+    prior_box/prior_box_var [P, 4].  Returns [N, 1] loss.
+    """
+    from . import nn, tensor
+
+    if mining_type != "max_negative":
+        raise ValueError("Only mining_type == 'max_negative' is supported")
+    num_prior = location.shape[-2]
+    num_class = confidence.shape[-1]
+    g = gt_box.shape[1]
+
+    # 1. IoU between every gt and every prior, per image: [N, G, P]
+    flat_gt = tensor.reshape(gt_box, [-1, 4])
+    iou = iou_similarity(flat_gt, prior_box)              # [N*G, P]
+    iou = tensor.reshape(iou, [-1, g, num_prior])
+    if gt_count is not None:
+        # mask [N, G, 1]: 1 for real gt rows, 0 for padding
+        arange_g = _range_like(gt_box, g)                 # [G] float32
+        cnt = tensor.reshape(tensor.cast(gt_count, "float32"), [-1, 1])
+        valid = tensor.cast(
+            tensor.less_than(tensor.reshape(arange_g, [1, g]), cnt),
+            "float32")
+        valid = tensor.reshape(valid, [-1, g, 1])
+        # padded gt rows must fall below the matcher's -1e9 exhaustion
+        # threshold so they can never be matched (even after all real gts
+        # are claimed) — their box_coder encodings contain log(0) = -inf
+        penalty = tensor.scale(valid, scale=1e10, bias=-1e10)  # 0 or -1e10
+        iou = tensor.elementwise_add(
+            tensor.elementwise_mul(iou, valid), penalty)
+    matched_indices, matched_dist = bipartite_match(
+        iou, match_type, overlap_threshold)               # [N, P]
+
+    # 2. conf loss for mining
+    gt_label3 = tensor.reshape(tensor.cast(gt_label, "float32"), [-1, g, 1])
+    target_label, _ = target_assign(gt_label3, matched_indices,
+                                    mismatch_value=background_label)
+    conf2d = tensor.reshape(confidence, [-1, num_class])
+    tl2d = tensor.reshape(tensor.cast(target_label, "int64"), [-1, 1])
+    tl2d.stop_gradient = True
+    conf_loss = nn.softmax_with_cross_entropy(conf2d, tl2d)
+    conf_loss = tensor.reshape(conf_loss, [-1, num_prior])
+    conf_loss.stop_gradient = True
+
+    # 3. hard-negative mining
+    helper = LayerHelper("ssd_loss")
+    neg_mask = helper.create_variable_for_type_inference("int32")
+    updated = helper.create_variable_for_type_inference(matched_indices.dtype)
+    helper.append_op(
+        "mine_hard_examples",
+        inputs={"ClsLoss": [conf_loss], "MatchIndices": [matched_indices],
+                "MatchDist": [matched_dist]},
+        outputs={"NegIndices": [neg_mask], "UpdatedMatchIndices": [updated]},
+        attrs={"neg_pos_ratio": neg_pos_ratio,
+               "neg_dist_threshold": neg_overlap,
+               "mining_type": mining_type,
+               "sample_size": sample_size or 0},
+    )
+    neg_mask.stop_gradient = True
+    updated.stop_gradient = True
+
+    # 4. targets: encode gt against priors, gather matched
+    encoded = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
+                        target_box=flat_gt,
+                        code_type="encode_center_size")   # [P, N*G, 4]
+    enc = tensor.transpose(encoded, [1, 0, 2])            # [N*G, P, 4]
+    enc = tensor.reshape(enc, [-1, g, num_prior, 4])      # [N, G, P, 4]
+    target_bbox, target_loc_weight = target_assign(
+        enc, updated, mismatch_value=background_label)
+    target_label, target_conf_weight = target_assign(
+        gt_label3, updated, negative_indices=neg_mask,
+        mismatch_value=background_label)
+
+    # 5. losses
+    tl2d = tensor.reshape(tensor.cast(target_label, "int64"), [-1, 1])
+    tl2d.stop_gradient = True
+    conf_loss = nn.softmax_with_cross_entropy(conf2d, tl2d)
+    conf_w = tensor.reshape(target_conf_weight, [-1, 1])
+    conf_loss = tensor.elementwise_mul(conf_loss, conf_w)
+
+    loc2d = tensor.reshape(location, [-1, 4])
+    tb2d = tensor.reshape(target_bbox, [-1, 4])
+    tb2d.stop_gradient = True
+    loc_loss = nn.smooth_l1(loc2d, tb2d)
+    loc_w = tensor.reshape(target_loc_weight, [-1, 1])
+    loc_loss = tensor.elementwise_mul(loc_loss, loc_w)
+
+    loss = tensor.elementwise_add(
+        tensor.scale(conf_loss, scale=conf_loss_weight),
+        tensor.scale(loc_loss, scale=loc_loss_weight))
+    loss = tensor.reshape(loss, [-1, num_prior])
+    loss = tensor.reduce_sum(loss, dim=1, keep_dim=True)
+    if normalize:
+        normalizer = tensor.scale(tensor.reduce_sum(target_loc_weight),
+                                  bias=1e-6)
+        loss = tensor.elementwise_div(loss, tensor.reshape(normalizer, [1]))
+    return loss
+
+
+def _range_like(ref_var, n):
+    """[0..n) as a float32 graph constant."""
+    from . import tensor
+    import numpy as np
+
+    return tensor.assign(np.arange(n, dtype="float32"))
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """Decode predictions + multiclass NMS (reference
+    layers/detection.py:201 detection_output).  loc [N, P, 4] deltas,
+    scores [N, P, C] logits.  Returns (out [N, keep_top_k, 6], counts)."""
+    from . import nn, tensor
+
+    decoded = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
+                        target_box=loc,
+                        code_type="decode_center_size")   # [N, P, 4]
+    probs = nn.softmax(scores)                            # [N, P, C]
+    probs_t = tensor.transpose(probs, [0, 2, 1])          # [N, C, P]
+    return multiclass_nms(
+        bboxes=decoded, scores=probs_t, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, background_label=background_label,
+        return_rois_num=True)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=None, flip=True, clip=False,
+                   kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD prediction heads over a feature pyramid (reference
+    layers/detection.py:1259 multi_box_head): per feature map, conv loc
+    [P*4] + conf [P*C] heads and prior boxes; concat across maps.
+    Returns (mbox_locs [N, P, 4], mbox_confs [N, P, C],
+    boxes [P, 4], variances [P, 4])."""
+    from . import nn, tensor
+
+    variance = variance or [0.1, 0.1, 0.2, 0.2]
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule (detection.py:1397-1410)
+        min_sizes, max_sizes = [], []
+        step = int(math.floor((max_ratio - min_ratio) / (n_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        mins = [mins] if not isinstance(mins, (list, tuple)) else list(mins)
+        maxs = ([maxs] if maxs and not isinstance(maxs, (list, tuple))
+                else (list(maxs) if maxs else None))
+        ar = [ar] if not isinstance(ar, (list, tuple)) else list(ar)
+        if steps:
+            layer_steps = (list(steps[i])
+                           if isinstance(steps[i], (list, tuple))
+                           else [float(steps[i])] * 2)
+        elif step_w or step_h:
+            layer_steps = [step_w[i] if step_w else 0.0,
+                           step_h[i] if step_h else 0.0]
+        else:
+            layer_steps = None
+        box, var = prior_box(
+            feat, image, mins, maxs, ar, variance, flip, clip,
+            layer_steps, offset, None,
+            min_max_aspect_ratios_order)
+        # [H, W, P, 4] -> [H*W*P, 4]
+        box = tensor.reshape(box, [-1, 4])
+        var = tensor.reshape(var, [-1, 4])
+        # priors per cell, statically (mirrors lower_prior_box's spec)
+        expanded = _expand_ratios_static(ar, flip)
+        num_priors_per_cell = len(mins) * len(expanded) + (
+            min(len(mins), len(maxs)) if maxs else 0)
+        num_px = num_priors_per_cell * feat.shape[2] * feat.shape[3]
+
+        loc = nn.conv2d(feat, num_filters=num_priors_per_cell * 4,
+                        filter_size=kernel_size, padding=pad, stride=stride)
+        loc = tensor.transpose(loc, [0, 2, 3, 1])        # NHWC
+        loc = tensor.reshape(loc, [-1, num_px, 4])
+        conf = nn.conv2d(feat, num_filters=num_priors_per_cell * num_classes,
+                         filter_size=kernel_size, padding=pad, stride=stride)
+        conf = tensor.transpose(conf, [0, 2, 3, 1])
+        conf = tensor.reshape(conf, [-1, num_px, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(box)
+        vars_all.append(var)
+
+    mbox_locs = tensor.concat(locs, axis=1)
+    mbox_confs = tensor.concat(confs, axis=1)
+    boxes = tensor.concat(boxes_all, axis=0)
+    variances = tensor.concat(vars_all, axis=0)
+    boxes.stop_gradient = True
+    variances.stop_gradient = True
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=None, clip=False, steps=None, offset=0.5,
+                      name=None):
+    """Densified prior boxes (reference layers/detection.py:1133,
+    density_prior_box_op.h)."""
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {
+        "densities": [int(d) for d in densities],
+        "fixed_sizes": [float(s) for s in fixed_sizes],
+        "fixed_ratios": [float(r) for r in fixed_ratios],
+        "variances": variance or [0.1, 0.1, 0.2, 0.2],
+        "clip": clip,
+        "offset": offset,
+    }
+    if steps:
+        attrs["step_w"], attrs["step_h"] = float(steps[0]), float(steps[1])
+    helper.append_op(
+        "density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs=attrs,
+    )
+    boxes.stop_gradient = True
+    var.stop_gradient = True
+    return boxes, var
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral"):
+    """Single-shot mAP metric (reference layers/detection.py:515,
+    detection_map_op.cc).  Dense idiom: detect_res [N, D, 6] padded with
+    label -1 (multiclass_nms output), label [N, G, 6]."""
+    helper = LayerHelper("detection_map")
+    m_ap = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "detection_map",
+        inputs={"DetectRes": [detect_res], "Label": [label]},
+        outputs={"MAP": [m_ap]},
+        attrs={"class_num": class_num,
+               "background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version},
+    )
+    m_ap.shape = (1,)
+    return m_ap
+
+
+def yolov3_loss(x, gtbox, gtlabel, anchors, class_num, ignore_thresh,
+                loss_weight_xy=1.0, loss_weight_wh=1.0,
+                loss_weight_conf_target=1.0, loss_weight_conf_notarget=1.0,
+                loss_weight_class=1.0, name=None):
+    """YOLOv3 loss (reference layers/detection.py yolov3_loss,
+    yolov3_loss_op.h).  x [N, A*(5+C), H, W]; gtbox [N, B, 4] normalized
+    cx/cy/w/h (zero rows = padding); gtlabel [N, B]."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "yolov3_loss",
+        inputs={"X": [x], "GTBox": [gtbox], "GTLabel": [gtlabel]},
+        outputs={"Loss": [loss]},
+        attrs={
+            "anchors": [float(a) for a in anchors],
+            "class_num": class_num,
+            "ignore_thresh": ignore_thresh,
+            "loss_weight_xy": loss_weight_xy,
+            "loss_weight_wh": loss_weight_wh,
+            "loss_weight_conf_target": loss_weight_conf_target,
+            "loss_weight_conf_notarget": loss_weight_conf_notarget,
+            "loss_weight_class": loss_weight_class,
+        },
+    )
+    loss.shape = (1,)
+    return loss
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances=None,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """RPN proposals (reference layers/detection.py generate_proposals,
+    generate_proposals_op.cc).  Dense: returns (rois [N, post, 4],
+    roi_probs [N, post, 1], rois_num [N])."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference("float32")
+    probs = helper.create_variable_for_type_inference("float32")
+    num = helper.create_variable_for_type_inference("int32")
+    inputs = {"Scores": [scores], "BboxDeltas": [bbox_deltas],
+              "ImInfo": [im_info], "Anchors": [anchors]}
+    if variances is not None:
+        inputs["Variances"] = [variances]
+    helper.append_op(
+        "generate_proposals",
+        inputs=inputs,
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                 "RpnRoisNum": [num]},
+        attrs={"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size},
+    )
+    for v in (rois, probs, num):
+        v.stop_gradient = True
+    return rois, probs, num
+
+
+def rpn_target_assign(anchor_box, gt_boxes, im_info=None, is_crowd=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=False,
+                      name=None):
+    """RPN anchor sampling (reference layers/detection.py
+    rpn_target_assign, rpn_target_assign_op.cc).  Dense: returns
+    (target_label [N, A] with 1/0/-1, target_bbox [N, A, 4],
+    bbox_inside_weight [N, A, 1])."""
+    if use_random:
+        raise NotImplementedError(
+            "rpn_target_assign: use_random sampling is not supported under "
+            "jit; subsampling is deterministic (top-IoU fg, first bg)")
+    helper = LayerHelper("rpn_target_assign", name=name)
+    label = helper.create_variable_for_type_inference("int32")
+    tbox = helper.create_variable_for_type_inference("float32")
+    inw = helper.create_variable_for_type_inference("float32")
+    inputs = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]}
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info]
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd]
+    helper.append_op(
+        "rpn_target_assign",
+        inputs=inputs,
+        outputs={"TargetLabel": [label], "TargetBBox": [tbox],
+                 "BBoxInsideWeight": [inw]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "rpn_straddle_thresh": rpn_straddle_thresh},
+    )
+    for v in (label, tbox, inw):
+        v.stop_gradient = True
+    return label, tbox, inw
+
+
+def polygon_box_transform(input, name=None):
+    """EAST geometry map -> absolute quad coordinates (reference
+    polygon_box_transform_op.cc)."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("polygon_box_transform", inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    out.shape = input.shape
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              batch_idx=None, name=None):
+    """Warp quad ROIs to rectangles (reference
+    roi_perspective_transform_op.cc).  rois [R, 8] quads."""
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if batch_idx is not None:
+        inputs["BatchIdx"] = [batch_idx]
+    helper.append_op(
+        "roi_perspective_transform",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale},
+    )
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, batch_idx=None, name=None):
+    """Position-sensitive ROI pooling (reference psroi_pool_op.h)."""
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if batch_idx is not None:
+        inputs["BatchIdx"] = [batch_idx]
+    helper.append_op(
+        "psroi_pool",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"output_channels": output_channels,
+               "spatial_scale": spatial_scale,
+               "pooled_height": pooled_height,
+               "pooled_width": pooled_width},
     )
     return out

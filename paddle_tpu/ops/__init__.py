@@ -17,4 +17,5 @@ from . import (  # noqa: F401
     detection_ops,
     ctc_ops,
     image_ops,
+    rcnn_ops,
 )

@@ -108,10 +108,7 @@ def lower_box_coder(ctx, ins):
     norm = ctx.attr("box_normalized", True)
     one = 0.0 if norm else 1.0
 
-    pw = prior[:, 2] - prior[:, 0] + one
-    ph = prior[:, 3] - prior[:, 1] + one
-    pcx = prior[:, 0] + pw * 0.5
-    pcy = prior[:, 1] + ph * 0.5
+    pcx, pcy, pw, ph = _center_size(prior, one)
     if pvar is not None:
         pvar = pvar.reshape(-1, 4)
         v0, v1, v2, v3 = pvar[:, 0], pvar[:, 1], pvar[:, 2], pvar[:, 3]
@@ -120,10 +117,7 @@ def lower_box_coder(ctx, ins):
 
     if code_type.lower().startswith("encode"):
         t = target.reshape(-1, 4)  # [M, 4] gt boxes
-        tw = t[:, 2] - t[:, 0] + one
-        th = t[:, 3] - t[:, 1] + one
-        tcx = t[:, 0] + tw * 0.5
-        tcy = t[:, 1] + th * 0.5
+        tcx, tcy, tw, th = _center_size(t, one)
         # out[i, j] = encoding of target j against prior i
         out = jnp.stack([
             (tcx[None, :] - pcx[:, None]) / pw[:, None] / _col(v0),
@@ -149,6 +143,17 @@ def lower_box_coder(ctx, ins):
 def _col(v):
     jnp = _jnp()
     return v[:, None] if hasattr(v, "ndim") and v.ndim == 1 else v
+
+
+def _center_size(boxes, one):
+    """ltrb [..., 4] -> (cx, cy, w, h); `one` is the +1 pixel convention
+    (0.0 for normalized coords).  The single source of truth for every
+    box codec (box_coder, generate_proposals, rpn_target_assign)."""
+    w = boxes[..., 2] - boxes[..., 0] + one
+    h = boxes[..., 3] - boxes[..., 1] + one
+    cx = boxes[..., 0] + w * 0.5
+    cy = boxes[..., 1] + h * 0.5
+    return cx, cy, w, h
 
 
 def _iou_matrix(a, b, norm=True):
@@ -187,39 +192,44 @@ def lower_bipartite_match(ctx, ins):
 
     jnp = _jnp()
     sim = ins["DistMat"][0]
-    if sim.ndim == 3:
-        sim = sim[0]
-    m, n = sim.shape
+    batched = sim.ndim == 3
+    if not batched:
+        sim = sim[None]                       # [1, M, N]
+    m, n = sim.shape[1], sim.shape[2]
     match_type = ctx.attr("match_type", "bipartite")
     thresh = ctx.attr("dist_threshold", 0.5)
 
-    def body(_, carry):
-        s, col_row, col_dist = carry
-        idx = jnp.argmax(s)
-        r, c = idx // n, idx % n
-        best = s[r, c]
-        do = best > -1e9
-        col_row = jnp.where(
-            do & (jnp.arange(n) == c), r.astype(jnp.int64), col_row)
-        col_dist = jnp.where(do & (jnp.arange(n) == c), best, col_dist)
-        s = jnp.where(do & (jnp.arange(m)[:, None] == r), -1e10, s)
-        s = jnp.where(do & (jnp.arange(n)[None, :] == c), -1e10, s)
-        return s, col_row, col_dist
+    def match_one(s0):
+        def body(_, carry):
+            s, col_row, col_dist = carry
+            idx = jnp.argmax(s)
+            r, c = idx // n, idx % n
+            best = s[r, c]
+            do = best > -1e9
+            col_row = jnp.where(
+                do & (jnp.arange(n) == c), r.astype(jnp.int64), col_row)
+            col_dist = jnp.where(do & (jnp.arange(n) == c), best, col_dist)
+            s = jnp.where(do & (jnp.arange(m)[:, None] == r), -1e10, s)
+            s = jnp.where(do & (jnp.arange(n)[None, :] == c), -1e10, s)
+            return s, col_row, col_dist
 
-    col_row = jnp.full((n,), -1, jnp.int64)
-    col_dist = jnp.zeros((n,), jnp.float32)
-    _, col_row, col_dist = jax.lax.fori_loop(
-        0, min(m, n), body, (sim, col_row, col_dist))
+        col_row = jnp.full((n,), -1, jnp.int64)
+        col_dist = jnp.zeros((n,), jnp.float32)
+        _, col_row, col_dist = jax.lax.fori_loop(
+            0, min(m, n), body, (s0, col_row, col_dist))
 
-    if match_type == "per_prediction":
-        best_row = jnp.argmax(sim, axis=0).astype(jnp.int64)
-        best_val = jnp.max(sim, axis=0)
-        extra = (col_row < 0) & (best_val > thresh)
-        col_row = jnp.where(extra, best_row, col_row)
-        col_dist = jnp.where(extra, best_val, col_dist)
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(s0, axis=0).astype(jnp.int64)
+            best_val = jnp.max(s0, axis=0)
+            extra = (col_row < 0) & (best_val > thresh)
+            col_row = jnp.where(extra, best_row, col_row)
+            col_dist = jnp.where(extra, best_val, col_dist)
+        return col_row, col_dist
+
+    col_row, col_dist = jax.vmap(match_one)(sim)   # [B, N]
     return {
-        "ColToRowMatchIndices": [col_row[None, :]],
-        "ColToRowMatchDis": [col_dist[None, :]],
+        "ColToRowMatchIndices": [col_row],
+        "ColToRowMatchDis": [col_dist],
     }
 
 
@@ -459,3 +469,245 @@ def lower_box_clip(ctx, ins):
     if squeeze:
         out = out[0]
     return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# SSD training ops (round 4): target_assign, mine_hard_examples,
+# density_prior_box, detection_map
+# ---------------------------------------------------------------------------
+
+
+@register("target_assign", no_grad=True)
+def lower_target_assign(ctx, ins):
+    """Assign per-prior targets from matched gt rows (reference
+    detection/target_assign_op.h TargetAssignFunctor).
+
+    Dense idiom: X is [N, G, K] (or [N, G, P, K] for per-prior encodings,
+    e.g. box_coder encode output), MatchIndices [N, P] (gt id or -1).
+    Out[n,p] = X[n, match[n,p]] (or X[n, match[n,p], p]); weight 1 for
+    matched, else mismatch_value/0.  Optional NegIndices is a dense [N, P]
+    0/1 mask (the reference's LoD list of negative prior ids): negatives
+    get Out=mismatch_value with weight 1 — that is how background labels
+    enter the conf loss."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    match = ins["MatchIndices"][0].astype(jnp.int32)     # [N, P]
+    mismatch = ctx.attr("mismatch_value", 0)
+    n, p = match.shape
+    safe = jnp.maximum(match, 0)
+    if x.ndim == 4:
+        # [N, G, P, K] -> out[n,p,k] = x[n, match[n,p], p, k] via one
+        # advanced-indexing gather (NOT take_along_axis, whose broadcast
+        # would materialize an O(P^2) [N, P, P, K] intermediate)
+        bi = jnp.arange(n)[:, None]                      # [N, 1]
+        pi = jnp.arange(p)[None, :]                      # [1, P]
+        gathered = x[bi, safe, pi]                       # [N, P, K]
+    else:
+        gathered = jnp.take_along_axis(x, safe[:, :, None], axis=1)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch, gathered.dtype))
+    wt = matched.astype(jnp.float32)
+    if ins.get("NegIndices"):
+        neg = ins["NegIndices"][0].reshape(n, p).astype(bool)
+        out = jnp.where(neg[:, :, None],
+                        jnp.asarray(mismatch, out.dtype), out)
+        wt = jnp.maximum(wt, neg[:, :, None].astype(jnp.float32))
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+@register("mine_hard_examples", no_grad=True)
+def lower_mine_hard_examples(ctx, ins):
+    """Hard-negative mining (reference detection/mine_hard_examples_op.cc).
+
+    max_negative: eligible = unmatched priors with match_dist below
+    neg_dist_threshold; keep the top num_pos*neg_pos_ratio by conf loss.
+    NegIndices is emitted as a dense [N, P] 0/1 mask (reference: LoD id
+    list).  UpdatedMatchIndices == MatchIndices for max_negative."""
+    jnp = _jnp()
+    cls_loss = ins["ClsLoss"][0]                          # [N, P]
+    match = ins["MatchIndices"][0].astype(jnp.int32)      # [N, P]
+    dist = ins["MatchDist"][0] if ins.get("MatchDist") else None
+    ratio = ctx.attr("neg_pos_ratio", 3.0)
+    thresh = ctx.attr("neg_dist_threshold", 0.5)
+    mining = ctx.attr("mining_type", "max_negative")
+    if mining != "max_negative":
+        # the reference's kHardExample additionally demotes unselected
+        # positives in UpdatedMatchIndices; refuse rather than half-do it
+        raise NotImplementedError(
+            "mine_hard_examples: only mining_type='max_negative' is "
+            f"implemented (got {mining!r})")
+
+    loss = cls_loss
+    eligible = match < 0
+    if dist is not None:
+        eligible &= dist < thresh
+    num_pos = jnp.sum((match >= 0).astype(jnp.int32), axis=1)  # [N]
+    num_elig = jnp.sum(eligible.astype(jnp.int32), axis=1)
+    neg_sel = jnp.minimum((num_pos.astype(jnp.float32)
+                           * ratio).astype(jnp.int32), num_elig)
+
+    masked = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1)                  # desc by loss
+    rank = jnp.argsort(order, axis=1)                     # rank per prior
+    neg = eligible & (rank < neg_sel[:, None])
+    return {
+        "NegIndices": [neg.astype(jnp.int32)],
+        "UpdatedMatchIndices": [match],
+    }
+
+
+@register("density_prior_box", no_grad=True)
+def lower_density_prior_box(ctx, ins):
+    """Densified anchors (reference detection/density_prior_box_op.h):
+    for each fixed_size with density d, d*d shifted centers per cell; one
+    box per fixed_ratio.  Outputs Boxes/Variances [H, W, P, 4]."""
+    jnp = _jnp()
+    feat = ins["Input"][0]
+    image = ins["Image"][0]
+    fixed_sizes = [float(s) for s in ctx.attr("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in ctx.attr("fixed_ratios", [1.0])]
+    densities = [int(d) for d in ctx.attr("densities", [])]
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    clip = ctx.attr("clip", False)
+    offset = ctx.attr("offset", 0.5)
+    img_h, img_w = image.shape[2], image.shape[3]
+    fh, fw = feat.shape[2], feat.shape[3]
+    step_w = ctx.attr("step_w", 0.0) or img_w / fw
+    step_h = ctx.attr("step_h", 0.0) or img_h / fh
+
+    # per-cell (dx, dy, w/2, h/2) tuples, static; the shift grid is laid
+    # out on step_average for BOTH axes (density_prior_box_op.h:65-87)
+    step_avg = int((step_w + step_h) * 0.5)
+    cells = []
+    for size, density in zip(fixed_sizes, densities):
+        shift = step_avg / density
+        for r in fixed_ratios:
+            bw = size * math.sqrt(r) / 2.0
+            bh = size / math.sqrt(r) / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    dx = (dj + 0.5) * shift - step_avg * 0.5
+                    dy = (di + 0.5) * shift - step_avg * 0.5
+                    cells.append((dx, dy, bw, bh))
+    spec = jnp.asarray(cells, jnp.float32)                # [P, 4]
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    pnum = spec.shape[0]
+    ccx = cx[None, :, None] + spec[None, None, :, 0]
+    ccy = cy[:, None, None] + spec[None, None, :, 1]
+    ccx = jnp.broadcast_to(ccx, (fh, fw, pnum))
+    ccy = jnp.broadcast_to(ccy, (fh, fw, pnum))
+    bw = spec[None, None, :, 2]
+    bh = spec[None, None, :, 3]
+    boxes = jnp.stack(
+        [(ccx - bw) / img_w, (ccy - bh) / img_h,
+         (ccx + bw) / img_w, (ccy + bh) / img_h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register("detection_map", no_grad=True)
+def lower_detection_map(ctx, ins):
+    """Mean average precision (reference detection/detection_map_op.cc,
+    integral + 11point).  Dense idiom: DetectRes [N, D, 6] rows
+    (label, score, x1, y1, x2, y2) padded with label=-1; Label [N, G, 6]
+    rows (label, x1, y1, x2, y2, difficult) padded with label=-1.
+    Single-shot evaluation (the reference's streaming PosCount/TruePos
+    accumulation is served by CheckpointManager-style host metrics)."""
+    import jax
+
+    jnp = _jnp()
+    det = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    overlap_t = ctx.attr("overlap_threshold", 0.5)
+    ap_type = ctx.attr("ap_type", "integral")
+    class_num = ctx.attr("class_num")
+    evaluate_difficult = ctx.attr("evaluate_difficult", True)
+    n, d_max, _ = det.shape
+    g_max = gt.shape[1]
+
+    if gt.shape[2] >= 6:
+        difficult = gt[:, :, 5] > 0.5
+    else:
+        difficult = jnp.zeros(gt.shape[:2], bool)
+    gt_valid = gt[:, :, 0] >= 0
+    det_valid = det[:, :, 0] >= 0
+
+    # [N, D, G] IoU between detections and gts of the same image
+    def img_iou(db, gb):
+        return _iou_matrix(db, gb, True)
+
+    ious = jax.vmap(img_iou)(det[:, :, 2:6], gt[:, :, 1:5])
+
+    def ap_for_class(c):
+        c_gt = gt_valid & (gt[:, :, 0].astype(jnp.int32) == c)
+        if not evaluate_difficult:
+            npos = jnp.sum((c_gt & ~difficult).astype(jnp.int32))
+        else:
+            npos = jnp.sum(c_gt.astype(jnp.int32))
+        c_det = det_valid & (det[:, :, 0].astype(jnp.int32) == c)
+        scores = jnp.where(c_det, det[:, :, 1], -jnp.inf)  # [N, D]
+
+        # greedy per-image match: detection (desc score) claims the best
+        # unclaimed same-class gt at IoU strictly > threshold (reference
+        # detection_map_op.h overlap > threshold); with
+        # evaluate_difficult=False, difficult gts are not claimable at all
+        # (the reference leaves them unvisited)
+        def match_image(sc, iou_im, gts, diff):
+            order = jnp.argsort(-sc)
+            claimable = gts if evaluate_difficult else (gts & ~diff)
+
+            def body(i, carry):
+                claimed, tp, fp = carry
+                di = order[i]
+                valid = sc[di] > -jnp.inf
+                cand = jnp.where(claimable & ~claimed, iou_im[di], -1.0)
+                best = jnp.argmax(cand)
+                ok = (cand[best] > overlap_t) & valid
+                claimed = claimed | (ok & (jnp.arange(g_max) == best))
+                tp = tp.at[di].set(jnp.where(valid & ok, 1.0, 0.0))
+                fp = fp.at[di].set(
+                    jnp.where(valid & ~ok, 1.0, 0.0))
+                return claimed, tp, fp
+
+            claimed0 = jnp.zeros((g_max,), bool)
+            tp0 = jnp.zeros((d_max,), jnp.float32)
+            fp0 = jnp.zeros((d_max,), jnp.float32)
+            _, tp, fp = jax.lax.fori_loop(0, d_max, body,
+                                          (claimed0, tp0, fp0))
+            return tp, fp
+
+        tp, fp = jax.vmap(match_image)(scores, ious, c_gt, difficult)
+        flat_scores = scores.reshape(-1)
+        order = jnp.argsort(-flat_scores)
+        tp_s = jnp.take(tp.reshape(-1), order)
+        fp_s = jnp.take(fp.reshape(-1), order)
+        tp_c = jnp.cumsum(tp_s)
+        fp_c = jnp.cumsum(fp_s)
+        prec = tp_c / jnp.maximum(tp_c + fp_c, 1e-10)
+        rec = tp_c / jnp.maximum(npos.astype(jnp.float32), 1e-10)
+        active = jnp.take(flat_scores, order) > -jnp.inf
+        if ap_type == "11point":
+            pts = jnp.linspace(0.0, 1.0, 11)
+            pmax = jax.vmap(
+                lambda t: jnp.max(jnp.where((rec >= t) & active, prec, 0.0))
+            )(pts)
+            ap = jnp.mean(pmax)
+        else:
+            d_rec = jnp.diff(rec, prepend=0.0)
+            ap = jnp.sum(jnp.where(active, prec * d_rec, 0.0))
+        return ap, npos > 0
+
+    # one traced ap_for_class, vmapped over the class axis (a Python loop
+    # would duplicate the whole greedy-match subgraph class_num times)
+    bg = ctx.attr("background_label", 0)
+    classes = jnp.arange(class_num)
+    aps, has = jax.vmap(ap_for_class)(classes)
+    has = has.astype(jnp.float32) * (classes != bg).astype(jnp.float32)
+    m_ap = jnp.sum(aps * has) / jnp.maximum(jnp.sum(has), 1.0)
+    return {"MAP": [m_ap.reshape((1,))]}

@@ -1,0 +1,437 @@
+"""RCNN / YOLO / OCR detection ops: yolov3_loss, generate_proposals,
+rpn_target_assign, polygon_box_transform, roi_perspective_transform,
+psroi_pool.
+
+Reference: paddle/fluid/operators/yolov3_loss_op.h,
+operators/detection/{generate_proposals,rpn_target_assign,
+polygon_box_transform,roi_perspective_transform}_op.cc,
+operators/psroi_pool_op.h.  TPU-first: every per-image C++ loop becomes a
+vmapped static-shape computation; ragged outputs (proposal lists, sampled
+anchor index lists) become fixed-size tensors padded/masked with counts —
+the same dense idiom as multiclass_nms.
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _xywh_iou(wh1, wh2):
+    """IoU of boxes centered at origin, given [.., 2] width/height."""
+    jnp = _jnp()
+    inter = (jnp.minimum(wh1[..., 0], wh2[..., 0])
+             * jnp.minimum(wh1[..., 1], wh2[..., 1]))
+    union = (wh1[..., 0] * wh1[..., 1] + wh2[..., 0] * wh2[..., 1]
+             - inter)
+    return inter / (union + 1e-10)
+
+
+@register("yolov3_loss")
+def lower_yolov3_loss(ctx, ins):
+    """YOLOv3 multi-part loss (reference yolov3_loss_op.h:330-392):
+    sigmoid xy + raw wh MSE on the responsible anchor cell, BCE on
+    objectness (target + ignore-thresholded no-target) and classes;
+    each part mean-normalized over its mask's point count.
+
+    X: [N, A*(5+C), H, W]; GTBox: [N, B, 4] (cx, cy, w, h, normalized,
+    all-zero rows = padding); GTLabel: [N, B] int."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    gt_box = ins["GTBox"][0].astype(jnp.float32)
+    gt_label = ins["GTLabel"][0].astype(jnp.int32)
+    anchors = [float(a) for a in ctx.attr("anchors")]
+    class_num = ctx.attr("class_num")
+    ignore_thresh = ctx.attr("ignore_thresh", 0.7)
+    w_xy = ctx.attr("loss_weight_xy", 1.0)
+    w_wh = ctx.attr("loss_weight_wh", 1.0)
+    w_ct = ctx.attr("loss_weight_conf_target", 1.0)
+    w_cn = ctx.attr("loss_weight_conf_notarget", 1.0)
+    w_cls = ctx.attr("loss_weight_class", 1.0)
+
+    n, _, h, w = x.shape
+    an_num = len(anchors) // 2
+    attrs = 5 + class_num
+    xr = x.reshape(n, an_num, attrs, h, w)
+    pred_x = jax.nn.sigmoid(xr[:, :, 0])
+    pred_y = jax.nn.sigmoid(xr[:, :, 1])
+    pred_w = xr[:, :, 2]
+    pred_h = xr[:, :, 3]
+    pred_conf = jax.nn.sigmoid(xr[:, :, 4])
+    pred_cls = jax.nn.sigmoid(xr[:, :, 5:].transpose(0, 1, 3, 4, 2))
+
+    anc = jnp.asarray(anchors, jnp.float32).reshape(an_num, 2)
+    b = gt_box.shape[1]
+    valid = jnp.any(jnp.abs(gt_box) >= 1e-6, axis=2)      # [N, B]
+    gx = gt_box[..., 0] * w
+    gy = gt_box[..., 1] * h
+    gw = gt_box[..., 2] * w
+    gh = gt_box[..., 3] * h
+    gi = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+    gwh = jnp.stack([gw, gh], axis=-1)                    # [N, B, 2]
+    iou_a = _xywh_iou(gwh[:, :, None, :], anc[None, None])  # [N, B, A]
+    best = jnp.argmax(iou_a, axis=2)                      # [N, B]
+
+    # scatter per-gt targets into [N, A, H, W] maps
+    bi = jnp.broadcast_to(jnp.arange(n)[:, None], (n, b)).reshape(-1)
+    flat = lambda t: t.reshape(-1)
+    vb, bb_, gjf, gif = flat(valid), flat(best), flat(gj), flat(gi)
+    # route padded gts to a scratch cell (w index = w, sliced off)
+    scratch_w = jnp.where(vb, gif, w)
+    obj = jnp.zeros((n, an_num, h, w + 1), jnp.float32)
+    obj = obj.at[bi, bb_, gjf, scratch_w].set(1.0)
+    obj_mask = obj[..., :w]
+
+    def scatter(vals):
+        z = jnp.zeros((n, an_num, h, w + 1), jnp.float32)
+        return z.at[bi, bb_, gjf, scratch_w].set(flat(vals))[..., :w]
+
+    tx = scatter(gx - gi)
+    ty = scatter(gy - gj)
+    anc_best = anc[best]                                  # [N, B, 2]
+    tw = scatter(jnp.log(jnp.maximum(gw / anc_best[..., 0], 1e-9)))
+    th = scatter(jnp.log(jnp.maximum(gh / anc_best[..., 1], 1e-9)))
+    tcls = jnp.zeros((n, an_num, h, w + 1, class_num), jnp.float32)
+    tcls = tcls.at[bi, bb_, gjf, scratch_w,
+                   flat(gt_label)].set(1.0)[:, :, :, :w]
+
+    # noobj: start at 1, clear every anchor over ignore_thresh at the gt
+    # cell, and the responsible anchor
+    noobj = jnp.ones((n, an_num, h, w + 1), jnp.float32)
+    over = iou_a > ignore_thresh                          # [N, B, A]
+    for a_idx in range(an_num):
+        sel = flat(over[:, :, a_idx])
+        wpos = jnp.where(vb & sel, gif, w)
+        noobj = noobj.at[bi, a_idx, gjf, wpos].set(0.0)
+    noobj = noobj.at[bi, bb_, gjf, scratch_w].set(0.0)
+    noobj_mask = noobj[..., :w]
+
+    def mse(p, t, m):
+        cnt = jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.sum(jnp.square(p - t) * m) / cnt
+
+    def bce(p, t, m):
+        p = jnp.clip(p, 1e-7, 1.0 - 1e-7)
+        cnt = jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.sum(-(t * jnp.log(p) + (1 - t) * jnp.log(1 - p)) * m) / cnt
+
+    obj5 = obj_mask[..., None]
+    loss = (w_xy * (mse(pred_x, tx, obj_mask) + mse(pred_y, ty, obj_mask))
+            + w_wh * (mse(pred_w, tw, obj_mask) + mse(pred_h, th, obj_mask))
+            + w_ct * bce(pred_conf, obj_mask, obj_mask)
+            + w_cn * bce(pred_conf, obj_mask, noobj_mask)
+            + w_cls * bce(pred_cls, tcls,
+                          jnp.broadcast_to(obj5, tcls.shape)))
+    return {"Loss": [loss.reshape((1,))]}
+
+
+def _decode_xywh(anchors, deltas, variances=None):
+    """anchor ltrb [A,4] + deltas [A,4] -> ltrb boxes (generate_proposals
+    box decoding, detection/generate_proposals_op.cc BoxCoder)."""
+    jnp = _jnp()
+    from .detection_ops import _center_size
+
+    acx, acy, aw, ah = _center_size(anchors, 1.0)
+    if variances is not None:
+        deltas = deltas * variances
+    dcx = deltas[:, 0] * aw + acx
+    dcy = deltas[:, 1] * ah + acy
+    dw = jnp.exp(jnp.minimum(deltas[:, 2], 10.0)) * aw
+    dh = jnp.exp(jnp.minimum(deltas[:, 3], 10.0)) * ah
+    return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                      dcx + dw * 0.5 - 1.0, dcy + dh * 0.5 - 1.0], axis=1)
+
+
+@register("generate_proposals", no_grad=True)
+def lower_generate_proposals(ctx, ins):
+    """RPN proposal generation (reference
+    detection/generate_proposals_op.cc): top pre_nms_topN scored anchors,
+    decode deltas, clip to image, filter min_size, NMS, keep
+    post_nms_topN.  Dense out: RpnRois [N, post, 4] + RpnRoiProbs
+    [N, post, 1] + RpnRoisNum [N] (LoD in the reference)."""
+    import jax
+
+    jnp = _jnp()
+    scores = ins["Scores"][0]        # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]    # [N, A*4, H, W]
+    im_info = ins["ImInfo"][0]       # [N, 3] (h, w, scale)
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    variances = ins.get("Variances", [None])[0]
+    if variances is not None:
+        variances = variances.reshape(-1, 4)
+    pre_n = ctx.attr("pre_nms_topN", 6000)
+    post_n = ctx.attr("post_nms_topN", 1000)
+    nms_thresh = ctx.attr("nms_thresh", 0.7)
+    min_size = ctx.attr("min_size", 0.1)
+
+    n, a, h, w = scores.shape
+    total = a * h * w
+    pre_n = min(pre_n, total)
+    post_n = min(post_n, pre_n)
+    sc = scores.transpose(0, 2, 3, 1).reshape(n, -1)       # [N, HWA]
+    dl = (deltas.reshape(n, a, 4, h, w).transpose(0, 3, 4, 1, 2)
+          .reshape(n, -1, 4))                              # [N, HWA, 4]
+    # anchor_generator emits [H, W, A, 4]; flattened [-1, 4] is already
+    # HWA-ordered, matching the score/delta flattening above
+    anc = anchors
+
+    from .detection_ops import _iou_matrix
+
+    def one(sci, dli, info):
+        vals, idx = jax.lax.top_k(sci, pre_n)
+        boxes = _decode_xywh(jnp.take(anc, idx, axis=0),
+                             jnp.take(dli, idx, axis=0),
+                             None if variances is None
+                             else jnp.take(variances, idx, axis=0))
+        ih, iw = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, iw - 1),
+            jnp.clip(boxes[:, 1], 0, ih - 1),
+            jnp.clip(boxes[:, 2], 0, iw - 1),
+            jnp.clip(boxes[:, 3], 0, ih - 1)], axis=1)
+        ms = min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1.0 >= ms)
+                & (boxes[:, 3] - boxes[:, 1] + 1.0 >= ms))
+        vals = jnp.where(keep, vals, -1.0)
+        iou = _iou_matrix(boxes, boxes, False)
+
+        def body(i, alive):
+            sup = (iou[i] > nms_thresh) & (jnp.arange(pre_n) > i) & alive[i]
+            return alive & ~sup
+
+        alive = jax.lax.fori_loop(0, pre_n, body, vals > -1.0)
+        vals = jnp.where(alive, vals, -1.0)
+        top_vals, top_idx = jax.lax.top_k(vals, post_n)
+        out_boxes = jnp.take(boxes, top_idx, axis=0)
+        cnt = jnp.sum((top_vals > -1.0).astype(jnp.int32))
+        return out_boxes, top_vals[:, None], cnt
+
+    rois, probs, counts = jax.vmap(one)(sc, dl, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs],
+            "RpnRoisNum": [counts]}
+
+
+@register("rpn_target_assign", no_grad=True)
+def lower_rpn_target_assign(ctx, ins):
+    """Anchor sampling for RPN training (reference
+    detection/rpn_target_assign_op.cc).  Dense idiom: instead of the
+    reference's index lists (ScoreIndex/LocationIndex), emit per-anchor
+    label maps + weights: TargetLabel [N, A] (1 fg / 0 bg / -1 ignore),
+    TargetBBox [N, A, 4] encoded deltas, BBoxInsideWeight [N, A, 1].
+    Subsampling to rpn_batch_size_per_im keeps the highest-IoU fgs and
+    (deterministically; use_random unsupported under jit) the first bgs."""
+    import jax
+
+    jnp = _jnp()
+    from .detection_ops import _iou_matrix
+
+    anchor = ins["Anchor"][0].reshape(-1, 4)               # [A, 4]
+    gt = ins["GtBoxes"][0]                                 # [N, G, 4]
+    im_info = ins.get("ImInfo", [None])[0]                 # [N, 3]
+    is_crowd = ins.get("IsCrowd", [None])[0]               # [N, G] 0/1
+    batch = ctx.attr("rpn_batch_size_per_im", 256)
+    fg_frac = ctx.attr("rpn_fg_fraction", 0.5)
+    pos_th = ctx.attr("rpn_positive_overlap", 0.7)
+    neg_th = ctx.attr("rpn_negative_overlap", 0.3)
+    straddle = ctx.attr("rpn_straddle_thresh", 0.0)
+    a = anchor.shape[0]
+    g = gt.shape[1]
+    fg_max = int(batch * fg_frac)
+
+    def one(gt_i, info_i, crowd_i):
+        valid = jnp.any(jnp.abs(gt_i) >= 1e-6, axis=1)     # [G]
+        if crowd_i is not None:
+            # crowd gts never produce fg anchors (reference
+            # rpn_target_assign_op.cc FilterStraddleAnchor/crowd handling)
+            valid &= crowd_i < 0.5
+        iou = _iou_matrix(gt_i, anchor, True)              # [G, A]
+        iou = jnp.where(valid[:, None], iou, -1.0)
+        if info_i is not None and straddle >= 0:
+            # anchors straddling the image boundary beyond the threshold
+            # are excluded from sampling entirely (label -1)
+            ih, iw = info_i[0], info_i[1]
+            inside = ((anchor[:, 0] >= -straddle)
+                      & (anchor[:, 1] >= -straddle)
+                      & (anchor[:, 2] < iw + straddle)
+                      & (anchor[:, 3] < ih + straddle))
+        else:
+            inside = jnp.ones((a,), bool)
+        iou = jnp.where(inside[None, :], iou, -1.0)
+        best_per_anchor = jnp.max(iou, axis=0)             # [A]
+        best_gt = jnp.argmax(iou, axis=0)                  # [A]
+        # fg: IoU > pos_th, plus the best anchor for each gt
+        fg = best_per_anchor >= pos_th
+        best_anchor_per_gt = jnp.argmax(iou, axis=1)       # [G]
+        fg = fg.at[best_anchor_per_gt].set(
+            jnp.where(valid, True, fg[best_anchor_per_gt]))
+        fg = fg & inside
+        bg = (best_per_anchor < neg_th) & ~fg & inside
+        # subsample: keep top-IoU fgs, first bgs
+        fg_rank = jnp.argsort(jnp.argsort(-jnp.where(fg, best_per_anchor,
+                                                     -2.0)))
+        fg = fg & (fg_rank < fg_max)
+        n_fg = jnp.sum(fg.astype(jnp.int32))
+        bg_quota = batch - n_fg
+        bg_rank = jnp.cumsum(bg.astype(jnp.int32)) - 1
+        bg = bg & (bg_rank < bg_quota)
+        label = jnp.where(fg, 1, jnp.where(bg, 0, -1)).astype(jnp.int32)
+        # encoded deltas of the matched gt for fg anchors
+        from .detection_ops import _center_size
+
+        mg = gt_i[best_gt]                                 # [A, 4]
+        acx, acy, aw, ah = _center_size(anchor, 1.0)
+        gcx, gcy, gw, gh = _center_size(mg, 1.0)
+        gw = jnp.maximum(gw, 1e-6)
+        gh = jnp.maximum(gh, 1e-6)
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+        tgt = jnp.where(fg[:, None], tgt, 0.0)
+        inside_w = fg[:, None].astype(jnp.float32)
+        return label, tgt, inside_w
+
+    n_im = gt.shape[0]
+    if im_info is None and is_crowd is None:
+        labels, tgts, inw = jax.vmap(lambda gi: one(gi, None, None))(gt)
+    elif is_crowd is None:
+        labels, tgts, inw = jax.vmap(
+            lambda gi, ii: one(gi, ii, None))(gt, im_info)
+    elif im_info is None:
+        labels, tgts, inw = jax.vmap(
+            lambda gi, ci: one(gi, None, ci))(gt, is_crowd.reshape(n_im, -1))
+    else:
+        labels, tgts, inw = jax.vmap(one)(
+            gt, im_info, is_crowd.reshape(n_im, -1))
+    return {"TargetLabel": [labels], "TargetBBox": [tgts],
+            "BBoxInsideWeight": [inw]}
+
+
+@register("polygon_box_transform", no_grad=True)
+def lower_polygon_box_transform(ctx, ins):
+    """EAST-style geometry map to absolute quad coords (reference
+    detection/polygon_box_transform_op.cc): even channels are x offsets
+    (out = 4*w - in), odd are y offsets (out = 4*h - in)."""
+    jnp = _jnp()
+    x = ins["Input"][0]                                    # [N, C, H, W]
+    n, c, h, w = x.shape
+    ws = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4.0
+    hs = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4.0
+    even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return {"Output": [jnp.where(even, ws - x, hs - x)]}
+
+
+@register("roi_perspective_transform", no_grad=True)
+def lower_roi_perspective_transform(ctx, ins):
+    """Warp quadrilateral ROIs to rectangles (reference
+    detection/roi_perspective_transform_op.cc get_transform_matrix +
+    bilinear_interpolate; in-quad mask zero-fill).  ROIs: [R, 8] quad
+    (x0,y0,..x3,y3); BatchIdx [R] (LoD in the reference)."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]                                        # [N, C, H, W]
+    rois = ins["ROIs"][0].reshape(-1, 8)
+    if ins.get("BatchIdx"):
+        bidx = ins["BatchIdx"][0].reshape(-1).astype(jnp.int32)
+    else:
+        bidx = jnp.zeros((rois.shape[0],), jnp.int32)
+    th_ = ctx.attr("transformed_height")
+    tw_ = ctx.attr("transformed_width")
+    scale = ctx.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one(roi, bi):
+        rx = roi[0::2] * scale
+        ry = roi[1::2] * scale
+        x0, x1, x2, x3 = rx[0], rx[1], rx[2], rx[3]
+        y0, y1, y2, y3 = ry[0], ry[1], ry[2], ry[3]
+        dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+        dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1 + 1e-10
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / (tw_ - 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / (th_ - 1)
+        m3 = (y1 - y0 + m6 * (tw_ - 1) * y1) / (tw_ - 1)
+        m4 = (y3 - y0 + m7 * (th_ - 1) * y3) / (th_ - 1)
+        m0 = (x1 - x0 + m6 * (tw_ - 1) * x1) / (tw_ - 1)
+        m1 = (x3 - x0 + m7 * (th_ - 1) * x3) / (th_ - 1)
+        ow = jnp.arange(tw_, dtype=x.dtype)[None, :]
+        oh = jnp.arange(th_, dtype=x.dtype)[:, None]
+        wq = m6 * ow + m7 * oh + 1.0
+        iw_ = (m0 * ow + m1 * oh + x0) / wq                # src x
+        ih_ = (m3 * ow + m4 * oh + y0) / wq                # src y
+        inb = (iw_ >= -0.5) & (iw_ <= w - 0.5) & \
+              (ih_ >= -0.5) & (ih_ <= h - 0.5)
+        x0i = jnp.floor(iw_)
+        y0i = jnp.floor(ih_)
+        img = x[bi]                                        # [C, H, W]
+
+        def tap(yi, xi):
+            wgt = (1 - jnp.abs(iw_ - xi)) * (1 - jnp.abs(ih_ - yi))
+            ib = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            v = img[:, yc, xc]                             # [C, th, tw]
+            return v * jnp.where(ib, wgt, 0.0)[None]
+
+        out = (tap(y0i, x0i) + tap(y0i, x0i + 1)
+               + tap(y0i + 1, x0i) + tap(y0i + 1, x0i + 1))
+        return out * inb[None].astype(x.dtype)
+
+    out = jax.vmap(one)(rois, bidx)                        # [R, C, th, tw]
+    return {"Out": [out]}
+
+
+@register("psroi_pool", no_grad=False)
+def lower_psroi_pool(ctx, ins):
+    """Position-sensitive ROI pooling (reference psroi_pool_op.h): output
+    channel d at bin (i, j) average-pools input channel (d*ph + i)*pw + j
+    over that bin.  X: [N, O*ph*pw, H, W], ROIs [R, 4] + BatchIdx [R]."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    rois = ins["ROIs"][0].reshape(-1, 4)
+    if ins.get("BatchIdx"):
+        bidx = ins["BatchIdx"][0].reshape(-1).astype(jnp.int32)
+    else:
+        bidx = jnp.zeros((rois.shape[0],), jnp.int32)
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    out_c = ctx.attr("output_channels")
+    scale = ctx.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    samples = 4  # fixed sampling grid per bin (static shapes)
+
+    def one(roi, bi):
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = (jnp.round(roi[2]) + 1.0) * scale
+        y2 = (jnp.round(roi[3]) + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / pw, rh / ph
+        v = x[bi].reshape(out_c, ph, pw, h, w)
+        # one vectorized two-axis gather over a [ph|pw, samples] grid
+        # (not a per-bin Python loop — that unrolls O(O*ph*pw) subgraphs)
+        frac = (jnp.arange(samples) + 0.5) / samples
+        ys = y1 + (jnp.arange(ph)[:, None] + frac[None, :]) * bh  # [ph, S]
+        xs = x1 + (jnp.arange(pw)[:, None] + frac[None, :]) * bw  # [pw, S]
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        t1 = jnp.take_along_axis(
+            v, jnp.broadcast_to(yi[None, :, None, :, None],
+                                (out_c, ph, pw, samples, w)), axis=3)
+        t2 = jnp.take_along_axis(
+            t1, jnp.broadcast_to(xi[None, None, :, None, :],
+                                 (out_c, ph, pw, samples, samples)), axis=4)
+        return jnp.mean(t2, axis=(3, 4))                    # [O, ph, pw]
+
+    out = jax.vmap(one)(rois, bidx)
+    return {"Out": [out]}

@@ -122,11 +122,44 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        from . import imperative as imp
+
+        if imp.enabled():
+            return self._minimize_eager(loss, parameter_list, no_grad_set)
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    def _minimize_eager(self, loss, parameter_list=None, no_grad_set=None):
+        """Dygraph minimize: tape-vjp backward, then the SAME registered
+        optimizer ops — appended under the eager hook they execute
+        immediately, updating parameter values in the session (the
+        reference's dygraph optimizer path reuses its graph ops the same
+        way).  Call imperative.clear_gradients() (or layer
+        .clear_gradients()) after each step."""
+        from . import imperative as imp
+
+        imp.backward(loss)
+        session = imp._require_session()
+        block = fw.default_main_program().global_block()
+        params = parameter_list or fw.default_main_program().all_parameters()
+        frozen = {getattr(v, "name", v) for v in (no_grad_set or ())}
+        params_grads = []
+        for p in params:
+            g = session.grads.get(p.name)
+            if (g is None or getattr(p, "stop_gradient", False)
+                    or p.name in frozen):
+                continue
+            gv = block.create_var(
+                name=fw.unique_name(p.name + "@EGRAD"),
+                shape=list(p.shape), dtype=p.dtype)
+            gv.stop_gradient = True
+            session.values[gv.name] = g
+            params_grads.append((p, gv))
+        ops = self.apply_gradients(params_grads)
+        return ops, params_grads
 
 
 class SGDOptimizer(Optimizer):

@@ -1,0 +1,184 @@
+"""yolov3_loss, generate_proposals, rpn_target_assign,
+polygon_box_transform, roi_perspective_transform, psroi_pool
+(reference yolov3_loss_op.h, detection/generate_proposals_op.cc,
+rpn_target_assign_op.cc, polygon_box_transform_op.cc,
+roi_perspective_transform_op.cc, psroi_pool_op.h)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+rng = np.random.RandomState(4)
+
+
+def test_polygon_box_transform():
+    x = rng.randn(2, 4, 3, 5).astype("float32")
+    xv = layers.data(name="x", shape=[4, 3, 5], dtype="float32")
+    out = layers.polygon_box_transform(xv)
+    exe = pt.Executor(pt.CPUPlace())
+    (o,) = exe.run(feed={"x": x}, fetch_list=[out])
+    o = np.asarray(o)
+    expect = np.empty_like(x)
+    for c in range(4):
+        for h in range(3):
+            for w in range(5):
+                base = w * 4 if c % 2 == 0 else h * 4
+                expect[:, c, h, w] = base - x[:, c, h, w]
+    np.testing.assert_allclose(o, expect, rtol=1e-6)
+
+
+def test_yolov3_loss_decreases_and_grad_flows():
+    N, A, C, H = 4, 2, 3, 8
+    anchors = [8, 8, 16, 16]
+    rs = np.random.RandomState(0)
+
+    def make_batch():
+        gtb = np.zeros((N, 2, 4), "float32")
+        gtl = np.zeros((N, 2), "int32")
+        for i in range(N):
+            gtb[i, 0] = [rs.uniform(0.2, 0.8), rs.uniform(0.2, 0.8),
+                         rs.uniform(0.2, 0.4), rs.uniform(0.2, 0.4)]
+            gtl[i, 0] = rs.randint(0, C)
+        return gtb, gtl
+
+    img = layers.data(name="img", shape=[4, H, H], dtype="float32")
+    gtb = layers.data(name="gtb", shape=[2, 4], dtype="float32")
+    gtl = layers.data(name="gtl", shape=[2], dtype="int32")
+    feat = layers.conv2d(img, num_filters=A * (5 + C), filter_size=3,
+                         padding=1)
+    loss = layers.yolov3_loss(feat, gtb, gtl, anchors=anchors, class_num=C,
+                              ignore_thresh=0.5)
+    pt.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    fixed_img = rs.randn(N, 4, H, H).astype("float32")
+    gtb_v, gtl_v = make_batch()
+    for _ in range(80):
+        (lv,) = exe.run(feed={"img": fixed_img, "gtb": gtb_v, "gtl": gtl_v},
+                        fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_rpn_target_assign_dense():
+    # anchors laid out so exactly one overlaps each gt strongly
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [50, 50, 60, 60], [5, 40, 12, 47]], "float32")
+    gt = np.zeros((1, 2, 4), "float32")
+    gt[0, 0] = [0, 0, 10, 10]       # matches anchor 0
+    gt[0, 1] = [21, 21, 29, 29]     # matches anchor 1
+    av = layers.data(name="a", shape=[4], dtype="float32",
+                     append_batch_size=False)
+    av.shape = (4, 4)
+    gv = layers.data(name="g", shape=[2, 4], dtype="float32")
+    lbl, tbox, inw = layers.rpn_target_assign(
+        av, gv, rpn_positive_overlap=0.5, rpn_negative_overlap=0.3)
+    exe = pt.Executor(pt.CPUPlace())
+    l, t, w = exe.run(feed={"a": anchors, "g": gt},
+                      fetch_list=[lbl, tbox, inw])
+    l, t, w = np.asarray(l), np.asarray(t), np.asarray(w)
+    assert l[0, 0] == 1 and l[0, 1] == 1      # fg
+    assert l[0, 2] == 0 and l[0, 3] == 0      # bg (no overlap)
+    np.testing.assert_allclose(t[0, 0], 0.0, atol=1e-5)  # exact match
+    assert w[0, 0, 0] == 1.0 and w[0, 2, 0] == 0.0
+
+
+def test_generate_proposals_shapes_and_sanity():
+    N, A, H, W = 2, 3, 4, 4
+    scores = rng.rand(N, A, H, W).astype("float32")
+    deltas = (rng.randn(N, A * 4, H, W) * 0.1).astype("float32")
+    im_info = np.array([[32, 32, 1.0], [32, 32, 1.0]], "float32")
+    sv = layers.data(name="s", shape=[A, H, W], dtype="float32")
+    dv = layers.data(name="d", shape=[A * 4, H, W], dtype="float32")
+    iv = layers.data(name="i", shape=[3], dtype="float32")
+    anc, var = layers.anchor_generator(sv, anchor_sizes=[8.0],
+                                       aspect_ratios=[1.0, 2.0, 0.5],
+                                       stride=[8.0, 8.0])
+    rois, probs, num = layers.generate_proposals(
+        sv, dv, iv, anc, var, pre_nms_top_n=20, post_nms_top_n=10,
+        nms_thresh=0.7, min_size=1.0)
+    exe = pt.Executor(pt.CPUPlace())
+    r, p, c = exe.run(feed={"s": scores, "d": deltas, "i": im_info},
+                      fetch_list=[rois, probs, num])
+    r, p, c = np.asarray(r), np.asarray(p), np.asarray(c)
+    assert r.shape == (N, 10, 4) and p.shape == (N, 10, 1)
+    assert (c >= 1).all() and (c <= 10).all()
+    for n in range(N):
+        k = int(c[n])
+        assert (r[n, :k, 0] >= 0).all() and (r[n, :k, 2] <= 31).all()
+        assert (r[n, :k, 2] >= r[n, :k, 0]).all()
+        # probs sorted descending over the valid prefix
+        assert (np.diff(p[n, :k, 0]) <= 1e-6).all()
+
+
+def test_roi_perspective_transform_identity_quad():
+    # a rect quad aligned with the axes behaves like a crop+resize
+    N, C, H, W = 1, 1, 8, 8
+    x = np.arange(H * W, dtype="float32").reshape(N, C, H, W)
+    # quad corners (tl, tr, br, bl) of the rect [2,2]-[5,5]
+    rois = np.array([[2, 2, 5, 2, 5, 5, 2, 5]], "float32")
+    xv = layers.data(name="x", shape=[C, H, W], dtype="float32")
+    rv = layers.data(name="r", shape=[8], dtype="float32",
+                     append_batch_size=False)
+    rv.shape = (1, 8)
+    out = layers.roi_perspective_transform(xv, rv, transformed_height=4,
+                                           transformed_width=4)
+    exe = pt.Executor(pt.CPUPlace())
+    (o,) = exe.run(feed={"x": x, "r": rois}, fetch_list=[out])
+    o = np.asarray(o)
+    assert o.shape == (1, C, 4, 4)
+    # corners must hit the quad corners exactly
+    np.testing.assert_allclose(o[0, 0, 0, 0], x[0, 0, 2, 2])
+    np.testing.assert_allclose(o[0, 0, 3, 3], x[0, 0, 5, 5])
+
+
+def test_psroi_pool_position_sensitive():
+    N, O, ph, pw, H, W = 1, 2, 2, 2, 8, 8
+    C = O * ph * pw
+    # each channel holds its own constant -> output bin (i,j) of out-chan d
+    # must equal the constant of channel (d*ph+i)*pw+j... i.e. chan d, bin
+    # index i*pw+j within group d
+    x = np.zeros((N, C, H, W), "float32")
+    for c in range(C):
+        x[0, c] = c
+    rois = np.array([[0, 0, 7, 7]], "float32")
+    xv = layers.data(name="x", shape=[C, H, W], dtype="float32")
+    rv = layers.data(name="r", shape=[4], dtype="float32",
+                     append_batch_size=False)
+    rv.shape = (1, 4)
+    out = layers.psroi_pool(xv, rv, output_channels=O, spatial_scale=1.0,
+                            pooled_height=ph, pooled_width=pw)
+    exe = pt.Executor(pt.CPUPlace())
+    (o,) = exe.run(feed={"x": x, "r": rois}, fetch_list=[out])
+    o = np.asarray(o)
+    assert o.shape == (1, O, ph, pw)
+    for d in range(O):
+        for i in range(ph):
+            for j in range(pw):
+                expect = d * ph * pw + (i * pw + j)
+                np.testing.assert_allclose(o[0, d, i, j], expect, atol=1e-5)
+
+
+def test_rpn_target_assign_straddle_exclusion():
+    """Anchors outside the image get label -1 when im_info is given."""
+    anchors = np.array([[0, 0, 10, 10], [28, 28, 40, 40]], "float32")
+    gt = np.zeros((1, 1, 4), "float32")
+    gt[0, 0] = [0, 0, 10, 10]
+    av = layers.data(name="a2", shape=[4], dtype="float32",
+                     append_batch_size=False)
+    av.shape = (2, 4)
+    gv = layers.data(name="g2", shape=[1, 4], dtype="float32")
+    iv = layers.data(name="i2", shape=[3], dtype="float32")
+    lbl, _, _ = layers.rpn_target_assign(
+        av, gv, im_info=iv, rpn_positive_overlap=0.5,
+        rpn_negative_overlap=0.3, rpn_straddle_thresh=0.0)
+    exe = pt.Executor(pt.CPUPlace())
+    im_info = np.array([[32, 32, 1.0]], "float32")
+    (l,) = exe.run(feed={"a2": anchors, "g2": gt, "i2": im_info},
+                   fetch_list=[lbl])
+    l = np.asarray(l)
+    assert l[0, 0] == 1          # in-image matching anchor
+    assert l[0, 1] == -1         # straddles the boundary -> excluded

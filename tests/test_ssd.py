@@ -1,0 +1,201 @@
+"""SSD training path: target_assign / mine_hard_examples numerics vs brute
+force, density_prior_box, detection_map vs hand-computed AP, and an
+integration test training a toy SSD head (multi_box_head + ssd_loss) to
+decreasing loss with detection_output producing sane boxes.
+Reference: layers/detection.py ssd_loss:779, detection_output:201,
+multi_box_head:1259, density_prior_box:1133, detection_map:515;
+operators/detection/{target_assign,mine_hard_examples,density_prior_box,
+detection_map}_op."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import framework as fw
+
+rng = np.random.RandomState(3)
+
+
+def _run_op(op_type, inputs, outputs, attrs):
+    prog, startup = fw.Program(), fw.Program()
+    with fw.program_guard(prog, startup):
+        blk = prog.global_block()
+        feed = {}
+        in_spec = {}
+        for slot, (name, arr) in inputs.items():
+            blk.create_var(name=name, shape=arr.shape, dtype=str(arr.dtype),
+                           is_data=True)
+            feed[name] = arr
+            in_spec[slot] = [name]
+        out_spec = {}
+        for slot, name in outputs.items():
+            blk.create_var(name=name, dtype="float32")
+            out_spec[slot] = [name]
+        blk.append_op(op_type, inputs=in_spec, outputs=out_spec, attrs=attrs)
+    exe = pt.Executor(pt.CPUPlace())
+    res = exe.run(prog, feed=feed, fetch_list=list(outputs.values()))
+    return [np.asarray(r) for r in res]
+
+
+def test_target_assign_matches_brute_force():
+    N, G, P, K = 2, 3, 5, 4
+    x = rng.randn(N, G, K).astype("float32")
+    match = np.array([[0, -1, 2, 1, -1],
+                      [2, 2, -1, 0, 1]], "int32")
+    out, wt = _run_op(
+        "target_assign",
+        {"X": ("x", x), "MatchIndices": ("m", match)},
+        {"Out": "o", "OutWeight": "w"},
+        {"mismatch_value": 7},
+    )
+    for n in range(N):
+        for p in range(P):
+            if match[n, p] >= 0:
+                np.testing.assert_allclose(out[n, p], x[n, match[n, p]])
+                assert wt[n, p] == 1.0
+            else:
+                np.testing.assert_allclose(out[n, p], 7.0)
+                assert wt[n, p] == 0.0
+
+
+def test_target_assign_negative_mask():
+    N, G, P = 1, 2, 4
+    x = rng.randn(N, G, 1).astype("float32")
+    match = np.array([[0, -1, -1, 1]], "int32")
+    neg = np.array([[0, 1, 0, 0]], "int32")
+    out, wt = _run_op(
+        "target_assign",
+        {"X": ("x", x), "MatchIndices": ("m", match),
+         "NegIndices": ("n", neg)},
+        {"Out": "o", "OutWeight": "w"},
+        {"mismatch_value": 0},
+    )
+    # negatives: background value with weight 1 (they join the conf loss)
+    assert out[0, 1, 0] == 0.0 and wt[0, 1, 0] == 1.0
+    assert out[0, 2, 0] == 0.0 and wt[0, 2, 0] == 0.0
+
+
+def test_mine_hard_examples_max_negative():
+    N, P = 2, 6
+    cls_loss = np.array([[5, 4, 3, 2, 1, 0.5],
+                         [1, 6, 2, 5, 3, 4]], "float32")
+    match = np.array([[0, -1, -1, -1, -1, -1],
+                      [-1, 0, -1, 1, -1, -1]], "int32")
+    dist = np.zeros((N, P), "float32")  # all below neg_dist_threshold
+    neg, updated = _run_op(
+        "mine_hard_examples",
+        {"ClsLoss": ("c", cls_loss), "MatchIndices": ("m", match),
+         "MatchDist": ("d", dist)},
+        {"NegIndices": "n", "UpdatedMatchIndices": "u"},
+        {"neg_pos_ratio": 2.0, "neg_dist_threshold": 0.5,
+         "mining_type": "max_negative"},
+    )
+    np.testing.assert_array_equal(updated, match)
+    # image 0: 1 positive -> 2 negatives, the highest-loss unmatched: p1, p2
+    np.testing.assert_array_equal(neg[0], [0, 1, 1, 0, 0, 0])
+    # image 1: 2 positives -> 4 negatives among eligible {0,2,4,5}: all 4
+    np.testing.assert_array_equal(neg[1], [1, 0, 1, 0, 1, 1])
+
+
+def test_density_prior_box_counts_and_geometry():
+    feat = np.zeros((1, 8, 4, 4), "float32")
+    img = np.zeros((1, 3, 32, 32), "float32")
+    fv = layers.data(name="feat", shape=[8, 4, 4], dtype="float32")
+    iv = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    boxes, var = layers.density_prior_box(
+        fv, iv, densities=[2], fixed_sizes=[8.0], fixed_ratios=[1.0],
+        clip=True)
+    exe = pt.Executor(pt.CPUPlace())
+    b, v = exe.run(feed={"feat": feat, "img": img}, fetch_list=[boxes, var])
+    b = np.asarray(b)
+    assert b.shape == (4, 4, 4, 4)  # H, W, density^2 priors, 4
+    assert (b >= 0).all() and (b <= 1).all()
+    w = b[..., 2] - b[..., 0]
+    assert np.all(w <= 8.0 / 32 + 1e-6)
+    np.testing.assert_allclose(np.asarray(v)[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_detection_map_hand_computed():
+    # 1 image, 2 classes (bg=0 skipped), 2 gts of class 1; 3 detections:
+    # det0 matches gt0 (score .9 tp), det1 misses (score .8 fp),
+    # det2 matches gt1 (score .7 tp)
+    det = np.array([[[1, 0.9, 0.0, 0.0, 0.4, 0.4],
+                     [1, 0.8, 0.6, 0.6, 0.9, 0.9],
+                     [1, 0.7, 0.0, 0.5, 0.4, 0.9],
+                     [-1, 0, 0, 0, 0, 0]]], "float32")
+    gt = np.array([[[1, 0.0, 0.0, 0.4, 0.4, 0],
+                    [1, 0.0, 0.5, 0.4, 0.9, 0],
+                    [-1, 0, 0, 0, 0, 0]]], "float32")
+    dv = layers.data(name="det", shape=[4, 6], dtype="float32")
+    gv = layers.data(name="gt", shape=[3, 6], dtype="float32")
+    m = layers.detection_map(dv, gv, class_num=2)
+    exe = pt.Executor(pt.CPUPlace())
+    (mv,) = exe.run(feed={"det": det, "gt": gt}, fetch_list=[m])
+    # integral AP: rec/prec points (.5, 1.0), (.5, .5), (1.0, 2/3)
+    # AP = .5*1.0 + .5*(2/3) = 5/6
+    np.testing.assert_allclose(np.asarray(mv)[0], 5.0 / 6.0, atol=1e-5)
+
+
+def _toy_ssd_data(bs, rs):
+    """Images with one bright square; gt = its box, label 1."""
+    imgs = np.zeros((bs, 1, 32, 32), "float32")
+    gtb = np.zeros((bs, 2, 4), "float32")
+    gtl = np.zeros((bs, 2), "int64")
+    cnt = np.ones((bs,), "int64")
+    for i in range(bs):
+        cx, cy = rs.randint(6, 26, 2)
+        s = rs.randint(4, 8)
+        x1, y1 = max(cx - s, 0), max(cy - s, 0)
+        x2, y2 = min(cx + s, 31), min(cy + s, 31)
+        imgs[i, 0, y1:y2, x1:x2] = 1.0
+        gtb[i, 0] = [x1 / 32, y1 / 32, x2 / 32, y2 / 32]
+        gtl[i, 0] = 1
+    return imgs, gtb, gtl, cnt
+
+
+def test_ssd_trains_end_to_end():
+    bs = 8
+    rs = np.random.RandomState(0)
+    img = layers.data(name="img", shape=[1, 32, 32], dtype="float32")
+    gtb = layers.data(name="gtb", shape=[2, 4], dtype="float32")
+    gtl = layers.data(name="gtl", shape=[2], dtype="int64")
+    cnt = layers.data(name="cnt", shape=[], dtype="int64")
+
+    c1 = layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                       stride=2, act="relu")              # [B,8,16,16]
+    c2 = layers.conv2d(c1, num_filters=16, filter_size=3, padding=1,
+                       stride=2, act="relu")              # [B,16,8,8]
+    c3 = layers.conv2d(c2, num_filters=16, filter_size=3, padding=1,
+                       stride=2, act="relu")              # [B,16,4,4]
+    locs, confs, boxes, vars_ = layers.multi_box_head(
+        inputs=[c2, c3], image=img, base_size=32, num_classes=2,
+        aspect_ratios=[[1.0], [1.0]], min_sizes=[8.0, 16.0],
+        max_sizes=[16.0, 24.0], flip=False)
+    loss = layers.ssd_loss(locs, confs, gtb, gtl, boxes, vars_,
+                           gt_count=cnt)
+    avg = layers.mean(loss)
+    dets, det_cnt = layers.detection_output(
+        locs, confs, boxes, vars_, score_threshold=0.3, nms_top_k=16,
+        keep_top_k=8)
+    pt.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(avg)
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for _ in range(60):
+        xb, bb, lb, cb = _toy_ssd_data(bs, rs)
+        (lv,) = exe.run(feed={"img": xb, "gtb": bb, "gtl": lb, "cnt": cb},
+                        fetch_list=[avg])
+        losses.append(float(np.asarray(lv)))
+    assert np.isfinite(losses).all(), losses[-5:]
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+    # inference pass produces finite decoded boxes in [~0, ~1]
+    test_prog = pt.default_main_program().clone(for_test=True)
+    xb, bb, lb, cb = _toy_ssd_data(bs, rs)
+    d, dc = exe.run(test_prog,
+                    feed={"img": xb, "gtb": bb, "gtl": lb, "cnt": cb},
+                    fetch_list=[dets, det_cnt])
+    d = np.asarray(d)
+    assert d.shape[0] == bs and d.shape[2] == 6
+    assert np.isfinite(d[:, :, 2:]).all()
