@@ -202,16 +202,19 @@ class TraceContext:
         return jax.random.fold_in(self.base_key, self._rng_counter)
 
 
-def trace_block(block: fw.Block, env: Dict[str, Any], tctx: TraceContext):
+def trace_block(block: fw.Block, env: Dict[str, Any], tctx: TraceContext,
+                ops: Optional[Sequence] = None):
     """Run every op's lowering over `env` (name -> traced value), in order.
 
     This is the TPU replacement for the interpreter hot loop
     (executor.cc:448): it executes at *trace time only*; the result is a
-    single XLA computation.
+    single XLA computation.  `ops` restricts tracing to a subset (used by
+    gradient accumulation to split the fwd/bwd prefix from the Optimize
+    suffix).
     """
     from .. import amp as _amp
 
-    for op in block.ops:
+    for op in (block.ops if ops is None else ops):
         lower = registry.get_grad_lowering(op.type) if op.type.endswith("_grad") else None
         if lower is None:
             lower = registry.get(op.type).lower
@@ -586,6 +589,228 @@ class Executor:
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
+
+    def run_accumulated(
+        self,
+        program: Optional[fw.Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        accumulate_steps: Optional[int] = None,
+        return_numpy: bool = True,
+    ):
+        """Gradient accumulation in ONE compiled XLA call: run the
+        forward+backward prefix over K micro-batches (feed arrays carry a
+        leading [K, micro_bs, ...] axis) summing every parameter gradient,
+        then run the Optimize-role op suffix ONCE on the averaged grads.
+
+        The capability of the reference's multi_batch_merge_pass
+        (ir/multi_batch_merge_pass.h:25 — clone fwd/bwd N times, average,
+        optimize once), realized as a lax.scan instead of a graph clone.
+        Gradient clipping/regularization ops carry the Backward role, so
+        they apply per micro-batch (matching the reference pass, which
+        clones everything before the optimizer).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if program is None:
+            program = fw.default_main_program()
+        feed = feed or {}
+        scope = scope or global_scope()
+        fetch_names = [
+            v.name if isinstance(v, fw.Variable) else v
+            for v in (fetch_list or [])
+        ]
+        feed_names = sorted(feed)
+        feed_stack = {
+            n: self._to_device_array(program, n, feed[n])
+            for n in feed_names
+        }
+        if accumulate_steps is None:
+            if not feed_names:
+                raise ValueError("run_accumulated needs accumulate_steps "
+                                 "when feed is empty")
+            accumulate_steps = int(feed_stack[feed_names[0]].shape[0])
+        k = accumulate_steps
+
+        key = (
+            "run_accumulated",
+            program.fingerprint(),
+            bool(getattr(program, "_amp_bf16", False)),
+            bool(self.check_nan_inf),
+            self._scope_signature(program, feed_names, scope),
+            k,
+            tuple(feed_names),
+            tuple(
+                (tuple(feed_stack[n].shape), str(feed_stack[n].dtype))
+                for n in feed_names
+            ),
+            tuple(fetch_names),
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile_accumulated(
+                program, feed_names, fetch_names, scope, k
+            )
+            self._cache[key] = entry
+
+        rw_vals = [scope.find_var(n) for n in entry.rw_state]
+        ro_vals = [scope.find_var(n) for n in entry.ro_state]
+        feed_vals = [feed_stack[n] for n in feed_names]
+        self._run_counter += 1
+        seed = program.random_seed or 0
+        base_key = jax.random.fold_in(prng_key(seed), self._run_counter)
+        fetches, new_state, nan_flags = entry.fn(
+            feed_vals, rw_vals, ro_vals, base_key)
+        for n, v in zip(entry.state_writes, new_state):
+            scope.set_var(n, v)
+        if entry.nan_check_ops:
+            per_op = np.asarray(nan_flags)
+            if per_op.ndim == 2:
+                per_op = per_op.all(axis=0)
+            bad = [d for d, ok in zip(entry.nan_check_ops, per_op) if not ok]
+            if bad:
+                raise FloatingPointError(
+                    "check_nan_inf: non-finite output from op(s):\n  "
+                    + "\n  ".join(bad))
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    def _compile_accumulated(self, program, feed_names, fetch_names, scope,
+                             k):
+        import jax
+        import jax.numpy as jnp
+
+        block = program.global_block()
+        opt_bit = fw.OpRole.Optimize
+        prefix_ops = [
+            op for op in block.ops
+            if not (int(op.attrs.get(fw.OpRole.ROLE_ATTR_NAME, 0)) & opt_bit)
+        ]
+        suffix_ops = [
+            op for op in block.ops
+            if int(op.attrs.get(fw.OpRole.ROLE_ATTR_NAME, 0)) & opt_bit
+        ]
+        if not suffix_ops:
+            raise ValueError(
+                "run_accumulated: program has no Optimize-role ops "
+                "(call optimizer.minimize first)")
+        # the gradients the optimizer consumes are what we accumulate
+        grad_names = sorted({
+            n for op in suffix_ops for n in op.inputs.get("Grad", []) if n
+        })
+
+        state_reads, state_writes = analyze_block_io(block, feed_names, scope)
+        write_set = set(state_writes)
+        rw_state = [n for n in state_reads if n in write_set]
+        ro_state = [n for n in state_reads if n not in write_set]
+        # write-only names created by the program: surfaced from the last
+        # micro-batch (prefix) or from the suffix, like _compile_steps
+        wo_state = [n for n in state_writes if n not in set(rw_state)]
+        check = self.check_nan_inf
+        nan_check_ops: List[str] = []
+
+        def acc_fn(feed_vals, rw_vals, ro_vals, base_key):
+            rw0 = list(rw_vals)
+
+            def run_prefix(i_key, per_step, rw):
+                tctx = TraceContext(
+                    program, i_key,
+                    is_test=getattr(program, "_is_test", False),
+                    check_nan_inf=check,
+                )
+                env: Dict[str, Any] = {}
+                env.update(zip(feed_names, per_step))
+                env.update(zip(rw_state, rw))
+                env.update(zip(ro_state, ro_vals))
+                trace_block(block, env, tctx, ops=prefix_ops)
+                new_rw = [env.get(n, v) for n, v in zip(rw_state, rw)]
+                fetches = []
+                for n in fetch_names:
+                    if n not in env:
+                        raise KeyError(
+                            f"fetch target {n!r} not produced by the "
+                            "fwd/bwd prefix (run_accumulated cannot fetch "
+                            "Optimize-role outputs)"
+                        )
+                    fetches.append(env[n])
+                wo = [env.get(n) for n in wo_state]
+                flags = (
+                    jnp.stack([f for _, f in tctx.nan_checks])
+                    if check and tctx.nan_checks else jnp.ones((0,), bool)
+                )
+                return env, new_rw, fetches, wo, flags, tctx
+
+            def body(carry, xs):
+                rw, grad_sums = carry
+                i, per_step = xs[0], xs[1]
+                env, new_rw, fetches, wo, flags, _ = run_prefix(
+                    jax.random.fold_in(base_key, i), per_step, rw)
+                new_sums = [
+                    s + env[g] for s, g in zip(grad_sums, grad_names)
+                ]
+                return (new_rw, new_sums), (fetches, wo, flags)
+
+            # step 0 traced inline (gives grad-sum init without a
+            # throwaway zeros trace), steps 1..k-1 under lax.scan
+            env0, rw1, fetches0, wo0, flags0, tctx0 = run_prefix(
+                jax.random.fold_in(base_key, 0),
+                [v[0] for v in feed_vals], rw0)
+            sums0 = [env0[g] for g in grad_names]
+            nan_check_ops.clear()
+            nan_check_ops.extend(d for d, _ in tctx0.nan_checks)
+
+            if k > 1:
+                xs = (jnp.arange(1, k),
+                      [v[1:] for v in feed_vals])
+                (rw_f, sums_f), (rest, wo_rest, flag_rest) = jax.lax.scan(
+                    body, (rw1, sums0), xs)
+                fetches = [
+                    jnp.concatenate([f0[None], fr], axis=0)
+                    for f0, fr in zip(fetches0, rest)
+                ]
+                wo_last = [
+                    (wr[-1] if wr is not None else w0)
+                    for w0, wr in zip(wo0, wo_rest)
+                ]
+                all_flags = jnp.concatenate(
+                    [flags0[None], flag_rest], axis=0)
+            else:
+                rw_f, sums_f = rw1, sums0
+                fetches = [f0[None] for f0 in fetches0]
+                wo_last = wo0
+                all_flags = flags0[None]
+
+            # optimizer suffix ONCE on the averaged gradients
+            envf: Dict[str, Any] = {}
+            envf.update(zip(rw_state, rw_f))
+            envf.update(zip(ro_state, ro_vals))
+            for g, s in zip(grad_names, sums_f):
+                envf[g] = s / float(k)
+            tctxf = TraceContext(
+                program, jax.random.fold_in(base_key, k),
+                is_test=getattr(program, "_is_test", False),
+                check_nan_inf=check,
+            )
+            trace_block(block, envf, tctxf, ops=suffix_ops)
+            by_name = dict(zip(rw_state, rw_f))
+            by_name.update(zip(wo_state, wo_last))
+            # suffix outputs (param updates) win over scanned values
+            for n in state_writes:
+                if n in envf and envf[n] is not None:
+                    by_name[n] = envf[n]
+            new_state = [by_name.get(n) for n in state_writes]
+            return fetches, new_state, all_flags
+
+        jitted = jax.jit(acc_fn, donate_argnums=(1,))
+        return _CompiledEntry(
+            lambda f, rw, ro, key: jitted(f, rw, ro, key),
+            rw_state, ro_state, state_writes, True,
+            nan_check_ops=nan_check_ops if check else None,
+            jitted=jitted,
+        )
 
     def _compile_steps(self, program, feed_names, fetch_names, scope, steps):
         import jax

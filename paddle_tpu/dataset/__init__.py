@@ -6,10 +6,16 @@ from . import (  # noqa: F401
     cifar,
     common,
     conll05,
+    flowers,
+    image,
     imdb,
     imikolov,
     mnist,
     movielens,
+    mq2007,
+    sentiment,
     uci_housing,
+    voc2012,
+    wmt14,
     wmt16,
 )

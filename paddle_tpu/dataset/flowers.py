@@ -1,0 +1,86 @@
+"""Oxford 102 Flowers (reference: python/paddle/dataset/flowers.py —
+train/test/valid readers yielding (3x224x224 float CHW image / 255,
+label 0..101) via the image.py transform pipeline).
+
+Offline fallback: synthetic class-colored images (each class gets a
+distinctive hue block), separable by a small conv net."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from . import common, image
+
+DATA_URL = "http://www.robots.ox.ac.uk/~vgg/data/flowers/102/102flowers.tgz"
+LABEL_URL = "http://www.robots.ox.ac.uk/~vgg/data/flowers/102/imagelabels.mat"
+SETID_URL = "http://www.robots.ox.ac.uk/~vgg/data/flowers/102/setid.mat"
+
+_CLASSES = 102
+
+
+def _synthetic_reader(seed, n=256, size=64):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, _CLASSES))
+            im = rng.rand(3, size, size).astype("float32") * 0.1
+            im[label % 3, (label // 3) % (size - 8):
+               (label // 3) % (size - 8) + 8, :] += 0.9
+            yield im, label
+    return reader
+
+
+def _real_reader(split_key, mapper):
+    def reader():
+        from scipy.io import loadmat  # gated: only the real path needs it
+
+        data_path = common.download(DATA_URL, "flowers", None)
+        label_path = common.download(LABEL_URL, "flowers", None)
+        setid_path = common.download(SETID_URL, "flowers", None)
+        labels = loadmat(label_path)["labels"][0]
+        indexes = loadmat(setid_path)[split_key][0]
+        with tarfile.open(data_path, "r") as f:
+            members = {m.name: m for m in f.getmembers()}
+            for idx in indexes:
+                name = f"jpg/image_{idx:05d}.jpg"
+                if name not in members:
+                    continue
+                data = f.extractfile(members[name]).read()
+                im = image.load_image_bytes(data)
+                im = mapper(im)
+                yield im, int(labels[idx - 1]) - 1
+    return reader
+
+
+def _train_mapper(im):
+    im = image.simple_transform(im, 256, 224, True)
+    return im.astype("float32") / 255.0
+
+
+def _test_mapper(im):
+    im = image.simple_transform(im, 256, 224, False)
+    return im.astype("float32") / 255.0
+
+
+def train(mapper=_train_mapper, buffered_size=1024, use_xmap=True,
+          cycle=False, synthetic=False):
+    if common.use_synthetic(synthetic):
+        return _synthetic_reader(31)
+    return _real_reader("trnid", mapper)
+
+
+def test(mapper=_test_mapper, buffered_size=1024, use_xmap=True,
+         cycle=False, synthetic=False):
+    if common.use_synthetic(synthetic):
+        return _synthetic_reader(32)
+    return _real_reader("tstid", mapper)
+
+
+def valid(mapper=_test_mapper, buffered_size=1024, use_xmap=True,
+          synthetic=False):
+    if common.use_synthetic(synthetic):
+        return _synthetic_reader(33)
+    return _real_reader("valid", mapper)

@@ -1,0 +1,131 @@
+"""Image preprocessing utilities (reference: python/paddle/dataset/image.py
+— resize_short, center_crop, random_crop, left_right_flip, to_chw,
+simple_transform, load_image*).
+
+TPU-first: pure-numpy implementations (bilinear resize included) instead
+of the reference's hard cv2 dependency; decoding bytes still needs an
+image library and is gated behind the call."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "load_image_bytes", "load_image", "resize_short", "to_chw",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform",
+]
+
+
+def load_image_bytes(data, is_color=True):
+    """Decode encoded image bytes -> HWC uint8 (reference
+    image.py:141). Needs PIL or cv2 at call time."""
+    import io
+
+    try:
+        from PIL import Image
+
+        im = Image.open(io.BytesIO(data))
+        im = im.convert("RGB" if is_color else "L")
+        arr = np.asarray(im)
+        if not is_color:
+            arr = arr[:, :, None]
+        return arr
+    except ImportError:
+        pass
+    try:
+        import cv2
+
+        flag = cv2.IMREAD_COLOR if is_color else cv2.IMREAD_GRAYSCALE
+        arr = cv2.imdecode(np.frombuffer(data, np.uint8), flag)
+        return arr[..., ::-1] if is_color else arr[:, :, None]
+    except ImportError as e:
+        raise RuntimeError(
+            "load_image_bytes needs PIL or cv2 installed") from e
+
+
+def load_image(path, is_color=True):
+    """reference image.py:167."""
+    with open(path, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def _resize_bilinear(im, oh, ow):
+    """HWC numpy bilinear resize (align_corners=False, cv2 convention)."""
+    h, w = im.shape[:2]
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    im = im.astype("float32")
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals `size` (reference image.py:197)."""
+    h, w = im.shape[:2]
+    if h < w:
+        oh, ow = size, int(round(w * size / h))
+    else:
+        oh, ow = int(round(h * size / w)), size
+    return _resize_bilinear(im, oh, ow)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """reference image.py:225."""
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """reference image.py:249."""
+    h, w = im.shape[:2]
+    h0 = (h - size) // 2
+    w0 = (w - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    """reference image.py:277 (host-side; the on-device variant is
+    layers.random_crop)."""
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h0 = rng.randint(0, h - size + 1)
+    w0 = rng.randint(0, w - size + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    """reference image.py:305."""
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short + (random|center) crop + maybe flip + CHW + mean
+    (reference image.py:327)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if (rng or np.random).randint(2) == 0:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.asarray(mean, "float32")
+        im -= mean[:, None, None] if mean.ndim == 1 else mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """reference image.py:383."""
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

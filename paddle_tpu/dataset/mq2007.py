@@ -1,0 +1,100 @@
+"""MQ2007 learning-to-rank dataset (reference:
+python/paddle/dataset/mq2007.py — LETOR 46-feature query/doc pairs;
+readers in pointwise / pairwise / listwise formats).
+
+Offline fallback: synthetic queries whose relevance is a noisy linear
+function of the features — rankers trained on it order documents
+correctly."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+URL = ("https://download.microsoft.com/download/E/7/E/"
+       "E7EABEF1-4C7B-4E31-ACE5-73927950ED5E/LETOR4.0.zip")
+
+FEATURE_DIM = 46
+
+
+def _synthetic_querylists(seed, n_queries=60, docs_per_query=(5, 20)):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(FEATURE_DIM) / np.sqrt(FEATURE_DIM)
+    out = []
+    for _ in range(n_queries):
+        n = int(rng.randint(*docs_per_query))
+        feats = rng.rand(n, FEATURE_DIM).astype("float32")
+        score = feats @ w + 0.1 * rng.randn(n)
+        # 3 relevance grades by score tercile (LETOR labels are 0/1/2)
+        cut = np.percentile(score, [33, 66])
+        labels = np.digitize(score, cut).astype("int64")
+        out.append((labels, feats))
+    return out
+
+
+def _parse_letor(path):
+    """LETOR line format: label qid:<id> 1:<v> 2:<v> ... #docid ..."""
+    lists, cur_qid, cur = [], None, None
+    with open(path) as f:
+        for line in f:
+            body = line.split("#")[0].split()
+            if len(body) < 2:
+                continue
+            label = int(body[0])
+            qid = body[1].split(":")[1]
+            feats = np.full((FEATURE_DIM,), -1.0, "float32")
+            for tok in body[2:]:
+                k, v = tok.split(":")
+                feats[int(k) - 1] = float(v)
+            if qid != cur_qid:
+                if cur is not None:
+                    lists.append(cur)
+                cur_qid, cur = qid, ([], [])
+            cur[0].append(label)
+            cur[1].append(feats)
+    if cur is not None:
+        lists.append(cur)
+    return [(np.asarray(l, "int64"), np.stack(f)) for l, f in lists]
+
+
+def _querylists(synthetic, split, seed):
+    if common.use_synthetic(synthetic):
+        return _synthetic_querylists(seed)
+    path = os.path.join(common.DATA_HOME, "mq2007", "MQ2007", "Fold1",
+                        f"{split}.txt")
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"mq2007: place the extracted LETOR4.0 file at {path} "
+            "(zero-egress image), or pass synthetic=True")
+    return _parse_letor(path)
+
+
+def _reader(split, fmt, synthetic, seed):
+    def reader():
+        for labels, feats in _querylists(synthetic, split, seed):
+            if fmt == "pointwise":
+                for l, f in zip(labels, feats):
+                    yield f, int(l)
+            elif fmt == "pairwise":
+                for i in range(len(labels)):
+                    for j in range(len(labels)):
+                        if labels[i] > labels[j]:
+                            yield 1.0, feats[i], feats[j]
+            elif fmt == "listwise":
+                yield labels, feats
+            else:
+                raise ValueError(f"unknown format {fmt!r}")
+    return reader
+
+
+def train(format="pairwise", synthetic=False, shuffle=False,
+          fill_missing=-1):
+    return _reader("train", format, synthetic, 71)
+
+
+def test(format="pairwise", synthetic=False, shuffle=False,
+         fill_missing=-1):
+    return _reader("test", format, synthetic, 72)

@@ -1,0 +1,81 @@
+"""NLTK movie-review sentiment dataset (reference:
+python/paddle/dataset/sentiment.py — get_word_dict() over corpus
+frequencies; train/test readers yielding (word-id list, 0/1)).
+
+Offline fallback: synthetic class-biased token streams (same scheme as
+imdb's fallback; the reference corpus needs NLTK's downloader)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+_VOCAB = 1500
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+
+def get_word_dict(synthetic=False):
+    """word -> id ordered by corpus frequency (reference sentiment.py:56)."""
+    if common.use_synthetic(synthetic):
+        return {f"w{i}": i for i in range(_VOCAB)}
+    import nltk
+    from nltk.corpus import movie_reviews
+
+    common.must_mkdirs(common.DATA_HOME)
+    nltk.data.path.append(common.DATA_HOME)
+    try:
+        movie_reviews.categories()
+    except LookupError:
+        nltk.download("movie_reviews", download_dir=common.DATA_HOME)
+    freq = {}
+    for w in movie_reviews.words():
+        w = w.lower()
+        freq[w] = freq.get(w, 0) + 1
+    words = sorted(freq, key=lambda w: (-freq[w], w))
+    return {w: i for i, w in enumerate(words)}
+
+
+def _synthetic_reader(seed, n):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(10, 50))
+            lo = 0 if label == 0 else _VOCAB // 2
+            ids = rng.randint(lo, lo + 3 * _VOCAB // 4, length) % _VOCAB
+            yield list(ids), label
+    return reader
+
+
+def _real_reader(lo, hi):
+    def reader():
+        from nltk.corpus import movie_reviews
+
+        word_idx = get_word_dict()
+        docs = []
+        for cat, label in (("pos", 0), ("neg", 1)):
+            for fid in movie_reviews.fileids(cat):
+                docs.append((
+                    [word_idx[w.lower()]
+                     for w in movie_reviews.words(fid)], label))
+        # interleave pos/neg like the reference's sorted shuffle
+        rng = np.random.RandomState(0)
+        rng.shuffle(docs)
+        for ids, label in docs[lo:hi]:
+            yield ids, label
+    return reader
+
+
+def train(synthetic=False):
+    if common.use_synthetic(synthetic):
+        return _synthetic_reader(41, NUM_TRAINING_INSTANCES)
+    return _real_reader(0, NUM_TRAINING_INSTANCES)
+
+
+def test(synthetic=False):
+    if common.use_synthetic(synthetic):
+        return _synthetic_reader(42, NUM_TOTAL_INSTANCES
+                                 - NUM_TRAINING_INSTANCES)
+    return _real_reader(NUM_TRAINING_INSTANCES, NUM_TOTAL_INSTANCES)

@@ -1,0 +1,70 @@
+"""PASCAL VOC2012 segmentation dataset (reference:
+python/paddle/dataset/voc2012.py — train/test/val readers yielding
+(CHW float image, HW int segmentation label) from the VOCtrainval tar).
+
+Offline fallback: synthetic images with a colored rectangle whose mask is
+the label — enough to exercise a segmentation head end to end."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from . import common, image
+
+URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+       "VOCtrainval_11-May-2012.tar")
+_SET_DIR = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+_IMG = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+_LBL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+
+def _synthetic_reader(seed, n=64, size=64):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            cls = int(rng.randint(1, 21))
+            im = rng.rand(3, size, size).astype("float32") * 0.2
+            lbl = np.zeros((size, size), "int32")
+            y0, x0 = rng.randint(4, size // 2, 2)
+            h, w = rng.randint(8, size // 2, 2)
+            im[cls % 3, y0:y0 + h, x0:x0 + w] += 0.8
+            lbl[y0:y0 + h, x0:x0 + w] = cls
+            yield im, lbl
+    return reader
+
+
+def _real_reader(sub_name):
+    def reader():
+        path = common.download(URL, "voc2012", None)
+        with tarfile.open(path, "r") as f:
+            names = (f.extractfile(_SET_DIR.format(sub_name))
+                     .read().decode().split())
+            for name in names:
+                img = image.load_image_bytes(
+                    f.extractfile(_IMG.format(name)).read())
+                lbl = image.load_image_bytes(
+                    f.extractfile(_LBL.format(name)).read(), is_color=False)
+                yield (image.to_chw(img).astype("float32") / 255.0,
+                       lbl[:, :, 0].astype("int32"))
+    return reader
+
+
+def train(synthetic=False):
+    if common.use_synthetic(synthetic):
+        return _synthetic_reader(51)
+    return _real_reader("train")
+
+
+def val(synthetic=False):
+    if common.use_synthetic(synthetic):
+        return _synthetic_reader(52)
+    return _real_reader("val")
+
+
+def test(synthetic=False):
+    if common.use_synthetic(synthetic):
+        return _synthetic_reader(53)
+    return _real_reader("trainval")

@@ -1,0 +1,80 @@
+"""WMT14 En-Fr translation dataset (reference:
+python/paddle/dataset/wmt14.py — pre-tokenized parallel corpus with
+train/test readers yielding (src_ids, trg_ids, trg_next_ids) and
+get_dict(dict_size); the machine_translation book model's data).
+
+Offline fallback: the same deterministic synthetic transduction scheme as
+wmt16 (token-wise affine map), so seq2seq + attention genuinely learns."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_dict"]
+
+_TOTAL_VOCAB = 30000
+START, END, UNK = 0, 1, 2
+START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
+
+
+def get_dict(dict_size, reverse=True, synthetic=True):
+    """word dicts (src, trg) (reference wmt14.py:156; reverse=True returns
+    id->word)."""
+    dict_size = min(dict_size, _TOTAL_VOCAB)
+    src = {START_MARK: START, END_MARK: END, UNK_MARK: UNK}
+    trg = dict(src)
+    for i in range(3, dict_size):
+        src[f"en{i}"] = i
+        trg[f"fr{i}"] = i
+    if reverse:
+        return ({v: k for k, v in src.items()},
+                {v: k for k, v in trg.items()})
+    return src, trg
+
+
+def _reader(seed, n_samples, dict_size, synthetic):
+    def reader():
+        if not common.use_synthetic(synthetic):
+            raise RuntimeError(
+                "wmt14: real-corpus mode needs the tar at the dataset "
+                "cache path (zero-egress image) — use synthetic=True")
+        rng = np.random.RandomState(seed)
+        for _ in range(n_samples):
+            ln = int(rng.randint(4, 16))
+            # the target is a deterministic chain keyed by the source's
+            # first token: trg[0] = key, trg[t] = 3 + (trg[t-1] + key) % m.
+            # An encoder-final-state + teacher-forced decoder can learn
+            # this EXACTLY (the state only needs to carry the key), so
+            # beam decode reproduces the full target — unlike a
+            # per-position src map, which a no-attention decoder cannot
+            # represent.
+            src = rng.randint(3, dict_size, ln)
+            key = int(src[0])
+            m = dict_size - 3
+            trg = [key]
+            for _ in range(ln - 1):
+                trg.append(3 + (trg[-1] + key) % m)
+            yield ([START] + src.tolist() + [END],
+                   [START] + trg,
+                   trg + [END])
+    return reader
+
+
+def synthetic_target(src_ids, dict_size):
+    """The ground-truth target chain for a synthetic source (test hook)."""
+    key = int(src_ids[0])
+    m = dict_size - 3
+    trg = [key]
+    for _ in range(len(src_ids) - 1):
+        trg.append(3 + (trg[-1] + key) % m)
+    return trg
+
+
+def train(dict_size, synthetic=True, n_samples=2000):
+    return _reader(61, n_samples, dict_size, synthetic)
+
+
+def test(dict_size, synthetic=True, n_samples=200):
+    return _reader(62, n_samples, dict_size, synthetic)

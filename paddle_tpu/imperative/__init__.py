@@ -233,15 +233,26 @@ class Layer:
             if p is not None:
                 self._own_params.append(p)
 
+    _in_call = False  # class-level: only the OUTERMOST __call__ adopts
+
     def __call__(self, *args, **kwargs):
         # adopt parameters created DURING forward (functional layers.*
         # calls create their weights on first use; without adoption a
         # layer mixing build-once sub-Layers with functional calls would
-        # silently drop the functional weights from parameters())
+        # silently drop the functional weights from parameters()).  Only
+        # the outermost call diffs the parameter list — nested sub-layer
+        # calls would otherwise rescan all parameters at every depth.
+        if Layer._in_call:
+            return self.forward(*args, **kwargs)
         before = {p.name for p in fw.default_main_program().all_parameters()}
-        out = self.forward(*args, **kwargs)
+        Layer._in_call = True
+        try:
+            out = self.forward(*args, **kwargs)
+        finally:
+            Layer._in_call = False
+        tracked = {p.name for p in self._tracked_parameters()}
         for p in fw.default_main_program().all_parameters():
-            if p.name not in before:
+            if p.name not in before and p.name not in tracked:
                 self._track(p)
         return out
 
@@ -265,16 +276,15 @@ class Layer:
 
     def parameters(self):
         # dedup by name: a lazily-built sub-Layer weight is tracked by the
-        # sub-Layer AND adopted by the enclosing __call__
+        # sub-Layer AND adopted by the enclosing __call__.  A never-called
+        # or stateless layer correctly reports [] (no whole-program
+        # fallback: parameter_list=sub.parameters() must never leak other
+        # modules' weights).
         seen, params = set(), []
         for p in self._tracked_parameters():
             if p.name not in seen:
                 seen.add(p.name)
                 params.append(p)
-        if not params:
-            # functional-style dygraph (layers.* calls in forward) on a
-            # never-called layer; fall back to every program parameter
-            return list(fw.default_main_program().all_parameters())
         return params
 
     def clear_gradients(self):

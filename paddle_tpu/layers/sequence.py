@@ -177,6 +177,10 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
                  "ResetHiddenPrev": [reset_h]},
         attrs={"activation": activation, "gate_activation": gate_activation},
     )
+    if hidden.shape:
+        out_h.shape = tuple(hidden.shape)
+        reset_h.shape = tuple(hidden.shape)
+        gate.shape = tuple(hidden.shape[:-1]) + (d * 3,)
     return out_h, reset_h, gate
 
 

@@ -87,6 +87,8 @@ def assign(input, output=None):
         if output is None:
             output = helper.create_variable_for_type_inference(input.dtype)
         helper.append_op("assign", inputs={"X": [input]}, outputs={"Out": [output]})
+        if input.shape and not output.shape:
+            output.shape = tuple(input.shape)
     else:
         arr = np.asarray(input)
         if output is None:

@@ -1,0 +1,141 @@
+"""GRU encoder-decoder for machine translation (reference:
+tests/book/test_machine_translation.py — encoder + decoder with a GRU
+cell, trained with teacher forcing; decode via layers.beam_search in a
+While loop, the same in-program pattern as models/transformer.py
+build_decoder).
+
+Shared parameter names let a scope trained with build_train_net decode
+directly through build_decoder."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def _encoder(src_word, src_vocab, emb_dim, hidden_dim, seq_len):
+    emb = layers.embedding(
+        src_word, size=[src_vocab, emb_dim],
+        param_attr=ParamAttr(name="src_emb"))
+    emb = layers.reshape(emb, [-1, seq_len, emb_dim])
+    proj = layers.fc(emb, size=hidden_dim * 3, num_flatten_dims=2,
+                     param_attr=ParamAttr(name="enc_proj_w"),
+                     bias_attr=ParamAttr(name="enc_proj_b"))
+    hidden = layers.dynamic_gru(
+        proj, size=hidden_dim,
+        param_attr=ParamAttr(name="enc_gru_w"),
+        bias_attr=ParamAttr(name="enc_gru_b"))
+    return layers.sequence_pool(hidden, "last")          # [B, H]
+
+
+def _decoder_step_params():
+    return dict(
+        emb=ParamAttr(name="trg_emb"),
+        proj_w=ParamAttr(name="dec_proj_w"),
+        proj_b=ParamAttr(name="dec_proj_b"),
+        gru_w=ParamAttr(name="dec_gru_w"),
+        gru_b=ParamAttr(name="dec_gru_b"),
+        out_w=ParamAttr(name="dec_out_w"),
+        out_b=ParamAttr(name="dec_out_b"),
+    )
+
+
+def build_train_net(src_vocab=1000, trg_vocab=1000, emb_dim=32,
+                    hidden_dim=64, src_seq_len=18, trg_seq_len=18):
+    """Teacher-forced training net.  Feeds: src_word [B, Ts, 1],
+    trg_word [B, Tt, 1], trg_next [B, Tt, 1] int64.  Returns avg_cost."""
+    p = _decoder_step_params()
+    src = layers.data(name="src_word", shape=[src_seq_len, 1], dtype="int64")
+    trg = layers.data(name="trg_word", shape=[trg_seq_len, 1], dtype="int64")
+    nxt = layers.data(name="trg_next", shape=[trg_seq_len, 1], dtype="int64")
+
+    enc_last = _encoder(src, src_vocab, emb_dim, hidden_dim, src_seq_len)
+
+    temb = layers.embedding(trg, size=[trg_vocab, emb_dim], param_attr=p["emb"])
+    temb = layers.reshape(temb, [-1, trg_seq_len, emb_dim])
+    proj = layers.fc(temb, size=hidden_dim * 3, num_flatten_dims=2,
+                     param_attr=p["proj_w"], bias_attr=p["proj_b"])
+    hidden = layers.dynamic_gru(
+        proj, size=hidden_dim, h_0=enc_last,
+        param_attr=p["gru_w"], bias_attr=p["gru_b"])     # [B, Tt, H]
+    logits = layers.fc(hidden, size=trg_vocab, num_flatten_dims=2,
+                       param_attr=p["out_w"], bias_attr=p["out_b"])
+    cost = layers.softmax_with_cross_entropy(
+        layers.reshape(logits, [-1, trg_vocab]),
+        layers.reshape(nxt, [-1, 1]))
+    return layers.mean(cost)
+
+
+def build_decoder(src_vocab=1000, trg_vocab=1000, emb_dim=32, hidden_dim=64,
+                  src_seq_len=18, batch_size=4, beam_size=3, max_out_len=16,
+                  bos_id=0, eos_id=1):
+    """Beam-search decoder sharing the train net's parameters; the While
+    loop carries (pre_ids, pre_scores, hidden) per beam lane.  Returns
+    (sentence_ids [b, beam, T], sentence_scores [b, beam], feed_names)."""
+    p = _decoder_step_params()
+    b, k = batch_size, beam_size
+    bk = b * k
+    neg_inf = -1e9
+
+    src = layers.data(name="src_word", shape=[src_seq_len, 1], dtype="int64")
+    enc_last = _encoder(src, src_vocab, emb_dim, hidden_dim, src_seq_len)
+    # tile per beam: [b, H] -> [b, k, H]
+    hidden = layers.expand(layers.reshape(enc_last, [b, 1, hidden_dim]),
+                           [1, k, 1])
+
+    t = layers.fill_constant([1], "int64", 0)
+    limit = layers.fill_constant([1], "int64", max_out_len)
+    cond = layers.less_than(t, limit)
+
+    pre_ids = layers.fill_constant([b, k], "int64", bos_id)
+    beam0 = layers.one_hot(layers.fill_constant([1], "int64", 0), k)
+    pre_scores = layers.expand(
+        layers.reshape(layers.scale(beam0, scale=1e9, bias=neg_inf), [1, k]),
+        [b, 1])
+    hidden_state = layers.assign(hidden)
+
+    ids_arr = layers.create_array("int64", element_shape=[b, k],
+                                  capacity=max_out_len)
+    parents_arr = layers.create_array("int64", element_shape=[b, k],
+                                      capacity=max_out_len)
+
+    w = layers.While(cond)
+    with w.block():
+        emb = layers.embedding(
+            layers.reshape(pre_ids, [bk, 1]),
+            size=[trg_vocab, emb_dim], param_attr=p["emb"])
+        emb = layers.reshape(emb, [bk, emb_dim])
+        proj = layers.fc(emb, size=hidden_dim * 3,
+                         param_attr=p["proj_w"], bias_attr=p["proj_b"])
+        h_flat = layers.reshape(hidden_state, [bk, hidden_dim])
+        new_h, _, _ = layers.gru_unit(
+            proj, h_flat, size=hidden_dim * 3,
+            param_attr=p["gru_w"], bias_attr=p["gru_b"])
+        logits = layers.fc(new_h, size=trg_vocab,
+                           param_attr=p["out_w"], bias_attr=p["out_b"])
+        probs = layers.softmax(logits)
+        log_probs = layers.reshape(
+            layers.log(layers.scale(probs, bias=1e-9)), [b, k, trg_vocab])
+
+        sel_ids, sel_scores, parent_idx = layers.beam_search(
+            pre_ids, pre_scores, None, log_probs, beam_size=k,
+            end_id=eos_id)
+
+        # reorder hidden by the parent beam each token came from
+        par3 = layers.expand(layers.reshape(parent_idx, [b, k, 1]),
+                             [1, 1, hidden_dim])
+        new_h3 = layers.reshape(new_h, [b, k, hidden_dim])
+        h_re = layers.take_along_axis(new_h3, par3, axis=1)
+
+        layers.array_write(sel_ids, t, array=ids_arr)
+        layers.array_write(parent_idx, t, array=parents_arr)
+        layers.assign(h_re, output=hidden_state)
+        layers.assign(sel_ids, output=pre_ids)
+        layers.assign(sel_scores, output=pre_scores)
+        layers.increment(t, value=1.0, in_place=True)
+        layers.less_than(t, limit, cond=cond)
+
+    sent_ids, sent_scores = layers.beam_search_decode(
+        ids_arr, pre_scores, beam_size=k, end_id=eos_id,
+        parents=parents_arr)
+    return sent_ids, sent_scores, ["src_word"]

@@ -209,3 +209,80 @@ def test_wmt16_synthetic():
     assert trg[1:] == trg_next[:-1]  # shifted pair
     d = pt.dataset.wmt16.get_dict("en", 1000)
     assert d["<s>"] == 0 and len(d) == 1000
+
+
+# -- round-4 datasets (flowers/sentiment/voc2012/wmt14/mq2007 + image) -----
+
+
+def test_flowers_synthetic():
+    import paddle_tpu as pt
+
+    sample = next(iter(pt.dataset.flowers.train(synthetic=True)()))
+    im, label = sample
+    assert im.shape[0] == 3 and im.dtype == np.float32
+    assert 0 <= label < 102
+    assert len(list(pt.dataset.flowers.valid(synthetic=True)())) > 0
+
+
+def test_sentiment_synthetic():
+    import paddle_tpu as pt
+
+    d = pt.dataset.sentiment.get_word_dict(synthetic=True)
+    assert len(d) >= 1000
+    ids, label = next(iter(pt.dataset.sentiment.train(synthetic=True)()))
+    assert label in (0, 1) and all(0 <= i < len(d) for i in ids)
+
+
+def test_voc2012_synthetic():
+    import paddle_tpu as pt
+
+    im, lbl = next(iter(pt.dataset.voc2012.train(synthetic=True)()))
+    assert im.shape[0] == 3 and lbl.ndim == 2
+    assert lbl.max() >= 1  # an object mask exists
+
+
+def test_wmt14_synthetic_transduction():
+    import paddle_tpu as pt
+
+    src, trg, nxt = next(iter(pt.dataset.wmt14.train(50)()))
+    assert src[0] == 0 and src[-1] == 1  # <s> ... <e>
+    assert trg[0] == 0 and nxt[-1] == 1
+    assert trg[1:] == nxt[:-1]
+    d_src, d_trg = pt.dataset.wmt14.get_dict(50)
+    assert d_src[0] == "<s>" and d_trg[1] == "<e>"
+
+
+def test_mq2007_synthetic_formats():
+    import paddle_tpu as pt
+
+    pair = next(iter(pt.dataset.mq2007.train("pairwise", synthetic=True)()))
+    assert pair[0] == 1.0 and pair[1].shape == (46,)
+    pt_feat, pt_label = next(
+        iter(pt.dataset.mq2007.train("pointwise", synthetic=True)()))
+    assert pt_feat.shape == (46,) and 0 <= pt_label <= 2
+    labels, feats = next(
+        iter(pt.dataset.mq2007.train("listwise", synthetic=True)()))
+    assert feats.shape == (len(labels), 46)
+
+
+def test_image_utils_numpy():
+    from paddle_tpu.dataset import image as im_utils
+
+    rs = np.random.RandomState(0)
+    im = (rs.rand(40, 60, 3) * 255).astype("uint8")
+    r = im_utils.resize_short(im, 32)
+    assert min(r.shape[:2]) == 32
+    c = im_utils.center_crop(r, 32)
+    assert c.shape[:2] == (32, 32)
+    rc = im_utils.random_crop(r, 24, rng=rs)
+    assert rc.shape[:2] == (24, 24)
+    fl = im_utils.left_right_flip(c)
+    np.testing.assert_allclose(np.asarray(fl)[:, ::-1], c)
+    chw = im_utils.to_chw(c)
+    assert chw.shape == (3, 32, 32)
+    t = im_utils.simple_transform(im, 36, 32, is_train=True, rng=rs)
+    assert t.shape == (3, 32, 32)
+    # bilinear resize sanity vs constant image
+    const = np.full((10, 10, 3), 7.0, "float32")
+    rr = im_utils.resize_short(const, 23)
+    np.testing.assert_allclose(rr, 7.0, rtol=1e-5)
