@@ -666,9 +666,11 @@ class Executor:
         for n, v in zip(entry.state_writes, new_state):
             scope.set_var(n, v)
         if entry.nan_check_ops:
-            per_op = np.asarray(nan_flags)
+            prefix_flags, suffix_flags = nan_flags
+            per_op = np.asarray(prefix_flags)
             if per_op.ndim == 2:
                 per_op = per_op.all(axis=0)
+            per_op = np.concatenate([per_op, np.asarray(suffix_flags)])
             bad = [d for d, ok in zip(entry.nan_check_ops, per_op) if not ok]
             if bad:
                 raise FloatingPointError(
@@ -795,6 +797,11 @@ class Executor:
                 check_nan_inf=check,
             )
             trace_block(block, envf, tctxf, ops=suffix_ops)
+            nan_check_ops.extend(d for d, _ in tctxf.nan_checks)
+            suf_flags = (
+                jnp.stack([f for _, f in tctxf.nan_checks])
+                if check and tctxf.nan_checks else jnp.ones((0,), bool)
+            )
             by_name = dict(zip(rw_state, rw_f))
             by_name.update(zip(wo_state, wo_last))
             # suffix outputs (param updates) win over scanned values
@@ -802,7 +809,7 @@ class Executor:
                 if n in envf and envf[n] is not None:
                     by_name[n] = envf[n]
             new_state = [by_name.get(n) for n in state_writes]
-            return fetches, new_state, all_flags
+            return fetches, new_state, (all_flags, suf_flags)
 
         jitted = jax.jit(acc_fn, donate_argnums=(1,))
         return _CompiledEntry(

@@ -65,18 +65,31 @@ def _test_mapper(im):
     return im.astype("float32") / 255.0
 
 
+def _maybe_cycle(reader, cycle):
+    if not cycle:
+        return reader
+
+    def cycled():
+        while True:
+            yield from reader()
+
+    return cycled
+
+
 def train(mapper=_train_mapper, buffered_size=1024, use_xmap=True,
           cycle=False, synthetic=False):
+    """buffered_size/use_xmap are performance hints of the reference's
+    xmap_readers pipeline; ordering semantics are unaffected here."""
     if common.use_synthetic(synthetic):
-        return _synthetic_reader(31)
-    return _real_reader("trnid", mapper)
+        return _maybe_cycle(_synthetic_reader(31), cycle)
+    return _maybe_cycle(_real_reader("trnid", mapper), cycle)
 
 
 def test(mapper=_test_mapper, buffered_size=1024, use_xmap=True,
          cycle=False, synthetic=False):
     if common.use_synthetic(synthetic):
-        return _synthetic_reader(32)
-    return _real_reader("tstid", mapper)
+        return _maybe_cycle(_synthetic_reader(32), cycle)
+    return _maybe_cycle(_real_reader("tstid", mapper), cycle)
 
 
 def valid(mapper=_test_mapper, buffered_size=1024, use_xmap=True,

@@ -233,26 +233,21 @@ class Layer:
             if p is not None:
                 self._own_params.append(p)
 
-    _in_call = False  # class-level: only the OUTERMOST __call__ adopts
-
     def __call__(self, *args, **kwargs):
         # adopt parameters created DURING forward (functional layers.*
         # calls create their weights on first use; without adoption a
         # layer mixing build-once sub-Layers with functional calls would
-        # silently drop the functional weights from parameters()).  Only
-        # the outermost call diffs the parameter list — nested sub-layer
-        # calls would otherwise rescan all parameters at every depth.
-        if Layer._in_call:
-            return self.forward(*args, **kwargs)
-        before = {p.name for p in fw.default_main_program().all_parameters()}
-        Layer._in_call = True
-        try:
-            out = self.forward(*args, **kwargs)
-        finally:
-            Layer._in_call = False
-        tracked = {p.name for p in self._tracked_parameters()}
-        for p in fw.default_main_program().all_parameters():
-            if p.name not in before and p.name not in tracked:
+        # silently drop the functional weights from parameters()).  Every
+        # nesting level adopts what appeared during ITS forward — so
+        # sub.parameters() works too — but only the appended tail is
+        # diffed (all_parameters() is creation-ordered), so steady-state
+        # cost after the first call is O(P) list construction, no set
+        # building.
+        before_len = len(fw.default_main_program().all_parameters())
+        out = self.forward(*args, **kwargs)
+        created = fw.default_main_program().all_parameters()[before_len:]
+        for p in created:
+            if all(p is not q for q in self._own_params):
                 self._track(p)
         return out
 

@@ -39,12 +39,19 @@ def multi_head_attention(
     keys = queries if keys is None else keys
     values = keys if values is None else values
 
+    # stable param names: the Megatron TP rules (parallel/sharding.py
+    # transformer_tp_rules) address these by regex
+    from ..core.framework import unique_name
+
     q = layers.fc(input=queries, size=d_key * n_head, bias_attr=False,
-                  num_flatten_dims=2)
+                  num_flatten_dims=2,
+                  param_attr=ParamAttr(name=unique_name("attn_q_w")))
     k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
-                  num_flatten_dims=2)
+                  num_flatten_dims=2,
+                  param_attr=ParamAttr(name=unique_name("attn_k_w")))
     v = layers.fc(input=values, size=d_value * n_head, bias_attr=False,
-                  num_flatten_dims=2)
+                  num_flatten_dims=2,
+                  param_attr=ParamAttr(name=unique_name("attn_v_w")))
 
     def split_heads(x, d):
         b, t, _ = x.shape
@@ -71,7 +78,8 @@ def multi_head_attention(
         b, t, h, d = ctx.shape
         ctx = layers.reshape(ctx, [b, t, h * d])
         return layers.fc(input=ctx, size=d_model, bias_attr=False,
-                         num_flatten_dims=2)
+                         num_flatten_dims=2,
+                         param_attr=ParamAttr(name=unique_name("attn_out_w")))
 
     q = split_heads(q, d_key)
     k = split_heads(k, d_key)
@@ -97,12 +105,21 @@ def multi_head_attention(
     b, h, t, d = ctx.shape
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [b, t, h * d])
-    return layers.fc(input=ctx, size=d_model, bias_attr=False, num_flatten_dims=2)
+    return layers.fc(input=ctx, size=d_model, bias_attr=False,
+                     num_flatten_dims=2,
+                     param_attr=ParamAttr(name=unique_name("attn_out_w")))
 
 
 def positionwise_feed_forward(x, d_inner_hid, d_hid):
-    hidden = layers.fc(input=x, size=d_inner_hid, act="relu", num_flatten_dims=2)
-    return layers.fc(input=hidden, size=d_hid, num_flatten_dims=2)
+    from ..core.framework import unique_name
+
+    hidden = layers.fc(input=x, size=d_inner_hid, act="relu",
+                       num_flatten_dims=2,
+                       param_attr=ParamAttr(name=unique_name("ffn_in_w")),
+                       bias_attr=ParamAttr(name=unique_name("ffn_in_b")))
+    return layers.fc(input=hidden, size=d_hid, num_flatten_dims=2,
+                     param_attr=ParamAttr(name=unique_name("ffn_out_w")),
+                     bias_attr=ParamAttr(name=unique_name("ffn_out_b")))
 
 
 def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):

@@ -259,12 +259,28 @@ class ShardedProgram:
 
 def transformer_tp_rules(model_axis="model"):
     """Megatron-style tensor-parallel PartitionSpecs for the bundled
-    transformer (models/transformer.py layer naming): attention QKV and
-    ffn-in weights split on the output dim, attention-out and ffn-out on the
-    input dim, embeddings on the vocab dim."""
+    transformer (models/transformer.py stable param names):
+
+      * attention q/k/v projections [d_model, h*d] — column-parallel
+        (split the head/output dim; each shard owns whole heads)
+      * attention output projection [h*d, d_model] — row-parallel
+        (split the input dim; GSPMD inserts the all-reduce)
+      * ffn-in [d_model, d_ff] column-parallel + its bias sharded the
+        same way; ffn-out [d_ff, d_model] row-parallel, bias replicated
+      * embedding tables [vocab, d_model] split on the vocab dim;
+        the tied/final vocab projection predict_w [d_model, vocab] on
+        its output (vocab) dim
+
+    Loss-parity vs single-device is asserted by
+    tests/test_sharding.py::test_transformer_tp_rules_loss_parity."""
     from jax.sharding import PartitionSpec as P
 
     return [
-        (r".*word_emb_table", P(model_axis, None)),
-        (r"fc_\d+\.w_0", P(None, model_axis)),  # refined per-model below
+        (r"(src|trg)_word_emb_table", P(model_axis, None)),
+        (r"attn_[qkv]_w_\d+", P(None, model_axis)),
+        (r"attn_out_w_\d+", P(model_axis, None)),
+        (r"ffn_in_w_\d+", P(None, model_axis)),
+        (r"ffn_in_b_\d+", P(model_axis)),
+        (r"ffn_out_w_\d+", P(model_axis, None)),
+        (r"predict_w", P(None, model_axis)),
     ]

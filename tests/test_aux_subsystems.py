@@ -208,3 +208,135 @@ def test_conv_bn_fuse_registered_as_pass():
     prog = pt.default_main_program().clone(for_test=True)
     n = pt.passes.apply_pass("conv_bn_fuse", prog, pt.global_scope())
     assert n == 1
+
+
+# -- round-4 pass framework v2: DAG matcher + attention_fuse ---------------
+
+
+def test_pattern_dag_matcher_multi_consumer():
+    """The DAG matcher handles a var feeding TWO pattern nodes (a shape no
+    linear chain matcher can express)."""
+    from paddle_tpu import passes
+
+    prog = pt.Program()
+    with pt.program_guard(prog, pt.Program()):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        a = layers.relu(x)
+        b = layers.sigmoid(a)     # consumer 1 of a
+        c = layers.tanh(a)        # consumer 2 of a
+        _ = layers.elementwise_add(b, c)
+    pat = (passes.Pattern()
+           .node("r", "relu").node("s", "sigmoid").node("t", "tanh")
+           .node("add", "elementwise_add")
+           .edge("r", "s", single_consumer=False)
+           .edge("r", "t", single_consumer=False)
+           .edge("s", "add", dst_slot="X")
+           .edge("t", "add", dst_slot="Y"))
+    ms = pat.match(prog.global_block())
+    assert len(ms) == 1
+    assert ms[0]["r"][1].type == "relu"
+
+
+def _hand_attention_prog(dropout, bias, seed=7):
+    """User-built matmul/softmax/matmul attention, NOT via contrib."""
+    prog, startup = pt.Program(), pt.Program()
+    prog.random_seed = startup.random_seed = seed
+    with pt.program_guard(prog, startup):
+        with pt.core.framework.guard_unique_name():
+            q = layers.data(name="q", shape=[2, 6, 8], dtype="float32")
+            k = layers.data(name="k", shape=[2, 6, 8], dtype="float32")
+            v = layers.data(name="v", shape=[2, 6, 8], dtype="float32")
+            scores = layers.matmul(q, k, transpose_y=True, alpha=8 ** -0.5)
+            if bias:
+                bvar = layers.data(name="b", shape=[2, 6, 6],
+                                   dtype="float32")
+                scores = layers.elementwise_add(scores, bvar)
+            w = layers.softmax(scores)
+            if dropout:
+                w = layers.dropout(w, dropout_prob=0.1)
+            out = layers.matmul(w, v)
+            res = layers.reduce_sum(out)
+    return prog, startup, res
+
+
+def test_attention_fuse_numeric_equivalence():
+    from paddle_tpu import passes
+
+    rng = np.random.RandomState(0)
+    feed = {n: rng.randn(3, 2, 6, 8).astype("float32") for n in "qkv"}
+    feed["b"] = rng.randn(3, 2, 6, 6).astype("float32")
+
+    prog, startup, res = _hand_attention_prog(dropout=False, bias=True)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        (before,) = exe.run(prog, feed=feed, fetch_list=[res], scope=scope)
+        n = passes.apply_pass("attention_fuse", prog, scope)
+        assert n == 1
+        types = [op.type for op in prog.global_block().ops]
+        assert "fused_attention" in types
+        assert "softmax" not in types
+        (after,) = exe.run(prog, feed=feed, fetch_list=[res], scope=scope)
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=2e-4, atol=1e-4)
+
+
+def test_attention_fuse_dropout_resited():
+    from paddle_tpu import passes
+
+    prog, startup, res = _hand_attention_prog(dropout=True, bias=False)
+    n = passes.apply_pass("attention_fuse", prog, None)
+    assert n == 1
+    types = [op.type for op in prog.global_block().ops]
+    assert "fused_attention" in types and "dropout" in types
+    assert types.index("fused_attention") < types.index("dropout")
+    # still runs end to end
+    rng = np.random.RandomState(1)
+    feed = {nm: rng.randn(3, 2, 6, 8).astype("float32") for nm in "qkv"}
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        (val,) = exe.run(prog, feed=feed, fetch_list=[res], scope=scope)
+    assert np.isfinite(np.asarray(val)).all()
+
+
+def test_attention_fuse_skips_non_canonical():
+    """No transpose_y (not attention-shaped) -> no rewrite."""
+    from paddle_tpu import passes
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        q = layers.data(name="q", shape=[2, 6, 8], dtype="float32")
+        k = layers.data(name="k", shape=[2, 8, 6], dtype="float32")
+        v = layers.data(name="v", shape=[2, 6, 8], dtype="float32")
+        w = layers.softmax(layers.matmul(q, k))
+        _ = layers.reduce_sum(layers.matmul(w, v))
+    assert passes.apply_pass("attention_fuse", prog, None) == 0
+
+
+def test_attention_fuse_v_producer_between():
+    """V computed AFTER the QK matmul: the fused op must insert after V's
+    producer (use-before-def regression)."""
+    from paddle_tpu import passes
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        q = layers.data(name="q", shape=[2, 6, 8], dtype="float32")
+        k = layers.data(name="k", shape=[2, 6, 8], dtype="float32")
+        x = layers.data(name="x", shape=[2, 6, 8], dtype="float32")
+        scores = layers.matmul(q, k, transpose_y=True, alpha=8 ** -0.5)
+        v = layers.scale(x, scale=2.0)     # V's producer AFTER qk matmul
+        w = layers.softmax(scores)
+        out = layers.matmul(w, v)
+        res = layers.reduce_sum(out)
+    assert passes.apply_pass("attention_fuse", prog, None) == 1
+    rng = np.random.RandomState(2)
+    feed = {nm: rng.randn(3, 2, 6, 8).astype("float32") for nm in ("q", "k", "x")}
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        (val,) = exe.run(prog, feed=feed, fetch_list=[res], scope=scope)
+    assert np.isfinite(np.asarray(val)).all()
