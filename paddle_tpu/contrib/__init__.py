@@ -1,3 +1,4 @@
 """Contrib tier (reference: python/paddle/fluid/contrib/)."""
 
 from . import quantize  # noqa: F401
+from . import slim  # noqa: F401

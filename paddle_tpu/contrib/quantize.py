@@ -157,3 +157,163 @@ class QuantizeTranspiler:
             block._bump()
             i += 1
         return count
+
+
+def freeze_int8(program: fw.Program, scope, startup_program=None) -> int:
+    """Convert a QAT-trained program (QuantizeTranspiler.training_transpile
+    structure) to an int8 INFERENCE program (the execution path the
+    reference reaches via quantize_op.cc/dequantize_op.cc + the slim
+    freeze pass):
+
+      * each quantized weight VALUE in `scope` is replaced by an int8
+        tensor plus a [1] f32 scale var — 4x smaller storage;
+      * fake_quantize on activations becomes a real `quantize` op reading
+        the trained moving-average scale (or a runtime abs-max);
+      * mul / conv2d consumers become int8_mul / int8_conv2d: int8 x int8
+        with int32 accumulation on the MXU, scales folded back in f32;
+      * all fake_dequantize ops disappear.
+
+    Returns the number of converted consumer ops.  Run on a clone(for_test
+    =True) program; the original float program stays usable.
+    """
+    import numpy as np
+
+    block = program.global_block()
+
+    # producer map is cached and rebuilt only after mutations (building it
+    # per trace_back would make the pass O(ops^2))
+    _prod_cache = [None]
+
+    def producers():
+        if _prod_cache[0] is None:
+            _prod_cache[0] = {n: (i, op)
+                              for i, op in enumerate(block.ops)
+                              for n in op.output_arg_names()}
+        return _prod_cache[0]
+
+    def invalidate_producers():
+        _prod_cache[0] = None
+
+    def trace_back(name):
+        """name '.dequantized' -> (orig_name, scale_source, quant_op_info,
+        dequant_op_info)"""
+        prod = producers()
+        if name not in prod:
+            return None
+        di, dop = prod[name]
+        if dop.type != "fake_dequantize_max_abs":
+            return None
+        qname = dop.input("X")[0]
+        qi, qop = prod[qname]
+        orig = qop.input("X")[0]
+        if qop.type == "fake_quantize_abs_max":
+            scale_src = qop.output("OutScale")[0]
+            kind = "abs_max"
+        elif qop.type == "fake_quantize_moving_average_abs_max":
+            scale_src = qop.input("InScale")[0]
+            kind = "moving_average"
+        else:
+            return None
+        return orig, scale_src, kind, (qi, qop), (di, dop)
+
+    params = {p.name for p in block.all_parameters()}
+    slot_map = {"conv2d": ("Input", "Filter"), "mul": ("X", "Y"),
+                "depthwise_conv2d": ("Input", "Filter")}
+    int8_type = {"conv2d": "int8_conv2d", "depthwise_conv2d": "int8_conv2d",
+                 "mul": "int8_mul"}
+    scale_slots = {"int8_conv2d": ("ScaleX", "ScaleW"),
+                   "int8_mul": ("ScaleX", "ScaleY")}
+    in_slots = {"int8_conv2d": ("Input", "Filter"),
+                "int8_mul": ("X", "Y")}
+
+    count = 0
+    i = 0
+    to_remove = set()
+    while i < len(block.ops):
+        op = block.ops[i]
+        slots = slot_map.get(op.type)
+        if slots is None:
+            i += 1
+            continue
+        traced = [trace_back(op.input(s)[0]) for s in slots]
+        if any(t is None for t in traced):
+            i += 1
+            continue
+        nt = int8_type[op.type]
+        new_inputs = {}
+        for (orig, scale_src, kind, qinfo, dinfo), islot, sslot in zip(
+                traced, in_slots[nt], scale_slots[nt]):
+            to_remove.add(qinfo[0])
+            to_remove.add(dinfo[0])
+            if orig in params:
+                # offline weight quantization: int8 value + scale in scope
+                w = np.asarray(scope.find_var(orig))
+                scale = float(np.max(np.abs(w))) or 1e-8
+                wq = np.clip(np.round(w / scale * 127.0), -127,
+                             127).astype(np.int8)
+                scope.set_var(orig, wq)
+                sname = orig + "@int8_scale"
+                sv = block.create_var(name=sname, shape=[1],
+                                      dtype="float32", persistable=True)
+                sv.stop_gradient = True
+                scope.set_var(sname, np.asarray([scale], "float32"))
+                wvar = block._find_var_recursive(orig)
+                if wvar is not None:
+                    wvar.dtype = "int8"
+                new_inputs[islot] = [orig]
+                new_inputs[sslot] = [sname]
+            else:
+                if kind != "moving_average":
+                    raise NotImplementedError(
+                        "freeze_int8: activation quantized with abs_max "
+                        "has no stored scale to freeze — train with "
+                        "activation_quantize_type="
+                        "'moving_average_abs_max'")
+                # runtime activation quantization against the trained scale
+                aq = fw.unique_name(orig + "@int8")
+                block.create_var(name=aq, dtype="int8")
+                block.insert_op(
+                    i, "quantize",
+                    inputs={"X": [orig], "Scale": [scale_src]},
+                    outputs={"Out": [aq]},
+                )
+                invalidate_producers()
+                # inserting shifts every recorded index at/after i
+                to_remove = {j + 1 if j >= i else j for j in to_remove}
+                i += 1
+                new_inputs[islot] = [aq]
+                new_inputs[sslot] = [scale_src]
+        # rewrite the consumer in place
+        op.type = nt
+        op.inputs = new_inputs
+        if nt == "int8_conv2d":
+            op.outputs = {"Out": op.outputs.get("Output", op.outputs.get("Out"))}
+        count += 1
+        i += 1
+    for j in sorted(to_remove, reverse=True):
+        block.remove_op(j)
+    block._bump()
+    return count
+
+
+def quantize_var(x, scale, name=None):
+    """Append a real `quantize` op (f32 -> int8 with scale); building
+    block for custom int8 graphs outside freeze_int8."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("quantize", name=name)
+    out = helper.create_variable_for_type_inference("int8")
+    helper.append_op("quantize", inputs={"X": [x], "Scale": [scale]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def dequantize_var(x, scale, name=None):
+    """Append a real `dequantize` op (int8 -> f32 with scale)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("dequantize", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("dequantize", inputs={"X": [x], "Scale": [scale]},
+                     outputs={"Out": [out]})
+    return out

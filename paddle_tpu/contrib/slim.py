@@ -1,0 +1,157 @@
+"""Model compression strategies (reference: python/paddle/fluid/contrib/
+slim/ — the Compressor framework with pruning / distillation /
+quantization strategies).
+
+TPU-first scope:
+  * magnitude pruning writes persistable 0/1 masks into the scope and
+    (for training) rewrites the program so every pruned weight is
+    multiplied by its mask — pruned entries stay zero through optimizer
+    updates because their gradients are masked too (the mask multiply is
+    part of the traced graph, so its vjp zeroes the cotangent);
+  * distillation losses are layer compositions (soft-label KD, hint/L2,
+    FSP) matching contrib/slim/distillation strategies;
+  * quantization strategy = contrib.quantize (QAT) + freeze_int8.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import layers
+from ..core import framework as fw
+
+
+class Pruner:
+    """Magnitude pruner (reference slim/prune strategies: ratio-based
+    magnitude pruning)."""
+
+    def __init__(self, ratios: Dict[str, float]):
+        """ratios: {param-name regex: prune fraction in [0, 1)}; first
+        matching rule wins."""
+        self.ratios = list(ratios.items())
+
+    def _ratio_for(self, name: str) -> Optional[float]:
+        for pat, r in self.ratios:
+            if re.fullmatch(pat, name):
+                return r
+        return None
+
+    def prune(self, program: fw.Program, scope) -> List[str]:
+        """Compute masks from current weight magnitudes, zero the pruned
+        entries in the scope, and rewrite the program so each pruned
+        parameter is masked at every forward (training keeps them zero).
+        Returns the pruned parameter names."""
+        block = program.global_block()
+        if any(op.type.endswith("_grad") for op in block.ops):
+            raise RuntimeError(
+                "Pruner.prune must run BEFORE optimizer.minimize(): the "
+                "mask multiply has to be part of the differentiated graph "
+                "so pruned entries get zero gradients")
+        pruned = []
+        for p in list(block.all_parameters()):
+            ratio = self._ratio_for(p.name)
+            if not ratio:
+                continue
+            w = np.asarray(scope.find_var(p.name))
+            k = int(round(w.size * ratio))
+            if k <= 0:
+                continue
+            thresh = np.partition(np.abs(w).ravel(), k - 1)[k - 1]
+            mask = (np.abs(w) > thresh).astype(w.dtype)
+            scope.set_var(p.name, w * mask)
+            mask_name = p.name + "@prune_mask"
+            mv = block.create_var(name=mask_name, shape=list(w.shape),
+                                  dtype=str(w.dtype), persistable=True)
+            mv.stop_gradient = True
+            scope.set_var(mask_name, mask)
+            self._mask_param(block, p.name, mask_name)
+            pruned.append(p.name)
+        return pruned
+
+    def _mask_param(self, block, name, mask_name):
+        """Insert masked = w * mask before the first consumer and rewire
+        every consumer of `name` to the masked var."""
+        masked = fw.unique_name(name + "@masked")
+        block.create_var(name=masked, dtype="float32")
+        first = None
+        for i, op in enumerate(block.ops):
+            if name in op.input_arg_names():
+                first = i
+                break
+        if first is None:
+            return
+        for op in block.ops:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [masked if n == name else n
+                                   for n in names]
+        block.insert_op(
+            first, "elementwise_mul",
+            inputs={"X": [name], "Y": [mask_name]},
+            outputs={"Out": [masked]},
+        )
+
+    @staticmethod
+    def sparsity(scope, names) -> float:
+        zeros = total = 0
+        for n in names:
+            w = np.asarray(scope.find_var(n))
+            zeros += int((w == 0).sum())
+            total += w.size
+        return zeros / max(total, 1)
+
+
+# -- distillation losses (reference slim/distillation strategies) ----------
+
+
+def soft_label_loss(teacher_logits, student_logits, temperature=2.0):
+    """KD loss: CE(softmax(t/T), softmax(s/T)) * T^2 (Hinton KD; reference
+    slim distillation soft_label_loss)."""
+    t = layers.softmax(layers.scale(teacher_logits,
+                                    scale=1.0 / temperature))
+    t.stop_gradient = True
+    s = layers.softmax(layers.scale(student_logits,
+                                    scale=1.0 / temperature))
+    ce = layers.reduce_sum(
+        layers.elementwise_mul(
+            t, layers.scale(layers.log(layers.scale(s, bias=1e-8)),
+                            scale=-1.0)),
+        dim=1, keep_dim=True)
+    return layers.scale(layers.mean(ce), scale=temperature * temperature)
+
+
+def l2_loss(teacher_feat, student_feat):
+    """Hint/L2 feature distillation (reference slim l2_loss)."""
+    return layers.mean(
+        layers.square(layers.elementwise_sub(student_feat, teacher_feat)))
+
+
+def fsp_loss(teacher_a, teacher_b, student_a, student_b):
+    """Flow-of-solution-procedure distillation (reference slim fsp_loss:
+    match the Gram matrix between two feature maps)."""
+    tf = layers.fsp_matrix(teacher_a, teacher_b)
+    tf.stop_gradient = True
+    sf = layers.fsp_matrix(student_a, student_b)
+    return layers.mean(layers.square(layers.elementwise_sub(sf, tf)))
+
+
+class Compressor:
+    """Strategy orchestrator (reference slim/core Compressor, simplified
+    to the capabilities above): apply pruning before training, report
+    sparsity, optionally freeze to int8 after."""
+
+    def __init__(self, program, scope, pruner: Optional[Pruner] = None):
+        self.program = program
+        self.scope = scope
+        self.pruner = pruner
+        self.pruned_params: List[str] = []
+
+    def compress(self):
+        if self.pruner is not None:
+            self.pruned_params = self.pruner.prune(self.program, self.scope)
+        return self
+
+    def sparsity(self) -> float:
+        return Pruner.sparsity(self.scope, self.pruned_params)

@@ -590,6 +590,41 @@ class Executor:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
 
+    def run_startup_missing(self, startup_program=None, scope=None):
+        """Run only the startup ops whose outputs are NOT yet in the scope
+        (init-on-demand).  Needed when graph surgery adds initialized state
+        after the startup program already ran — e.g. slim pruning before
+        optimizer.minimize(), whose learning-rate/accumulator initializers
+        land in an already-executed startup program.  Returns the number
+        of ops executed."""
+        startup = startup_program or fw.default_startup_program()
+        scope = scope or global_scope()
+        src = startup.global_block()
+        missing = [
+            op for op in src.ops
+            if any(scope.find_var(n) is None for n in op.output_arg_names())
+        ]
+        if not missing:
+            return 0
+        sub = fw.Program()
+        blk = sub.global_block()
+        names = set()
+        for op in missing:
+            names.update(op.input_arg_names())
+            names.update(op.output_arg_names())
+        for n in names:
+            v = src._find_var_recursive(n)
+            if v is not None:
+                blk.create_var(name=n, shape=v.shape, dtype=v.dtype,
+                               persistable=getattr(v, "persistable", True))
+            else:
+                blk.create_var(name=n, dtype="float32", persistable=True)
+        for op in missing:
+            blk.append_op(op.type, dict(op.inputs), dict(op.outputs),
+                          dict(op.attrs))
+        self.run(sub, scope=scope)
+        return len(missing)
+
     def run_accumulated(
         self,
         program: Optional[fw.Program] = None,

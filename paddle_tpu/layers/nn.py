@@ -1461,3 +1461,89 @@ def precision_recall(input, label, class_number, weights=None,
         attrs={"class_number": class_number},
     )
     return batch_m, accum_m, accum_s
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=None, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    """3D transpose convolution (reference conv_transpose_op.cc
+    conv3d_transpose; NCDHW, filter [C_in, C_out/g, kd, kh, kw])."""
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    c_in = input.shape[1]
+    groups = groups or 1
+
+    def _triple(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    fs = _triple(filter_size)
+    w = helper.create_parameter(
+        helper.param_attr(),
+        shape=[c_in, num_filters // groups] + fs, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": _triple(stride), "paddings": _triple(padding),
+               "dilations": _triple(dilation), "groups": groups},
+    )
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr(),
+                                    shape=[num_filters], dtype=dtype,
+                                    is_bias=True)
+        pre = helper.create_variable_for_type_inference(dtype)
+        helper.append_op("elementwise_add", inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [pre]}, attrs={"axis": 1})
+        out = pre
+    return helper.append_activation(out)
+
+
+def max_pool2d_with_index(input, pool_size, pool_stride=None, pool_padding=0,
+                          name=None):
+    """Max pool returning (out, flat argmax indices) — the Indices feed
+    layers.unpool (reference pool_with_index_op.cc)."""
+    helper = LayerHelper("max_pool2d_with_index", name=name)
+
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference("int32")
+    ks = _pair(pool_size)
+    helper.append_op(
+        "max_pool2d_with_index",
+        inputs={"X": [input]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={"ksize": ks,
+               "strides": _pair(pool_stride) if pool_stride else ks,
+               "paddings": _pair(pool_padding)},
+    )
+    return out, mask
+
+
+def py_func(func, x, out_shapes, out_dtypes, name=None):
+    """Host-Python escape hatch (reference layers/nn.py:9655 py_func,
+    py_func_op.cc), realized with jax.pure_callback: `func` must be a
+    PURE function of its numpy inputs; it runs on the host every step.
+    out_shapes/out_dtypes declare the outputs (static shapes — TPU).
+    Returns one Variable per declared output."""
+    from ..ops.misc_ops import register_py_func
+
+    helper = LayerHelper("py_func", name=name)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    fid = register_py_func(func)
+    outs = [helper.create_variable_for_type_inference(d)
+            for d in out_dtypes]
+    helper.append_op(
+        "py_func",
+        inputs={"X": list(xs)},
+        outputs={"Out": outs},
+        attrs={"func_id": fid,
+               "out_shapes": [list(s) for s in out_shapes],
+               "out_dtypes": list(out_dtypes)},
+    )
+    for o, s in zip(outs, out_shapes):
+        o.shape = tuple(s)
+    return outs if len(outs) > 1 else outs[0]

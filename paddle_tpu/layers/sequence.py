@@ -436,3 +436,60 @@ def sequence_erase(input, tokens, name=None):
                      attrs={"tokens": list(tokens)})
     out.shape = input.shape
     return out
+
+
+def sequence_expand_as(x, y, name=None):
+    """reference sequence_expand_as_op.cc (dense: [B, D] -> [B, T, D])."""
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_expand_as", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    if x.shape and y.shape:
+        feat = tuple(x.shape[1:])
+        if len(feat) == 2 and feat[0] == 1:
+            feat = feat[1:]  # the lowering squeezes [B, 1, D] to [B, D]
+        out.shape = (x.shape[0], y.shape[1]) + feat
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """reference sequence_reshape_op.cc."""
+    helper = LayerHelper("sequence_reshape", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_reshape", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"new_dim": new_dim})
+    if input.shape:
+        b, t, d = input.shape
+        out.shape = (b, t * d // new_dim, new_dim)
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """reference sequence_scatter_op.cc (dense: per-row column scatter-add)."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "sequence_scatter",
+        inputs={"X": [input], "Ids": [index], "Updates": [updates]},
+        outputs={"Out": [out]},
+    )
+    out.shape = input.shape
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, length=None, name=None):
+    """reference sequence_enumerate_op.cc."""
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        "sequence_enumerate",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"win_size": win_size, "pad_value": pad_value},
+    )
+    if input.shape:
+        out.shape = tuple(input.shape[:2]) + (win_size,)
+    return out

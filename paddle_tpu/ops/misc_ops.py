@@ -562,3 +562,49 @@ def lower_unpool(ctx, ins):
         jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None], idx2
     ].add(vals)
     return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+# py_func escape hatch ------------------------------------------------------
+
+_PY_FUNC_REGISTRY: dict = {}
+
+
+def register_py_func(fn) -> int:
+    """Register a host Python callable; returns its id attr (the layers
+    wrapper does this). Mirrors the reference's PyFuncRegistry
+    (py_func_op.cc)."""
+    fid = len(_PY_FUNC_REGISTRY)
+    _PY_FUNC_REGISTRY[fid] = fn
+    return fid
+
+
+@register("py_func", no_grad=True)
+def lower_py_func(ctx, ins):
+    """Arbitrary user Python inside the compiled program via
+    jax.pure_callback (reference py_func_op.cc / layers/nn.py:9655
+    py_func).  The callable must be a pure function of its inputs; it
+    runs on the HOST each step (a deliberate escape hatch, not a fast
+    path).  Output shapes/dtypes come from the declared out specs."""
+    import jax
+    import jax.numpy as jnp
+
+    fid = ctx.attr("func_id")
+    fn = _PY_FUNC_REGISTRY[fid]
+    out_shapes = ctx.attr("out_shapes")
+    out_dtypes = ctx.attr("out_dtypes")
+    xs = [v for v in ins.get("X", []) if v is not None]
+    specs = [
+        jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+        for s, d in zip(out_shapes, out_dtypes)
+    ]
+
+    def host_fn(*arrays):
+        import numpy as _np
+
+        res = fn(*arrays)
+        if not isinstance(res, (list, tuple)):
+            res = (res,)
+        return tuple(_np.asarray(r).astype(d) for r, d in zip(res, out_dtypes))
+
+    outs = jax.pure_callback(host_fn, tuple(specs), *xs, vmap_method="sequential")
+    return {"Out": list(outs)}

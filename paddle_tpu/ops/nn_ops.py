@@ -925,3 +925,73 @@ def lower_pool3d(ctx, ins):
         c = lax.reduce_window(ones, 0.0, lax.add, window, strides_, pads)
         out = s / c
     return {"Out": [out]}
+
+
+@register("conv3d_transpose")
+def lower_conv3d_transpose(ctx, ins):
+    """3D transpose conv as input-dilated conv (reference
+    conv_transpose_op.cc conv3d_transpose; filter [C_in, C_out/g, kd, kh,
+    kw])."""
+    import jax.lax as lax
+
+    jnp = _jnp()
+    x, w = ins["Input"][0], ins["Filter"][0]
+    s = ctx.attr("strides", [1, 1, 1])
+    p = ctx.attr("paddings", [0, 0, 0])
+    d = ctx.attr("dilations", [1, 1, 1])
+    g = ctx.attr("groups", 1) or 1
+    c_in, co_g, kd, kh, kw = w.shape
+    w2 = w.reshape(g, c_in // g, co_g, kd, kh, kw)
+    w2 = jnp.transpose(w2, (0, 2, 1, 3, 4, 5)).reshape(
+        g * co_g, c_in // g, kd, kh, kw)
+    w2 = jnp.flip(w2, axis=(-3, -2, -1))
+    pads = [(d[i] * (k - 1) - p[i],) * 2 for i, k in enumerate((kd, kh, kw))]
+    out = lax.conv_general_dilated(
+        x, w2,
+        window_strides=(1, 1, 1),
+        padding=pads,
+        lhs_dilation=tuple(s),
+        rhs_dilation=tuple(d),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=g,
+    )
+    return {"Output": [out]}
+
+
+@register("max_pool2d_with_index")
+def lower_max_pool2d_with_index(ctx, ins):
+    """Max pool that also returns the flat argmax index within each input
+    map (reference pool_with_index_op.cc) — the Indices feed unpool."""
+    import jax.lax as lax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    ks = ctx.attr("ksize", [2, 2])
+    s = ctx.attr("strides", ks)
+    p = ctx.attr("paddings", [0, 0])
+    n, c, h, w = x.shape
+    oh = (h + 2 * p[0] - ks[0]) // s[0] + 1
+    ow = (w + 2 * p[1] - ks[1]) // s[1] + 1
+    # one gather window per output cell: [oh, ow, kh, kw] source coords
+    ys = (jnp.arange(oh) * s[0] - p[0])[:, None, None, None] + \
+        jnp.arange(ks[0])[None, None, :, None]
+    xs = (jnp.arange(ow) * s[1] - p[1])[None, :, None, None] + \
+        jnp.arange(ks[1])[None, None, None, :]
+    inb = (ys >= 0) & (ys < h) & (xs >= 0) & (xs < w)
+    yc = jnp.clip(ys, 0, h - 1)
+    xc = jnp.clip(xs, 0, w - 1)
+    vals = x[:, :, yc, xc]                          # [N, C, oh, ow, kh, kw]
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    vals = jnp.where(inb[None, None], vals, neg)
+    flat = vals.reshape(n, c, oh, ow, -1)
+    best = jnp.argmax(flat, axis=-1)
+    out = jnp.take_along_axis(flat, best[..., None], axis=-1)[..., 0]
+    # flat index into the ORIGINAL [h, w] map (reference convention)
+    by = jnp.take_along_axis(
+        jnp.broadcast_to(yc[None, None], vals.shape).reshape(
+            n, c, oh, ow, -1), best[..., None], axis=-1)[..., 0]
+    bx = jnp.take_along_axis(
+        jnp.broadcast_to(xc[None, None], vals.shape).reshape(
+            n, c, oh, ow, -1), best[..., None], axis=-1)[..., 0]
+    idx = (by * w + bx).astype(jnp.int32)
+    return {"Out": [out], "Mask": [idx]}

@@ -106,3 +106,77 @@ def lower_fake_dequantize_max_abs(ctx, ins):
     max_range = ctx.attr("max_range", _qrange(ctx))
     return {"Out": [(x.astype(jnp.float32) * scale / max_range
                      ).astype(x.dtype)]}
+
+
+# -- int8 inference execution (reference quantize_op.cc / dequantize_op.cc,
+#    the mkldnn int8 path; TPU-first: int8 storage + int32-accumulated
+#    dot_general, scales folded back in fp32) -------------------------------
+
+
+@register("quantize", no_grad=True)
+def lower_quantize(ctx, ins):
+    """f32 -> int8 with a scale (Scale input [1] or attr): q = clip(
+    round(x / scale * 127), -127, 127)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    if ins.get("Scale"):
+        scale = ins["Scale"][0].reshape(())
+    else:
+        scale = jnp.asarray(ctx.attr("scale", 1.0), x.dtype)
+    q = jnp.clip(jnp.round(x / scale * 127.0), -127, 127)
+    return {"Out": [q.astype(jnp.int8)]}
+
+
+@register("dequantize", no_grad=True)
+def lower_dequantize(ctx, ins):
+    jnp = _jnp()
+    x = ins["X"][0].astype(jnp.float32)
+    if ins.get("Scale"):
+        scale = ins["Scale"][0].reshape(())
+    else:
+        scale = ctx.attr("scale", 1.0)
+    return {"Out": [x * scale / 127.0]}
+
+
+@register("int8_mul", no_grad=True)
+def lower_int8_mul(ctx, ins):
+    """int8 x int8 matmul with int32 accumulation; output rescaled to f32
+    by sx*sy/127^2.  The executable int8 path the reference reaches via
+    its mkldnn quantize/dequantize kernels."""
+    import jax.lax as lax
+
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    sx = ins["ScaleX"][0].reshape(()) if ins.get("ScaleX") else 1.0
+    sy = ins["ScaleY"][0].reshape(()) if ins.get("ScaleY") else 1.0
+    x2 = x.reshape(-1, x.shape[-1])
+    acc = lax.dot_general(
+        x2, y, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (sx * sy / (127.0 * 127.0))
+    return {"Out": [out.reshape(x.shape[:-1] + (y.shape[1],))]}
+
+
+@register("int8_conv2d", no_grad=True)
+def lower_int8_conv2d(ctx, ins):
+    """int8 conv with int32 accumulation (NCHW, OIHW), rescaled to f32."""
+    import jax.lax as lax
+
+    jnp = _jnp()
+    x, w = ins["Input"][0], ins["Filter"][0]
+    sx = ins["ScaleX"][0].reshape(()) if ins.get("ScaleX") else 1.0
+    sw = ins["ScaleW"][0].reshape(()) if ins.get("ScaleW") else 1.0
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    d = ctx.attr("dilations", [1, 1])
+    g = ctx.attr("groups", 1) or 1
+    acc = lax.conv_general_dilated(
+        x, w,
+        window_strides=tuple(s),
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=tuple(d),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=g,
+        preferred_element_type=jnp.int32,
+    )
+    return {"Out": [acc.astype(jnp.float32) * (sx * sw / (127.0 * 127.0))]}

@@ -475,3 +475,65 @@ def lower_im2sequence(ctx, ins):
     )  # [n, c*kh*kw, oh, ow]
     out = patches.reshape(n, c * kh * kw, oh * ow).transpose(0, 2, 1)
     return {"Out": [out]}
+
+
+@register("sequence_expand_as")
+def lower_sequence_expand_as(ctx, ins):
+    """Tile each X row to match Y's time dim (reference
+    sequence_expand_as_op.cc; padded idiom: X [B, D] or [B, 1, D] ->
+    [B, T, D] with T from Y)."""
+    jnp = _jnp()
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim == 3 and x.shape[1] == 1:
+        x = x[:, 0]
+    t = y.shape[1]
+    return {"Out": [jnp.repeat(x[:, None], t, axis=1)]}
+
+
+@register("sequence_reshape")
+def lower_sequence_reshape(ctx, ins):
+    """Re-chunk the feature dim (reference sequence_reshape_op.cc: each
+    timestep of width D becomes D/new_dim steps of width new_dim; dense
+    idiom reshapes [B, T, D] -> [B, T*D/new_dim, new_dim])."""
+    x = ins["X"][0]
+    new_dim = ctx.attr("new_dim")
+    b, t, d = x.shape
+    return {"Out": [x.reshape(b, t * d // new_dim, new_dim)]}
+
+
+@register("sequence_scatter", no_grad=False)
+def lower_sequence_scatter(ctx, ins):
+    """Scatter per-sequence updates into X (reference
+    sequence_scatter_op.cc: X [B, D], Ids [B, S] column indices per row,
+    Updates [B, S]; out[b, ids[b,s]] += updates[b,s])."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    ids = ins["Ids"][0].astype("int32")
+    upd = ins["Updates"][0]
+    if ids.ndim == 3:
+        ids = ids[..., 0]
+    b = x.shape[0]
+    bi = jnp.broadcast_to(jnp.arange(b)[:, None], ids.shape)
+    out = x.at[bi.reshape(-1), ids.reshape(-1)].add(
+        upd.reshape(-1).astype(x.dtype))
+    return {"Out": [out]}
+
+
+@register("sequence_enumerate", no_grad=True)
+def lower_sequence_enumerate(ctx, ins):
+    """All win_size-length sub-sequences per step (reference
+    sequence_enumerate_op.cc): X [B, T] int -> Out [B, T, win_size],
+    steps beyond each row's Length (or T) padded with pad_value."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    if x.ndim == 3:
+        x = x[..., 0]
+    win = ctx.attr("win_size")
+    pad = ctx.attr("pad_value", 0)
+    b, t = x.shape
+    length = _length_or_full(ins, x[:, :, None])
+    idx = jnp.arange(t)[:, None] + jnp.arange(win)[None, :]   # [T, W]
+    valid = idx[None] < length[:, None, None]                 # [B, T, W]
+    gathered = x[:, jnp.minimum(idx, t - 1)]                  # [B, T, W]
+    return {"Out": [jnp.where(valid, gathered,
+                              jnp.asarray(pad, x.dtype))]}

@@ -316,6 +316,10 @@ def _attention_fuse(program: fw.Program, scope=None) -> int:
                     for key in ("qk", "add", "sm"):
                         if key in m:
                             removed_outs |= set(m[key][1].output_arg_names())
+                    if with_dropout:
+                        # the dropout's original output (the attention
+                        # weights) loses its producer in the rewrite
+                        removed_outs |= set(m["drop"][1].output_arg_names())
                     if removed_outs & fetch_names:
                         continue
 
@@ -326,16 +330,21 @@ def _attention_fuse(program: fw.Program, scope=None) -> int:
                     attrs = {"scale": qk.attr("alpha", 1.0), "fmt": "bhtd"}
                     av_out = av.output("Out")[0]
 
+                    drop_spec = None
                     if with_dropout:
                         drop = m["drop"][1]
                         fused_out = fw.unique_name("attn_fuse_out")
-                        block.create_var(name=fused_out,
-                                         dtype=qvar.dtype)
-                        # dropout re-sited onto the fused output
-                        drop.inputs["X"] = [fused_out]
-                        drop.outputs["Out"] = [av_out]
+                        block.create_var(name=fused_out, dtype=qvar.dtype)
+                        # dropout re-sited onto the fused output; the op is
+                        # REBUILT after the fused op (V's producer may sit
+                        # between the old dropout and AV matmul positions,
+                        # so the old dropout slot can precede V)
+                        drop_spec = (dict(drop.attrs),
+                                     {"X": [fused_out]},
+                                     {"Out": [av_out],
+                                      "Mask": drop.outputs.get("Mask", [])})
                         out_name = fused_out
-                        remove_keys = ("qk", "add", "sm", "av")
+                        remove_keys = ("qk", "add", "sm", "drop", "av")
                     else:
                         out_name = av_out
                         remove_keys = ("qk", "add", "sm", "av")
@@ -344,15 +353,10 @@ def _attention_fuse(program: fw.Program, scope=None) -> int:
                                   reverse=True)
                     for i in idxs:
                         block.remove_op(i)
-                    # insert late enough that V's producer (which may sit
-                    # between the QK matmul and the AV matmul) stays ahead
-                    # of the fused op — but before the kept dropout op,
-                    # which now consumes the fused output
-                    if with_dropout:
-                        anchor = m["drop"][0]
-                    else:
-                        anchor = max(idxs)
-                    pos = anchor - sum(1 for i in idxs if i < anchor)
+                    # insert where the AV matmul stood (highest removed
+                    # index, shifted): every input's producer — including
+                    # V's — is above that point by construction
+                    pos = max(idxs) - (len(idxs) - 1)
                     block.insert_op(
                         pos,
                         "fused_attention",
@@ -360,6 +364,10 @@ def _attention_fuse(program: fw.Program, scope=None) -> int:
                         outputs={"Out": [out_name]},
                         attrs=attrs,
                     )
+                    if drop_spec is not None:
+                        d_attrs, d_in, d_out = drop_spec
+                        block.insert_op(pos + 1, "dropout", inputs=d_in,
+                                        outputs=d_out, attrs=d_attrs)
                     total += 1
                     changed = True
                     break  # indices shifted: rescan

@@ -340,3 +340,35 @@ def test_attention_fuse_v_producer_between():
         exe.run(startup, scope=scope)
         (val,) = exe.run(prog, feed=feed, fetch_list=[res], scope=scope)
     assert np.isfinite(np.asarray(val)).all()
+
+
+def test_attention_fuse_dropout_v_producer_between():
+    """Dropout variant with V computed between dropout and the AV matmul:
+    the rebuilt dropout must land after the fused op and after V."""
+    from paddle_tpu import passes
+
+    prog, startup = pt.Program(), pt.Program()
+    prog.random_seed = 3
+    with pt.program_guard(prog, startup):
+        q = layers.data(name="q", shape=[2, 6, 8], dtype="float32")
+        k = layers.data(name="k", shape=[2, 6, 8], dtype="float32")
+        x = layers.data(name="x", shape=[2, 6, 8], dtype="float32")
+        w = layers.dropout(
+            layers.softmax(layers.matmul(q, k, transpose_y=True)),
+            dropout_prob=0.2)
+        v = layers.scale(x, scale=0.5)     # V AFTER the dropout op
+        out = layers.matmul(w, v)
+        res = layers.reduce_sum(out)
+    assert passes.apply_pass("attention_fuse", prog, None) == 1
+    types = [op.type for op in prog.global_block().ops]
+    assert types.index("fused_attention") > types.index("scale")
+    assert types.index("dropout") == types.index("fused_attention") + 1
+    rng2 = np.random.RandomState(5)
+    feed = {nm: rng2.randn(3, 2, 6, 8).astype("float32")
+            for nm in ("q", "k", "x")}
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        (val,) = exe.run(prog, feed=feed, fetch_list=[res], scope=scope)
+    assert np.isfinite(np.asarray(val)).all()

@@ -1,0 +1,196 @@
+"""Round-4 small-gap features: sequence expand_as/reshape/scatter/
+enumerate, conv3d_transpose, max_pool2d_with_index (+unpool round trip),
+py_func, int8 inference execution (freeze_int8), slim pruning +
+distillation losses."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+rng = np.random.RandomState(6)
+
+
+def _run(fetch, feed, startup=False):
+    exe = pt.Executor(pt.CPUPlace())
+    if startup:
+        exe.run(pt.default_startup_program())
+    return [np.asarray(r) for r in exe.run(feed=feed, fetch_list=fetch)]
+
+
+def test_sequence_quartet():
+    b, t, d = 2, 4, 6
+    x2 = rng.randn(b, d).astype("float32")
+    y3 = rng.randn(b, t, d).astype("float32")
+    toks = rng.randint(0, 9, (b, t)).astype("int64")
+    x2v = layers.data(name="x2", shape=[d], dtype="float32")
+    y3v = layers.data(name="y3", shape=[t, d], dtype="float32")
+    tkv = layers.data(name="tk", shape=[t], dtype="int64")
+    ea = layers.sequence_expand_as(x2v, y3v)
+    rs = layers.sequence_reshape(y3v, new_dim=3)
+    ids = rng.randint(0, d, (b, 3)).astype("int64")
+    upd = rng.randn(b, 3).astype("float32")
+    iv = layers.data(name="ids", shape=[3], dtype="int64")
+    uv = layers.data(name="upd", shape=[3], dtype="float32")
+    sc = layers.sequence_scatter(x2v, iv, uv)
+    en = layers.sequence_enumerate(tkv, win_size=2, pad_value=-1)
+    r1, r2, r3, r4 = _run([ea, rs, sc, en],
+                          {"x2": x2, "y3": y3, "tk": toks,
+                           "ids": ids, "upd": upd})
+    np.testing.assert_allclose(r1, np.repeat(x2[:, None], t, 1))
+    np.testing.assert_allclose(r2, y3.reshape(b, t * 2, 3))
+    expect = x2.copy()
+    for i in range(b):
+        for j in range(3):
+            expect[i, ids[i, j]] += upd[i, j]
+    np.testing.assert_allclose(r3, expect, rtol=1e-6)
+    assert r4.shape == (b, t, 2)
+    np.testing.assert_array_equal(r4[:, :-1, 0], toks[:, :-1])
+    np.testing.assert_array_equal(r4[:, :-1, 1], toks[:, 1:])
+    assert (r4[:, -1, 1] == -1).all()
+
+
+def test_conv3d_transpose():
+    x = rng.randn(2, 3, 4, 4, 4).astype("float32")
+    xv = layers.data(name="x", shape=[3, 4, 4, 4], dtype="float32")
+    out = layers.conv3d_transpose(xv, num_filters=5, filter_size=2,
+                                  stride=2)
+    (o,) = _run([out], {"x": x}, startup=True)
+    assert o.shape == (2, 5, 8, 8, 8)
+    # stride-2 k2 transpose conv exactly inverts shape of stride-2 conv
+    assert np.isfinite(o).all()
+
+
+def test_max_pool_with_index_unpool_roundtrip():
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    xv = layers.data(name="x", shape=[2, 4, 4], dtype="float32")
+    out, mask = layers.max_pool2d_with_index(xv, pool_size=2)
+    up = layers.unpool(out, mask, ksize=[2, 2])
+    o, m, u = _run([out, mask, up], {"x": x})
+    np.testing.assert_allclose(o, x.reshape(1, 2, 2, 2, 2, 2).max((3, 5)))
+    # unpool scatters each max back to its recorded position
+    for c in range(2):
+        for i in range(2):
+            for j in range(2):
+                flat = m[0, c, i, j]
+                assert u[0, c, flat // 4, flat % 4] == o[0, c, i, j]
+    # everything else zero
+    assert (u != 0).sum() == 8
+
+
+def test_py_func_host_callback():
+    def host_fn(a, b):
+        return np.maximum(a, 0) + np.sort(b, axis=-1)
+
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(3, 4).astype("float32")
+    av = layers.data(name="a", shape=[4], dtype="float32")
+    bv = layers.data(name="b", shape=[4], dtype="float32")
+    out = layers.py_func(host_fn, [av, bv], out_shapes=[(3, 4)],
+                         out_dtypes=["float32"])
+    (o,) = _run([out], {"a": a, "b": b})
+    np.testing.assert_allclose(o, np.maximum(a, 0) + np.sort(b, -1),
+                               rtol=1e-6)
+
+
+def test_int8_freeze_matches_float_within_quant_error():
+    from paddle_tpu.contrib.quantize import QuantizeTranspiler, freeze_int8
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[16], dtype="float32")
+        h = layers.fc(x, size=32, act="relu")
+        out = layers.fc(h, size=10)
+    qt = QuantizeTranspiler()
+    with pt.program_guard(prog, startup):
+        qt.training_transpile(prog, startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        feed = {"x": rng.rand(8, 16).astype("float32")}
+        # a few forward passes warm the moving-average activation scales
+        for _ in range(10):
+            (ref,) = exe.run(prog, feed=feed, fetch_list=[out], scope=scope)
+        test_prog = prog.clone(for_test=True)
+        (ref,) = exe.run(test_prog, feed=feed, fetch_list=[out],
+                         scope=scope)
+        n = freeze_int8(test_prog, scope)
+        assert n == 2, n
+        types = [op.type for op in test_prog.global_block().ops]
+        assert "int8_mul" in types and "quantize" in types
+        assert not any(t.startswith("fake_") for t in types)
+        # weights now stored int8
+        w_names = [p.name for p in prog.global_block().all_parameters()
+                   if p.name.endswith("w_0")]
+        assert any(np.asarray(scope.find_var(nm)).dtype == np.int8
+                   for nm in w_names)
+        (got,) = exe.run(test_prog, feed=feed, fetch_list=[out],
+                         scope=scope)
+    ref, got = np.asarray(ref), np.asarray(got)
+    err = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-6)
+    assert err < 0.1, err  # int8 quantization error bound
+
+
+def test_slim_pruning_keeps_zeros_through_training():
+    import pytest as _pytest
+
+    from paddle_tpu.contrib.slim import Compressor, Pruner
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=16, act="tanh",
+                      param_attr=pt.ParamAttr(name="pw"))
+        loss = layers.mean(layers.square(layers.fc(h, size=1) - y))
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        # prune BEFORE minimize: the mask multiply joins the
+        # differentiated graph, so pruned entries get zero grads
+        comp = Compressor(prog, scope,
+                          pruner=Pruner({"pw": 0.5})).compress()
+        assert comp.pruned_params == ["pw"]
+        with pt.program_guard(prog, startup):
+            pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        # minimize added LR/accumulator initializers to the already-run
+        # startup program; init-on-demand runs just those
+        n_init = exe.run_startup_missing(startup, scope=scope)
+        assert n_init >= 1
+        s0 = comp.sparsity()
+        assert 0.45 <= s0 <= 0.55
+        w = rng.randn(8, 1).astype("float32")
+        for i in range(20):
+            xb = rng.randn(32, 8).astype("float32")
+            exe.run(prog, feed={"x": xb, "y": xb @ w}, fetch_list=[loss],
+                    scope=scope)
+        # pruned entries stayed zero through 20 optimizer updates:
+        # the mask multiply zeroes their gradients in the traced graph
+        wv = np.asarray(scope.find_var("pw"))
+        mask = np.asarray(scope.find_var("pw@prune_mask"))
+        assert (wv[mask == 0] == 0).all()
+        assert (wv[mask == 1] != 0).any()
+        # pruning AFTER minimize must refuse (inconsistent grads otherwise)
+        with _pytest.raises(RuntimeError, match="BEFORE"):
+            Pruner({"pw": 0.5}).prune(prog, scope)
+
+
+def test_slim_distillation_losses():
+    from paddle_tpu.contrib import slim
+
+    t = layers.data(name="t", shape=[10], dtype="float32")
+    s = layers.data(name="s", shape=[10], dtype="float32")
+    kd = slim.soft_label_loss(t, s, temperature=4.0)
+    l2 = slim.l2_loss(t, s)
+    tv = rng.randn(6, 10).astype("float32")
+    r1, r2 = _run([kd, l2], {"t": tv, "s": tv})
+    # identical logits: l2 = 0, KD = entropy * T^2 (> 0)
+    np.testing.assert_allclose(r2, 0.0, atol=1e-6)
+    assert r1 > 0
+    # KD decreases as student approaches teacher
+    sv = tv + rng.randn(6, 10).astype("float32")
+    r_far = _run([kd], {"t": tv, "s": sv})[0]
+    assert r_far > r1
