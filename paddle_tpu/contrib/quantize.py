@@ -217,8 +217,8 @@ def freeze_int8(program: fw.Program, scope, startup_program=None) -> int:
         return orig, scale_src, kind, (qi, qop), (di, dop)
 
     params = {p.name for p in block.all_parameters()}
-    slot_map = {"conv2d": ("Input", "Filter"), "mul": ("X", "Y"),
-                "depthwise_conv2d": ("Input", "Filter")}
+    # one source of truth with training_transpile's table
+    slot_map = QUANTIZABLE_OPS
     int8_type = {"conv2d": "int8_conv2d", "depthwise_conv2d": "int8_conv2d",
                  "mul": "int8_mul"}
     scale_slots = {"int8_conv2d": ("ScaleX", "ScaleW"),
@@ -229,6 +229,7 @@ def freeze_int8(program: fw.Program, scope, startup_program=None) -> int:
     count = 0
     i = 0
     to_remove = set()
+    frozen_weights = {}  # orig name -> scale var name (shared weights)
     while i < len(block.ops):
         op = block.ops[i]
         slots = slot_map.get(op.type)
@@ -246,6 +247,13 @@ def freeze_int8(program: fw.Program, scope, startup_program=None) -> int:
             to_remove.add(qinfo[0])
             to_remove.add(dinfo[0])
             if orig in params:
+                if orig in frozen_weights:
+                    # shared weight already int8: REUSE its scale var
+                    # (re-quantizing the int8 tensor would compute
+                    # scale ~= 127 and corrupt the model)
+                    new_inputs[islot] = [orig]
+                    new_inputs[sslot] = [frozen_weights[orig]]
+                    continue
                 # offline weight quantization: int8 value + scale in scope
                 w = np.asarray(scope.find_var(orig))
                 scale = float(np.max(np.abs(w))) or 1e-8
@@ -260,6 +268,7 @@ def freeze_int8(program: fw.Program, scope, startup_program=None) -> int:
                 wvar = block._find_var_recursive(orig)
                 if wvar is not None:
                     wvar.dtype = "int8"
+                frozen_weights[orig] = sname
                 new_inputs[islot] = [orig]
                 new_inputs[sslot] = [sname]
             else:

@@ -63,6 +63,16 @@ def unique_name(key: str) -> str:
     return _name_generator(key)
 
 
+_rng_id_counter = [0]
+
+
+def unique_rng_id() -> int:
+    """Static per-op rng stream id (offset far above the trace-time
+    sequential counters next_rng_key hands out)."""
+    _rng_id_counter[0] += 1
+    return 1_000_000 + _rng_id_counter[0]
+
+
 @contextlib.contextmanager
 def guard_unique_name(new_generator: Optional[UniqueNameGenerator] = None):
     global _name_generator

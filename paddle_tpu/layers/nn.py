@@ -380,6 +380,10 @@ def dropout(
             "is_test": is_test,
             "seed": seed or 0,
             "dropout_implementation": dropout_implementation,
+            # static per-op id: forward AND backward regenerate the same
+            # mask from fold_in(step_key, rng_id) — no mask residual has
+            # to cross fwd->bwd in HBM (ops/nn_ops.py lower_dropout)
+            "rng_id": fw.unique_rng_id(),
         },
     )
     return out

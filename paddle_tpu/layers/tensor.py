@@ -166,6 +166,13 @@ def split(input, num_or_sections, dim=-1, name=None):
         outputs={"Out": outs},
         attrs={"axis": dim, "num": num, "sections": sections},
     )
+    if input.shape and input.shape[dim] not in (None, -1):
+        sizes = ([input.shape[dim] // num] * num if num
+                 else list(sections))
+        for o, sz in zip(outs, sizes):
+            shp = list(input.shape)
+            shp[dim] = sz
+            o.shape = tuple(shp)
     return outs
 
 

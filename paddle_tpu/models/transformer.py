@@ -36,6 +36,7 @@ def multi_head_attention(
     shards over mesh axis `ring_axis` and K/V circulate via ppermute —
     attn_bias is ignored on this path (pad-free batches / pure-causal via
     ring_causal), see ops/fused_ops.py ring_attention."""
+    is_self = keys is None and values is None
     keys = queries if keys is None else keys
     values = keys if values is None else values
 
@@ -43,15 +44,25 @@ def multi_head_attention(
     # transformer_tp_rules) address these by regex
     from ..core.framework import unique_name
 
-    q = layers.fc(input=queries, size=d_key * n_head, bias_attr=False,
-                  num_flatten_dims=2,
-                  param_attr=ParamAttr(name=unique_name("attn_q_w")))
-    k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
-                  num_flatten_dims=2,
-                  param_attr=ParamAttr(name=unique_name("attn_k_w")))
-    v = layers.fc(input=values, size=d_value * n_head, bias_attr=False,
-                  num_flatten_dims=2,
-                  param_attr=ParamAttr(name=unique_name("attn_v_w")))
+    if is_self and d_key == d_value:
+        # ONE fused [d_model, 3*h*d] projection for self-attention: a
+        # single dot (fewer custom-call-adjacent layout boundaries —
+        # PERF.md r04 lead 2: the split q/k/v dots paid ~1.2 GB/step of
+        # relayout copies between dot-preferred and kernel layouts)
+        qkv = layers.fc(input=queries, size=3 * d_key * n_head,
+                        bias_attr=False, num_flatten_dims=2,
+                        param_attr=ParamAttr(name=unique_name("attn_qkv_w")))
+        q, k, v = layers.split(qkv, 3, dim=-1)
+    else:
+        q = layers.fc(input=queries, size=d_key * n_head, bias_attr=False,
+                      num_flatten_dims=2,
+                      param_attr=ParamAttr(name=unique_name("attn_q_w")))
+        k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
+                      num_flatten_dims=2,
+                      param_attr=ParamAttr(name=unique_name("attn_k_w")))
+        v = layers.fc(input=values, size=d_value * n_head, bias_attr=False,
+                      num_flatten_dims=2,
+                      param_attr=ParamAttr(name=unique_name("attn_v_w")))
 
     def split_heads(x, d):
         b, t, _ = x.shape

@@ -567,14 +567,21 @@ def lower_unpool(ctx, ins):
 # py_func escape hatch ------------------------------------------------------
 
 _PY_FUNC_REGISTRY: dict = {}
+_PY_FUNC_IDS: dict = {}
 
 
 def register_py_func(fn) -> int:
     """Register a host Python callable; returns its id attr (the layers
     wrapper does this). Mirrors the reference's PyFuncRegistry
-    (py_func_op.cc)."""
+    (py_func_op.cc).  Dedup by identity: a dygraph loop re-calling
+    layers.py_func with the same function must not leak one closure per
+    step."""
+    fid = _PY_FUNC_IDS.get(id(fn))
+    if fid is not None and _PY_FUNC_REGISTRY.get(fid) is fn:
+        return fid
     fid = len(_PY_FUNC_REGISTRY)
     _PY_FUNC_REGISTRY[fid] = fn
+    _PY_FUNC_IDS[id(fn)] = fid
     return fid
 
 

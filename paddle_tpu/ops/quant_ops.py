@@ -149,12 +149,19 @@ def lower_int8_mul(ctx, ins):
     x, y = ins["X"][0], ins["Y"][0]
     sx = ins["ScaleX"][0].reshape(()) if ins.get("ScaleX") else 1.0
     sy = ins["ScaleY"][0].reshape(()) if ins.get("ScaleY") else 1.0
-    x2 = x.reshape(-1, x.shape[-1])
+    # honor the mul op's flatten attrs (freeze_int8 keeps them): X
+    # flattens to [prod(dims[:nx]), prod(dims[nx:])] like lower_mul
+    nx = ctx.attr("x_num_col_dims", 1)
+    lead = x.shape[:nx]
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, -1)
     acc = lax.dot_general(
         x2, y, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
     out = acc.astype(jnp.float32) * (sx * sy / (127.0 * 127.0))
-    return {"Out": [out.reshape(x.shape[:-1] + (y.shape[1],))]}
+    return {"Out": [out.reshape(tuple(lead) + (y.shape[1],))]}
 
 
 @register("int8_conv2d", no_grad=True)
@@ -170,12 +177,13 @@ def lower_int8_conv2d(ctx, ins):
     p = ctx.attr("paddings", [0, 0])
     d = ctx.attr("dilations", [1, 1])
     g = ctx.attr("groups", 1) or 1
+    fmt = ctx.attr("data_format", "NCHW")
     acc = lax.conv_general_dilated(
         x, w,
         window_strides=tuple(s),
         padding=[(p[0], p[0]), (p[1], p[1])],
         rhs_dilation=tuple(d),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
         feature_group_count=g,
         preferred_element_type=jnp.int32,
     )

@@ -277,6 +277,7 @@ def transformer_tp_rules(model_axis="model"):
 
     return [
         (r"(src|trg)_word_emb_table", P(model_axis, None)),
+        (r"attn_qkv_w_\d+", P(None, model_axis)),
         (r"attn_[qkv]_w_\d+", P(None, model_axis)),
         (r"attn_out_w_\d+", P(model_axis, None)),
         (r"ffn_in_w_\d+", P(None, model_axis)),

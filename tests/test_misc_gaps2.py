@@ -194,3 +194,58 @@ def test_slim_distillation_losses():
     sv = tv + rng.randn(6, 10).astype("float32")
     r_far = _run([kd], {"t": tv, "s": sv})[0]
     assert r_far > r1
+
+
+def test_dropout_regenerated_mask_consistency():
+    """Residual-free dropout: the backward regenerates the SAME mask from
+    the static rng_id — positions zeroed in the forward must be exactly
+    the positions with zero gradient."""
+    x = layers.data(name="xd", shape=[64], dtype="float32")
+    x.stop_gradient = False
+    d = layers.dropout(x, dropout_prob=0.5,
+                       dropout_implementation="upscale_in_train")
+    total = layers.reduce_sum(d)
+    grads = pt.calc_gradient(total, [x])
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.ones((16, 64), "float32")
+    out, g = exe.run(feed={"xd": xv}, fetch_list=[d, grads[0]])
+    out, g = np.asarray(out), np.asarray(g)
+    np.testing.assert_array_equal(out == 0, g == 0)
+    # kept positions carry the upscale factor in BOTH directions
+    np.testing.assert_allclose(out[out != 0], 2.0, rtol=1e-6)
+    np.testing.assert_allclose(g[g != 0], 2.0, rtol=1e-6)
+    # and the op carries a static rng_id (no Mask residual in backward)
+    ops = pt.default_main_program().global_block().ops
+    dgrad = [op for op in ops if op.type == "dropout_grad"]
+    assert dgrad and not dgrad[0].inputs.get("Mask")
+
+
+def test_int8_freeze_shared_weight():
+    """A weight feeding TWO quantized consumers must be quantized once
+    and its scale reused (re-quantizing the int8 tensor would read
+    max|int8| ~ 127 as the scale and corrupt the model)."""
+    from paddle_tpu.contrib.quantize import QuantizeTranspiler, freeze_int8
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        shared = pt.ParamAttr(name="shared_w")
+        h1 = layers.fc(x, size=8, param_attr=shared, bias_attr=False)
+        h2 = layers.fc(h1, size=8, param_attr=shared, bias_attr=False)
+        out = layers.reduce_sum(h2, dim=1, keep_dim=True)
+        QuantizeTranspiler().training_transpile(prog, startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        feed = {"x": rng.rand(8, 8).astype("float32")}
+        for _ in range(6):  # warm the activation scales
+            (ref,) = exe.run(prog, feed=feed, fetch_list=[out], scope=scope)
+        test_prog = prog.clone(for_test=True)
+        (ref,) = exe.run(test_prog, feed=feed, fetch_list=[out], scope=scope)
+        n = freeze_int8(test_prog, scope)
+        assert n == 2
+        (got,) = exe.run(test_prog, feed=feed, fetch_list=[out], scope=scope)
+    ref, got = np.asarray(ref), np.asarray(got)
+    err = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-6)
+    assert err < 0.1, err

@@ -4,7 +4,7 @@ step: counts copy/transpose/custom-call instructions by shape and locates
 them relative to the flash-attention custom-calls.  Perf tooling for
 PERF.md leads 1-2 (attention layout copies, scan-carry copies).
 
-Usage: python tools/hlo_diag.py [transformer|transformer_noflash] [out.txt]
+Usage: python tools/hlo_diag.py [transformer|transformer_noflash|resnet50] [out.txt]
 """
 
 import os
@@ -44,6 +44,30 @@ def compile_transformer(scan_steps=8, batch_size=64, seq_len=256,
         for s in range(scan_steps)
     ]
     feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+    return exe, prog, feed, [avg_cost], scope
+
+
+def compile_resnet50(scan_steps=4, batch_size=256, image_size=224,
+                     depth=50, data_format="NHWC"):
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet as R
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        img, label, avg_cost, acc, _ = R.build_train_net(
+            class_dim=1000, image_shape=(3, image_size, image_size),
+            depth=depth, lr=0.1, data_format=data_format)
+    pt.amp.enable(prog)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {
+        "image": rng.rand(scan_steps, batch_size, 3, image_size,
+                          image_size).astype("float32"),
+        "label": rng.randint(0, 1000,
+                             (scan_steps, batch_size, 1)).astype("int64"),
+    }
     return exe, prog, feed, [avg_cost], scope
 
 
@@ -125,6 +149,8 @@ def main():
         args = compile_transformer()
     elif which == "transformer_noflash":
         args = compile_transformer(use_flash=False)
+    elif which == "resnet50":
+        args = compile_resnet50()
     else:
         raise SystemExit(f"unknown workload {which}")
     txt = lower_entry(*args)
