@@ -350,7 +350,9 @@ def bench_mnist(batch_size=512, scan_steps=16, calls=2, warmup=1, amp=True):
 
 
 def run_bert(args, peak):
-    bs = args.batch_size or (4 if args.smoke else 32)
+    # bs 128 measured best on v5e (35.5% MFU vs 28.9% at bs 32; 256
+    # regresses under scan memory pressure) — PERF.md r04
+    bs = args.batch_size or (4 if args.smoke else 128)
     seq = 64 if args.smoke else 128
     tps, flops_tok, loss = bench_bert(
         batch_size=bs, seq_len=seq,

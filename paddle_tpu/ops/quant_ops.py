@@ -149,14 +149,21 @@ def lower_int8_mul(ctx, ins):
     x, y = ins["X"][0], ins["Y"][0]
     sx = ins["ScaleX"][0].reshape(()) if ins.get("ScaleX") else 1.0
     sy = ins["ScaleY"][0].reshape(()) if ins.get("ScaleY") else 1.0
-    # honor the mul op's flatten attrs (freeze_int8 keeps them): X
-    # flattens to [prod(dims[:nx]), prod(dims[nx:])] like lower_mul
+    # honor the mul op's flatten attrs (freeze_int8 keeps them): X flattens
+    # to [prod(dims[:nx]), prod(dims[nx:])] and Y to
+    # [prod(dims[:ny]), prod(dims[ny:])] like lower_mul
     nx = ctx.attr("x_num_col_dims", 1)
+    ny = ctx.attr("y_num_col_dims", 1)
     lead = x.shape[:nx]
     m = 1
     for d in lead:
         m *= d
     x2 = x.reshape(m, -1)
+    if y.ndim > 2 or ny != 1:
+        k = 1
+        for d in y.shape[:ny]:
+            k *= d
+        y = y.reshape(k, -1)
     acc = lax.dot_general(
         x2, y, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
