@@ -264,6 +264,14 @@ def _plan(q, k, block_q, block_k, interpret, fmt="bhtd"):
         interpret = not on_tpu
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
+    if fmt == "bthd":
+        # whole-head blocks: each kv tile is [block, h, d] — cap the block
+        # so the bwd kernel's working set fits vmem (block=512 with
+        # h*d=512 fails to compile; 256 is the measured safe bound:
+        # block * h * d * 2B = 256 KB per kv tile)
+        cap = max(128, (256 * 1024) // max(h * d * 2, 1))
+        block_q = min(block_q, cap)
+        block_k = min(block_k, cap)
     if on_tpu and not interpret:
         # Mosaic: lane-dim (last-dim) dynamic-slice offsets must be
         # 128-aligned; sublane offsets 8-aligned.  The backward kernels
