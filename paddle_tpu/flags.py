@@ -124,6 +124,11 @@ FLAGS.define(
     "datasets yield synthetic offline samples (same as "
     "PADDLE_TPU_SYNTH_DATA=1)")
 FLAGS.define(
+    "hash_dropout", bool, True,
+    "generate dropout masks with the fusible counter-based hash PRNG "
+    "(kernels/hash_rng.py) instead of jax.random.bernoulli; the hash "
+    "fuses into consumers so no random-bits tensor exists in HBM")
+FLAGS.define(
     "vlog", int, 0,
     "verbose logging level, like glog's VLOG(n) (reference init.cc "
     "InitGLOG); see paddle_tpu.log")

@@ -32,13 +32,20 @@ from __future__ import annotations
 import functools
 
 
-def reference_attention(q, k, v, bias=None, scale=1.0, causal=False):
+def reference_attention(q, k, v, bias=None, scale=1.0, causal=False,
+                        dropout_rate=0.0, dropout_seed=None):
     """Pure-XLA fallback (and numerics reference for tests).
 
     Rows with no causally-visible key (only possible when Tq > Tk under
     bottom-right-aligned causal masking) produce zero output and zero
     gradients — the standard flash-attention convention, and what the
-    Pallas path implements."""
+    Pallas path implements.
+
+    With dropout_rate > 0 the attention WEIGHTS are dropped (the
+    reference's dropout-on-softmax semantics, transformer_model.py:44)
+    using the counter-based hash of kernels/hash_rng.py over the global
+    [b, h, tq, tk] element index — bit-identical to the mask the Pallas
+    kernels generate in-kernel from the same seed."""
     import jax
     import jax.numpy as jnp
 
@@ -50,12 +57,68 @@ def reference_attention(q, k, v, bias=None, scale=1.0, causal=False):
         mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
         logits = jnp.where(mask, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_rate:
+        from . import hash_rng
+
+        keep = hash_rng.keep_mask_attn(dropout_seed, weights.shape,
+                                       dropout_rate)
+        inv = jnp.asarray(1.0 / (1.0 - dropout_rate), weights.dtype)
+        weights = jnp.where(keep, weights * inv, jnp.zeros((), weights.dtype))
     out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
     if causal and q.shape[2] > k.shape[2]:
         tq, tk = q.shape[2], k.shape[2]
         visible = jnp.tril(jnp.ones((tq, tk), bool), tk - tq).any(axis=-1)
         out = jnp.where(visible[:, None], out, jnp.zeros_like(out))
     return out
+
+
+def _reference_bthd(q, k, v, bias, scale, causal, dropout_rate=0.0,
+                    dropout_seed=None):
+    out = reference_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), bias, scale, causal,
+        dropout_rate, dropout_seed)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _keep_tile(seed, shape, head_base, tq, tk, q_lo, k_lo, rate):
+    """In-kernel dropout keep-mask for an attention-weights tile.
+
+    shape [h, bq, bk] (whole-head bthd kernels; head_base = b*H) or
+    [bq, bk] (bhtd kernels; head_base = the grid's combined b*H + h index).
+    The mask bit for logical element (b, h, q, k) is a pure function of
+    (seed, b*H + h, q*Tk + k): the head coordinate folds into the seed
+    (hash_rng.attn_head_seed — a flat index over [b*h, Tq, Tk] would wrap
+    uint32 past 2^32 elements and correlate bits) and the in-plane index
+    keys the hash.  Forward and both backward kernels (different grids)
+    regenerate identical masks, and the pure-XLA fallback
+    (hash_rng.keep_mask_attn) matches bit-for-bit.  tq/tk are unused but
+    kept so call sites document the plane extents (exact for tk <= 65535).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from . import hash_rng
+
+    del tq  # plane index needs only tk; see docstring
+    u32 = jnp.uint32
+    q_lo = jnp.asarray(q_lo).astype(u32)
+    k_lo = jnp.asarray(k_lo).astype(u32)
+    head_base = jnp.asarray(head_base).astype(u32)
+    if len(shape) == 3:
+        gh = head_base + jax.lax.broadcasted_iota(u32, shape, 0)
+        q_idx = q_lo + jax.lax.broadcasted_iota(u32, shape, 1)
+        k_idx = k_lo + jax.lax.broadcasted_iota(u32, shape, 2)
+    else:
+        gh = head_base
+        q_idx = q_lo + jax.lax.broadcasted_iota(u32, shape, 0)
+        k_idx = k_lo + jax.lax.broadcasted_iota(u32, shape, 1)
+    # np.uint32 constants inline as jaxpr literals (jax Arrays would be
+    # constvars, which a pallas_call refuses to lower)
+    hseed = hash_rng.attn_head_seed(seed, gh)
+    return hash_rng.keep_mask_tile(hseed, q_idx * np.uint32(tk) + k_idx,
+                                   rate)
 
 
 # ---------------------------------------------------------------------------
@@ -86,13 +149,15 @@ def _read_bias(bias_ref, q_lo, block_q, k_lo, block_k, bias_q1):
     return b.astype(jnp.float32)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, scale,
-                block_q, block_k, causal, seq_k, causal_offset, bias_q1):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                scale, block_q, block_k, causal, seq_q, seq_k,
+                causal_offset, bias_q1, drop_rate, inv_keep):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
+    pid0 = pl.program_id(0)
 
     q = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
     d = q.shape[-1]
@@ -126,6 +191,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, scale,
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=1)
+        if drop_rate:
+            # weights-dropout: l (the softmax normalizer) accumulates the
+            # UNdropped p; only the value-accumulator sees the mask
+            keep = _keep_tile(seed_ref[0], (block_q, block_k),
+                              pid0, seq_q, seq_k,
+                              qi * block_q, j * block_k, drop_rate)
+            p = jnp.where(keep, p, 0.0)
         acc_new = acc * alpha[:, None] + p @ v
         return m_new, l_new, acc_new
 
@@ -135,6 +207,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, scale,
     # lse=+inf so the backward recompute p = exp(s - lse) is exactly 0.
     masked = (l == 0.0) | (m <= -1e29)
     l_safe = jnp.where(masked, 1.0, l)
+    if drop_rate:
+        acc = acc * inv_keep
     o_ref[...] = jnp.where(
         masked[:, None], 0.0, acc / l_safe[:, None]
     ).astype(o_ref.dtype)
@@ -142,14 +216,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, scale,
     lse_ref[...] = jnp.broadcast_to(lse[None, :], (LSE_SUBLANES, block_q))
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, scale, block_q, block_k, causal, seq_k,
-                   causal_offset, bias_q1):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, scale, block_q, block_k, causal,
+                   seq_q, seq_k, causal_offset, bias_q1, drop_rate, inv_keep):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
+    pid0 = pl.program_id(0)
 
     q = q_ref[...].astype(jnp.float32)
     do = do_ref[...].astype(jnp.float32)
@@ -180,6 +255,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
             )
             p = jnp.where(q_pos + causal_offset >= k_pos, p, 0.0)
         dp = do @ v.T  # [block_q, block_k]
+        if drop_rate:
+            keep = _keep_tile(seed_ref[0], (block_q, block_k),
+                              pid0, seq_q, seq_k,
+                              qi * block_q, j * block_k, drop_rate)
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = p * (dp - delta[:, None]) * scale
         return acc + ds @ k
 
@@ -187,14 +267,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
     dq_ref[...] = acc.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, *, scale, block_q, block_k,
-                    causal, seq_q, causal_offset, bias_q1):
+                    causal, seq_q, seq_k, causal_offset, bias_q1, drop_rate,
+                    inv_keep):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
+    pid0 = pl.program_id(0)
 
     k = k_ref[...].astype(jnp.float32)  # [block_k, d]
     v = v_ref[...].astype(jnp.float32)
@@ -228,8 +310,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                 jnp.int32, (block_q, block_k), 1
             )
             p = jnp.where(q_pos + causal_offset >= k_pos, p, 0.0)
-        dv = dv + p.T @ do
         dp = do @ v.T
+        if drop_rate:
+            keep = _keep_tile(seed_ref[0], (block_q, block_k),
+                              pid0, seq_q, seq_k,
+                              i * block_q, ki * block_k, drop_rate)
+            dv = dv + jnp.where(keep, p * inv_keep, 0.0).T @ do
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
+        else:
+            dv = dv + p.T @ do
         ds = p * (dp - delta[:, None]) * scale
         dk = dk + ds.T @ q
         return dk, dv
@@ -406,15 +495,17 @@ def _bias_tile_f32(bias_ref, n_head, bias_h, bias_q1, block_q, q_lo,
     return t[None]  # [1, q, k] broadcasts over heads (vreg replication)
 
 
-def _fwd_kernel_bthd(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
-                     scale, n_head, block_q, block_k, causal, seq_k,
-                     causal_offset, bias_q1, bias_h):
+def _fwd_kernel_bthd(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+                     lse_ref, *, scale, n_head, block_q, block_k, causal,
+                     seq_q, seq_k, causal_offset, bias_q1, bias_h,
+                     drop_rate, inv_keep):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
     h = n_head
+    pid0h = pl.program_id(0) * h
 
     q = q_ref[...].astype(jnp.float32).transpose(1, 0, 2) * scale  # [h,q,d]
     d = q.shape[-1]
@@ -449,27 +540,36 @@ def _fwd_kernel_bthd(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         p = jnp.exp(s - m_new[:, :, None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=2)
+        if drop_rate:
+            # weights-dropout: the normalizer l sees UNdropped p
+            keep = _keep_tile(seed_ref[0], (h, block_q, block_k),
+                              pid0h, seq_q, seq_k,
+                              qi * block_q, j * block_k, drop_rate)
+            p = jnp.where(keep, p, 0.0)
         acc_new = acc * alpha[:, :, None] + _bdot(p, v, (2,), (1,))
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m, l, acc))
     masked = (l == 0.0) | (m <= -1e29)
     l_safe = jnp.where(masked, 1.0, l)
+    if drop_rate:
+        acc = acc * inv_keep
     o = jnp.where(masked[:, :, None], 0.0, acc / l_safe[:, :, None])
     o_ref[...] = o.transpose(1, 0, 2).astype(o_ref.dtype)
     lse_ref[...] = jnp.where(masked, jnp.inf, m + jnp.log(l_safe))
 
 
-def _bwd_dq_kernel_bthd(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-                        delta_ref, dq_ref, *, scale, n_head, block_q,
-                        block_k, causal, seq_k, causal_offset, bias_q1,
-                        bias_h):
+def _bwd_dq_kernel_bthd(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                        lse_ref, delta_ref, dq_ref, *, scale, n_head,
+                        block_q, block_k, causal, seq_q, seq_k,
+                        causal_offset, bias_q1, bias_h, drop_rate, inv_keep):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
     h = n_head
+    pid0h = pl.program_id(0) * h
 
     q = q_ref[...].astype(jnp.float32).transpose(1, 0, 2)   # [h, q, d]
     do = do_ref[...].astype(jnp.float32).transpose(1, 0, 2)
@@ -502,6 +602,11 @@ def _bwd_dq_kernel_bthd(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
             )
             p = jnp.where(q_pos + causal_offset >= k_pos, p, 0.0)
         dp = _bdot(do, v, (2,), (2,))  # [h, q, k]
+        if drop_rate:
+            keep = _keep_tile(seed_ref[0], (h, block_q, block_k),
+                              pid0h, seq_q, seq_k,
+                              qi * block_q, j * block_k, drop_rate)
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = p * (dp - delta[:, :, None]) * scale
         return acc + _bdot(ds, k, (2,), (1,))
 
@@ -509,16 +614,18 @@ def _bwd_dq_kernel_bthd(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     dq_ref[...] = acc.transpose(1, 0, 2).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel_bthd(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-                         delta_ref, dk_ref, dv_ref, *, scale, n_head,
-                         block_q, block_k, causal, seq_q, causal_offset,
-                         bias_q1, bias_h):
+def _bwd_dkv_kernel_bthd(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                         lse_ref, delta_ref, dk_ref, dv_ref, *, scale,
+                         n_head, block_q, block_k, causal, seq_q, seq_k,
+                         causal_offset, bias_q1, bias_h, drop_rate,
+                         inv_keep):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
     h = n_head
+    pid0h = pl.program_id(0) * h
 
     k = k_ref[...].astype(jnp.float32).transpose(1, 0, 2)  # [h, k, d]
     v = v_ref[...].astype(jnp.float32).transpose(1, 0, 2)
@@ -553,8 +660,16 @@ def _bwd_dkv_kernel_bthd(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                 jnp.int32, (h, block_q, block_k), 2
             )
             p = jnp.where(q_pos + causal_offset >= k_pos, p, 0.0)
-        dv = dv + _bdot(p, do, (1,), (1,))   # [h, k, d]
         dp = _bdot(do, v, (2,), (2,))        # [h, q, k]
+        if drop_rate:
+            keep = _keep_tile(seed_ref[0], (h, block_q, block_k),
+                              pid0h, seq_q, seq_k,
+                              i * block_q, ki * block_k, drop_rate)
+            dv = dv + _bdot(jnp.where(keep, p * inv_keep, 0.0), do,
+                            (1,), (1,))      # [h, k, d]
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
+        else:
+            dv = dv + _bdot(p, do, (1,), (1,))   # [h, k, d]
         ds = p * (dp - delta[:, :, None]) * scale
         dk = dk + _bdot(ds, q, (1,), (1,))   # [h, k, d]
         return dk, dv
@@ -594,11 +709,26 @@ def _bias_spec_bthd(bias, b, h, block_q, block_k, for_dkv):
     return spec, bias_q1, bias_h
 
 
-def _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
-                   interpret, fmt="bhtd"):
+def _drop_params(dropout_rate):
+    """(drop_rate, inv_keep) static kernel params for a dropout rate."""
+    if not dropout_rate:
+        return 0.0, 1.0
+    return float(dropout_rate), 1.0 / (1.0 - dropout_rate)
+
+
+def _seed_spec():
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _flash_forward(q, k, v, bias, seed, scale, causal, block_q, block_k,
+                   interpret, fmt="bhtd", dropout_rate=0.0):
     """Returns (out, lse) via the Pallas kernel.  Caller has checked
     feasibility with _plan.  `out` is in the input format; lse is
-    [b, h, tq] f32."""
+    [b, h, tq] f32.  `seed`: (1,) uint32 — the dropout stream seed
+    (ignored when dropout_rate == 0)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -606,11 +736,12 @@ def _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
     b, h, tq, d = _dims(q, fmt)
     tk = _dims(k, fmt)[2]
     bh = b * h
+    drop_rate, inv_keep = _drop_params(dropout_rate)
     q_spec, kv_spec = _qkv_specs(fmt, h, "block", "full", block_q, block_k,
                                  tq, tk, d)
     if fmt == "bthd":
-        args = [q, k, v]
-        in_specs = [q_spec, kv_spec, kv_spec]
+        args = [seed, q, k, v]
+        in_specs = [_seed_spec(), q_spec, kv_spec, kv_spec]
         bias_q1 = bias_h = False
         if bias is not None:
             spec, bias_q1, bias_h = _bias_spec_bthd(
@@ -619,12 +750,14 @@ def _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
             args.append(bias)
         kern = functools.partial(
             _fwd_kernel_bthd, scale=scale, n_head=h, block_q=block_q,
-            block_k=block_k, causal=causal, seq_k=tk,
+            block_k=block_k, causal=causal, seq_q=tq, seq_k=tk,
             causal_offset=tk - tq, bias_q1=bias_q1, bias_h=bias_h,
+            drop_rate=drop_rate, inv_keep=inv_keep,
         )
         if bias is None:
-            def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
-                return kern(q_ref, k_ref, v_ref, None, o_ref, lse_ref)
+            def kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref):
+                return kern(seed_ref, q_ref, k_ref, v_ref, None, o_ref,
+                            lse_ref)
         else:
             kernel = kern
         out, lse = pl.pallas_call(
@@ -643,9 +776,9 @@ def _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
         )(*args)
         return out, lse
 
-    args = [q.reshape(bh, tq, d), k.reshape(bh, tk, d),
+    args = [seed, q.reshape(bh, tq, d), k.reshape(bh, tk, d),
             v.reshape(bh, tk, d)]
-    in_specs = [q_spec, kv_spec, kv_spec]
+    in_specs = [_seed_spec(), q_spec, kv_spec, kv_spec]
     bias_q1 = False
     if bias is not None:
         spec, barg, bias_q1 = _bias_spec_and_arg(
@@ -656,11 +789,12 @@ def _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
 
     kern = functools.partial(
         _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
-        causal=causal, seq_k=tk, causal_offset=tk - tq, bias_q1=bias_q1,
+        causal=causal, seq_q=tq, seq_k=tk, causal_offset=tk - tq,
+        bias_q1=bias_q1, drop_rate=drop_rate, inv_keep=inv_keep,
     )
     if bias is None:
-        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
-            return kern(q_ref, k_ref, v_ref, None, o_ref, lse_ref)
+        def kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref):
+            return kern(seed_ref, q_ref, k_ref, v_ref, None, o_ref, lse_ref)
     else:
         kernel = kern
 
@@ -682,8 +816,8 @@ def _flash_forward(q, k, v, bias, scale, causal, block_q, block_k,
     return out.reshape(b, h, tq, d), lse[:, 0, :].reshape(b, h, tq)
 
 
-def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
-                    block_k, interpret, fmt="bhtd"):
+def _flash_backward(q, k, v, bias, seed, o, lse, g, scale, causal, block_q,
+                    block_k, interpret, fmt="bhtd", dropout_rate=0.0):
     """Returns (dq, dk, dv) via the two backward kernels, in the input
     format.  `lse` is [b, h, tq] f32; q/k/v/o/g are in `fmt`."""
     import jax
@@ -694,6 +828,7 @@ def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
     tk = _dims(k, fmt)[2]
     bh = b * h
     causal_offset = tk - tq
+    drop_rate, inv_keep = _drop_params(dropout_rate)
 
     if fmt == "bthd":
         # delta[i] = rowsum(dO * O) -> [b, tq, h] -> [b, h, tq] (tiny f32)
@@ -705,25 +840,26 @@ def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
 
         q_spec, kv_spec = _qkv_specs(fmt, h, "block", "full", block_q,
                                      block_k, tq, tk, d)
-        in_specs = [q_spec, kv_spec, kv_spec, q_spec, lse_spec_q,
-                    lse_spec_q]
-        args = [q, k, v, g, lse, delta]
+        in_specs = [_seed_spec(), q_spec, kv_spec, kv_spec, q_spec,
+                    lse_spec_q, lse_spec_q]
+        args = [seed, q, k, v, g, lse, delta]
         bias_q1 = bias_h = False
         if bias is not None:
             spec, bias_q1, bias_h = _bias_spec_bthd(
                 bias, b, h, block_q, block_k, for_dkv=False)
-            in_specs.insert(3, spec)
-            args.insert(3, bias)
+            in_specs.insert(4, spec)
+            args.insert(4, bias)
         dq_kern = functools.partial(
             _bwd_dq_kernel_bthd, scale=scale, n_head=h, block_q=block_q,
-            block_k=block_k, causal=causal, seq_k=tk,
+            block_k=block_k, causal=causal, seq_q=tq, seq_k=tk,
             causal_offset=causal_offset, bias_q1=bias_q1, bias_h=bias_h,
+            drop_rate=drop_rate, inv_keep=inv_keep,
         )
         if bias is None:
-            def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dq_ref):
-                return dq_kern(q_ref, k_ref, v_ref, None, do_ref, lse_ref,
-                               delta_ref, dq_ref)
+            def dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, dq_ref):
+                return dq_kern(seed_ref, q_ref, k_ref, v_ref, None, do_ref,
+                               lse_ref, delta_ref, dq_ref)
         else:
             dq_kernel = dq_kern
         dq = pl.pallas_call(
@@ -737,25 +873,26 @@ def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
 
         qfull_spec, kblock_spec = _qkv_specs(fmt, h, "full", "block",
                                              block_q, block_k, tq, tk, d)
-        in_specs = [qfull_spec, kblock_spec, kblock_spec, qfull_spec,
-                    lse_spec_full, lse_spec_full]
-        args = [q, k, v, g, lse, delta]
+        in_specs = [_seed_spec(), qfull_spec, kblock_spec, kblock_spec,
+                    qfull_spec, lse_spec_full, lse_spec_full]
+        args = [seed, q, k, v, g, lse, delta]
         bias_q1 = bias_h = False
         if bias is not None:
             spec, bias_q1, bias_h = _bias_spec_bthd(
                 bias, b, h, block_q, block_k, for_dkv=True)
-            in_specs.insert(3, spec)
-            args.insert(3, bias)
+            in_specs.insert(4, spec)
+            args.insert(4, bias)
         dkv_kern = functools.partial(
             _bwd_dkv_kernel_bthd, scale=scale, n_head=h, block_q=block_q,
-            block_k=block_k, causal=causal, seq_q=tq,
+            block_k=block_k, causal=causal, seq_q=tq, seq_k=tk,
             causal_offset=causal_offset, bias_q1=bias_q1, bias_h=bias_h,
+            drop_rate=drop_rate, inv_keep=inv_keep,
         )
         if bias is None:
-            def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           dk_ref, dv_ref):
-                return dkv_kern(q_ref, k_ref, v_ref, None, do_ref, lse_ref,
-                                delta_ref, dk_ref, dv_ref)
+            def dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                           delta_ref, dk_ref, dv_ref):
+                return dkv_kern(seed_ref, q_ref, k_ref, v_ref, None, do_ref,
+                                lse_ref, delta_ref, dk_ref, dv_ref)
         else:
             dkv_kernel = dkv_kern
         dk, dv = pl.pallas_call(
@@ -791,26 +928,27 @@ def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
     # ---- dQ: grid over q blocks -----------------------------------------
     q_spec, kv_spec = _qkv_specs(fmt, h, "block", "full", block_q, block_k,
                                  tq, tk, d)
-    in_specs = [q_spec, kv_spec, kv_spec, q_spec, _lse_spec_q, _lse_spec_q]
-    args = [args3[0], args3[1], args3[2], args3[3], lse3, delta3]
+    in_specs = [_seed_spec(), q_spec, kv_spec, kv_spec, q_spec,
+                _lse_spec_q, _lse_spec_q]
+    args = [seed, args3[0], args3[1], args3[2], args3[3], lse3, delta3]
     bias_q1 = False
     if bias is not None:
         spec, barg, bias_q1 = _bias_spec_and_arg(
             bias, b, h, tq, tk, block_q, block_k, for_dkv=False
         )
-        in_specs.insert(3, spec)
-        args.insert(3, barg)
+        in_specs.insert(4, spec)
+        args.insert(4, barg)
 
     dq_kern = functools.partial(
         _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
-        causal=causal, seq_k=tk, causal_offset=causal_offset,
-        bias_q1=bias_q1,
+        causal=causal, seq_q=tq, seq_k=tk, causal_offset=causal_offset,
+        bias_q1=bias_q1, drop_rate=drop_rate, inv_keep=inv_keep,
     )
     if bias is None:
-        def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref):
-            return dq_kern(q_ref, k_ref, v_ref, None, do_ref, lse_ref,
-                           delta_ref, dq_ref)
+        def dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref):
+            return dq_kern(seed_ref, q_ref, k_ref, v_ref, None, do_ref,
+                           lse_ref, delta_ref, dq_ref)
     else:
         dq_kernel = dq_kern
 
@@ -826,27 +964,27 @@ def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
     # ---- dK/dV: grid over kv blocks -------------------------------------
     qfull_spec, kblock_spec = _qkv_specs(fmt, h, "full", "block", block_q,
                                          block_k, tq, tk, d)
-    in_specs = [qfull_spec, kblock_spec, kblock_spec, qfull_spec,
-                _lse_spec_full, _lse_spec_full]
-    args = [args3[0], args3[1], args3[2], args3[3], lse3, delta3]
+    in_specs = [_seed_spec(), qfull_spec, kblock_spec, kblock_spec,
+                qfull_spec, _lse_spec_full, _lse_spec_full]
+    args = [seed, args3[0], args3[1], args3[2], args3[3], lse3, delta3]
     bias_q1 = False
     if bias is not None:
         spec, barg, bias_q1 = _bias_spec_and_arg(
             bias, b, h, tq, tk, block_q, block_k, for_dkv=True
         )
-        in_specs.insert(3, spec)
-        args.insert(3, barg)
+        in_specs.insert(4, spec)
+        args.insert(4, barg)
 
     dkv_kern = functools.partial(
         _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
-        causal=causal, seq_q=tq, causal_offset=causal_offset,
-        bias_q1=bias_q1,
+        causal=causal, seq_q=tq, seq_k=tk, causal_offset=causal_offset,
+        bias_q1=bias_q1, drop_rate=drop_rate, inv_keep=inv_keep,
     )
     if bias is None:
-        def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref):
-            return dkv_kern(q_ref, k_ref, v_ref, None, do_ref, lse_ref,
-                            delta_ref, dk_ref, dv_ref)
+        def dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                       delta_ref, dk_ref, dv_ref):
+            return dkv_kern(seed_ref, q_ref, k_ref, v_ref, None, do_ref,
+                            lse_ref, delta_ref, dk_ref, dv_ref)
     else:
         dkv_kernel = dkv_kern
 
@@ -869,7 +1007,8 @@ def _flash_backward(q, k, v, bias, o, lse, g, scale, causal, block_q,
     )
 
 
-def _dbias_xla(q, k, bias, lse, g, v, o, scale, causal):
+def _dbias_xla(q, k, bias, lse, g, v, o, scale, causal, dropout_rate=0.0,
+               dropout_seed=None):
     """Bias cotangent via plain-XLA recompute (dS reduced over broadcast
     dims).  O(T^2) memory — but attention biases are almost always
     stop-gradient masks, and then XLA dead-code-eliminates this whole
@@ -886,6 +1025,11 @@ def _dbias_xla(q, k, bias, lse, g, v, o, scale, causal):
     p = jnp.exp(logits - lse[..., None])
     dp = jnp.einsum("bhqd,bhkd->bhqk", g.astype(jnp.float32),
                     v.astype(jnp.float32))
+    if dropout_rate:
+        from . import hash_rng
+
+        keep = hash_rng.keep_mask_attn(dropout_seed, dp.shape, dropout_rate)
+        dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     ds = p * (dp - delta[..., None])
     # reduce over dims the bias broadcast along
@@ -898,7 +1042,8 @@ def _dbias_xla(q, k, bias, lse, g, v, o, scale, causal):
 
 
 def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
-                    block_q=512, block_k=512, interpret=None, fmt="bhtd"):
+                    block_q=512, block_k=512, interpret=None, fmt="bhtd",
+                    dropout_rate=0.0, dropout_seed=None):
     """q,k,v: [B, H, T, D] (fmt="bhtd", default) or [B, T, H, D]
     (fmt="bthd"); bias: broadcastable [B, H, Tq, Tk] or None.  Returns the
     context in the same format as q.
@@ -909,42 +1054,65 @@ def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
     copies at the custom-call boundary (round-3 profile: ~5.5 GB/step of
     such copies at the bhtd boundary).
 
+    dropout_rate > 0 applies dropout to the attention WEIGHTS *inside* the
+    kernels (the reference's dropout-on-softmax semantics,
+    transformer_model.py:44 + dropout_op.cc) — the [Tq, Tk] mask never
+    exists in HBM.  The mask bit for element (b,h,q,k) is the counter-based
+    hash of kernels/hash_rng.py over (dropout_seed, global index): forward
+    and backward kernels regenerate it independently, and the pure-XLA
+    fallback produces the identical mask.  `dropout_seed`: (1,) uint32
+    array (see hash_rng.seed_from_key), traced — one per (step, site).
+
     Fully differentiable with Pallas kernels on BOTH passes: forward saves
     only (out, logsumexp); backward recomputes probability blocks in-kernel
     (FlashAttention-2), so neither pass materializes the [Tq, Tk] matrix."""
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
 
     if fmt not in ("bhtd", "bthd"):
         raise ValueError(f"flash_attention: unknown fmt {fmt!r}")
+    if dropout_rate:
+        if dropout_seed is None:
+            raise ValueError("flash_attention: dropout_rate > 0 needs "
+                             "dropout_seed")
+        seed = jnp.reshape(dropout_seed, (1,)).astype(jnp.uint32)
+    else:
+        seed = jnp.zeros((1,), jnp.uint32)
+
+    def _f0(s):
+        return np.zeros(s.shape, dtype=jax.dtypes.float0)
+
     ok, bq, bk, interp = _plan(q, k, block_q, block_k, interpret, fmt)
     if not ok:
         if fmt == "bthd":
-            out = reference_attention(
-                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                v.transpose(0, 2, 1, 3), bias, scale, causal)
-            return out.transpose(0, 2, 1, 3)
-        return reference_attention(q, k, v, bias, scale, causal)
+            return _reference_bthd(q, k, v, bias, scale, causal,
+                                   dropout_rate, seed)
+        return reference_attention(q, k, v, bias, scale, causal,
+                                   dropout_rate, seed)
 
     if bias is None:
         @jax.custom_vjp
-        def _attn(q, k, v):
-            out, _ = _flash_forward(q, k, v, None, scale, causal, bq, bk,
-                                    interp, fmt)
+        def _attn(q, k, v, seed):
+            out, _ = _flash_forward(q, k, v, None, seed, scale, causal,
+                                    bq, bk, interp, fmt, dropout_rate)
             return out
 
-        def _fwd(q, k, v):
-            out, lse = _flash_forward(q, k, v, None, scale, causal, bq, bk,
-                                      interp, fmt)
-            return out, (q, k, v, out, lse)
+        def _fwd(q, k, v, seed):
+            out, lse = _flash_forward(q, k, v, None, seed, scale, causal,
+                                      bq, bk, interp, fmt, dropout_rate)
+            return out, (q, k, v, seed, out, lse)
 
         def _bwd(res, g):
-            q, k, v, out, lse = res
-            return _flash_backward(q, k, v, None, out, lse, g, scale,
-                                   causal, bq, bk, interp, fmt)
+            q, k, v, seed, out, lse = res
+            dq, dk, dv = _flash_backward(q, k, v, None, seed, out, lse, g,
+                                         scale, causal, bq, bk, interp,
+                                         fmt, dropout_rate)
+            return dq, dk, dv, _f0(seed)
 
         _attn.defvjp(_fwd, _bwd)
-        return _attn(q, k, v)
+        return _attn(q, k, v, seed)
 
     # normalize bias to 4D [Bb, Hb, Tqb, Tkb]; each dim must be 1 or full
     bias = jnp.asarray(bias)
@@ -956,31 +1124,31 @@ def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
     if (bb not in (1, _b) or hb not in (1, _h)
             or tqb not in (1, _tq) or tkb not in (1, _tk)):
         if fmt == "bthd":
-            out = reference_attention(
-                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                v.transpose(0, 2, 1, 3), bias, scale, causal)
-            return out.transpose(0, 2, 1, 3)
-        return reference_attention(q, k, v, bias, scale, causal)
+            return _reference_bthd(q, k, v, bias, scale, causal,
+                                   dropout_rate, seed)
+        return reference_attention(q, k, v, bias, scale, causal,
+                                   dropout_rate, seed)
     if tkb == 1:
         # key-broadcast biases can't be block-sliced along Tk; materialize
         # the (cheap, [.., .., 1]-thin) broadcast up front
         bias = jnp.broadcast_to(bias, (bb, hb, tqb, _tk))
 
     @jax.custom_vjp
-    def _attn(q, k, v, bias):
-        out, _ = _flash_forward(q, k, v, bias, scale, causal, bq, bk,
-                                interp, fmt)
+    def _attn(q, k, v, bias, seed):
+        out, _ = _flash_forward(q, k, v, bias, seed, scale, causal, bq, bk,
+                                interp, fmt, dropout_rate)
         return out
 
-    def _fwd(q, k, v, bias):
-        out, lse = _flash_forward(q, k, v, bias, scale, causal, bq, bk,
-                                  interp, fmt)
-        return out, (q, k, v, bias, out, lse)
+    def _fwd(q, k, v, bias, seed):
+        out, lse = _flash_forward(q, k, v, bias, seed, scale, causal, bq,
+                                  bk, interp, fmt, dropout_rate)
+        return out, (q, k, v, bias, seed, out, lse)
 
     def _bwd(res, g):
-        q, k, v, bias, out, lse = res
-        dq, dk, dv = _flash_backward(q, k, v, bias, out, lse, g, scale,
-                                     causal, bq, bk, interp, fmt)
+        q, k, v, bias, seed, out, lse = res
+        dq, dk, dv = _flash_backward(q, k, v, bias, seed, out, lse, g,
+                                     scale, causal, bq, bk, interp, fmt,
+                                     dropout_rate)
         if fmt == "bthd":
             # _dbias_xla is written for bhtd; the transpose is an XLA view
             # feeding an einsum (fused), and trainable biases are rare —
@@ -988,10 +1156,12 @@ def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
             dbias = _dbias_xla(
                 q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), bias,
                 lse, g.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
-                out.transpose(0, 2, 1, 3), scale, causal)
+                out.transpose(0, 2, 1, 3), scale, causal, dropout_rate,
+                seed)
         else:
-            dbias = _dbias_xla(q, k, bias, lse, g, v, out, scale, causal)
-        return dq, dk, dv, dbias
+            dbias = _dbias_xla(q, k, bias, lse, g, v, out, scale, causal,
+                               dropout_rate, seed)
+        return dq, dk, dv, dbias, _f0(seed)
 
     _attn.defvjp(_fwd, _bwd)
-    return _attn(q, k, v, bias)
+    return _attn(q, k, v, bias, seed)

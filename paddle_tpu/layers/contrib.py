@@ -7,21 +7,29 @@ from ..layer_helper import LayerHelper
 
 def fused_attention(q, k, v, bias=None, scale=1.0, causal=False,
                     dropout_rate=0.0, block_q=512, block_k=512,
-                    fmt="bhtd", name=None):
+                    fmt="bhtd", weights_dropout=True, name=None):
     """Flash-attention layer (Pallas kernel on TPU) over [B,H,T,D] tensors
     (fmt="bhtd") or [B,T,H,D] tensors (fmt="bthd" — the transpose-free
     convention: reshape the projection output [B,T,H*D] to [B,T,H,D] and
     skip split/merge-head transposes entirely).
 
-    NOTE: with dropout_rate > 0 this applies dropout to the attention
-    *output* (flash-style), not to the attention weights like the unfused
-    path — toggling use_flash changes regularization semantics under
-    dropout."""
+    With dropout_rate > 0 and weights_dropout=True (default), dropout
+    applies to the attention WEIGHTS inside the kernels (the reference's
+    dropout-on-softmax semantics, transformer_model.py:44) via a
+    deterministic per-step hash mask that never exists in HBM — see
+    kernels/hash_rng.py.  The in-kernel mask costs O(T²·H) hash work
+    regenerated in all three kernels, so it wins at short sequences
+    (BERT-128: +1 MFU pt) and loses at long ones (seq 256: −2.5 pts);
+    weights_dropout=False instead applies hash dropout to the attention
+    OUTPUT (O(T·D) work, flash-style semantics)."""
+    from ..core import framework as fw
+
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if bias is not None:
         inputs["Bias"] = [bias]
+    in_kernel_rate = dropout_rate if weights_dropout else 0.0
     helper.append_op(
         "fused_attention",
         inputs=inputs,
@@ -32,10 +40,12 @@ def fused_attention(q, k, v, bias=None, scale=1.0, causal=False,
             "block_q": block_q,
             "block_k": block_k,
             "fmt": fmt,
+            "dropout_rate": float(in_kernel_rate),
+            "rng_id": fw.unique_rng_id() if in_kernel_rate else 0,
         },
     )
     out.shape = q.shape
-    if dropout_rate:
+    if dropout_rate and not weights_dropout:
         from .nn import dropout
 
         out = dropout(out, dropout_prob=dropout_rate,

@@ -13,14 +13,26 @@ def lower_fused_attention(ctx, ins):
     "bthd") q/k/v with optional additive bias.  "bthd" is the
     transpose-free convention — see kernels/attention.py.
 
-    No dropout inside the op: attention-weight dropout is not expressible in
-    the streaming kernel, and in-op randomness would break the generic vjp
-    re-trace.  The contrib layer applies a separate dropout op on the output
-    (correct masked gradients via the dropout op's saved Mask)."""
+    dropout_rate > 0 applies the reference's dropout-on-attention-weights
+    semantics (transformer_model.py:44) INSIDE the kernels: the mask is the
+    counter-based hash of (step base key, rng_id, global element index) —
+    deterministic within a step, so the generic vjp re-trace regenerates
+    the identical mask in the backward and the [Tq,Tk] mask never exists
+    in HBM (see kernels/hash_rng.py)."""
     from ..kernels.attention import flash_attention
+    from ..kernels import hash_rng
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     bias = ins.get("Bias", [None])[0]
+    rate = ctx.attr("dropout_rate", 0.0)
+    if ctx.attr("is_test", False) or ctx.is_test:
+        rate = 0.0
+    seed = None
+    if rate:
+        base = getattr(ctx.executor_ctx, "base_key", None)
+        if base is None:
+            base = ctx.executor_ctx._base_key  # eager session
+        seed = hash_rng.seed_from_key(base, ctx.attr("rng_id", 1))
     out = flash_attention(
         q, k, v, bias,
         scale=ctx.attr("scale", 1.0),
@@ -28,6 +40,8 @@ def lower_fused_attention(ctx, ins):
         block_q=ctx.attr("block_q", 512),
         block_k=ctx.attr("block_k", 512),
         fmt=ctx.attr("fmt", "bhtd"),
+        dropout_rate=rate,
+        dropout_seed=seed,
     )
     return {"Out": [out]}
 

@@ -662,10 +662,20 @@ def lower_dropout(ctx, ins):
 
 def _dropout_keep_mask(ctx, jax, shape, p):
     """The keep mask for one dropout op.  With a static rng_id attr the
-    key is fold_in(step_key, rng_id) — fully deterministic within a step,
-    so the BACKWARD op regenerates the identical mask instead of reading
-    a saved residual (removes one HBM round-trip per dropout site; the
-    fwd->bwd mask residuals cost ~12% end-to-end on transformer-base)."""
+    mask is a pure function of (step base key, rng_id, element index) —
+    fully deterministic within a step, so the BACKWARD op regenerates the
+    identical mask instead of reading a saved residual (removes one HBM
+    round-trip per dropout site; the fwd->bwd mask residuals cost ~12%
+    end-to-end on transformer-base).
+
+    With FLAGS.hash_dropout (default) the generator is the counter-based
+    hash of kernels/hash_rng.py: ~10 integer ops over an iota that XLA
+    fuses into the consuming select, so no random-bits tensor ever
+    exists in HBM (rbg rng-bit-generator is a fusion barrier — its bits
+    round-tripped ~2.5 ms/step on transformer-base)."""
+    from ..flags import FLAGS
+    from ..kernels import hash_rng
+
     seed = ctx.attr("seed", 0)
     rng_id = ctx.attr("rng_id", 0)
     if seed:
@@ -674,9 +684,15 @@ def _dropout_keep_mask(ctx, jax, shape, p):
         base = getattr(ctx.executor_ctx, "base_key", None)
         if base is None:
             base = ctx.executor_ctx._base_key  # eager session
+        if FLAGS.hash_dropout:
+            return hash_rng.keep_mask(
+                hash_rng.seed_from_key(base, rng_id), shape, p)
         key = jax.random.fold_in(base, rng_id)
     else:
         key = ctx.next_rng_key()
+    if FLAGS.hash_dropout:
+        return hash_rng.keep_mask(
+            hash_rng.seed_from_key(key, rng_id or 1), shape, p)
     return jax.random.bernoulli(key, 1.0 - p, shape)
 
 
@@ -833,6 +849,12 @@ def lower_hierarchical_sigmoid(ctx, ins):
     per-sample bit-code loop.
     Inputs: X [b,d], Label [b,1], W [V-1,d], Bias [V-1] (opt).
     Output: Out [b,1] cost.
+
+    CUSTOM TREES (reference custom-tree path, hierarchical_sigmoid_op.cc +
+    math/matrix_bit_code.h CustomCode): optional PathTable [b, L] (row ids
+    into W along each sample's root->leaf path; negative = padding) and
+    PathCode [b, L] (the 0/1 branch codes) replace the heap-derived
+    row/bit/valid — same masked-gather evaluation.
     """
     import jax
     jnp = _jnp()
@@ -843,14 +865,25 @@ def lower_hierarchical_sigmoid(ctx, ins):
     bias = ins["Bias"][0] if ins.get("Bias") else None
     num_classes = ctx.attr("num_classes", w.shape[0] + 1)
 
-    n = label + num_classes  # heap leaf id, root = 1
-    depth = int(2 * num_classes - 1).bit_length() - 1  # static max path len
+    if ins.get("PathTable"):
+        table = ins["PathTable"][0].astype(jnp.int32)
+        code = ins["PathCode"][0].astype(jnp.int32)
+        if table.ndim == 3:
+            table = table[..., 0]
+            code = code[..., 0]
+        depth = table.shape[1]
+        valid = table >= 0
+        row = jnp.clip(table, 0, w.shape[0] - 1)
+        bit = code
+    else:
+        n = label + num_classes  # heap leaf id, root = 1
+        depth = int(2 * num_classes - 1).bit_length() - 1  # static path len
 
-    js = jnp.arange(depth)
-    anc = n[:, None] >> (js[None, :] + 1)          # [b, L]
-    valid = anc >= 1
-    row = jnp.clip(anc - 1, 0, num_classes - 2)
-    bit = (n[:, None] >> js[None, :]) & 1
+        js = jnp.arange(depth)
+        anc = n[:, None] >> (js[None, :] + 1)          # [b, L]
+        valid = anc >= 1
+        row = jnp.clip(anc - 1, 0, num_classes - 2)
+        bit = (n[:, None] >> js[None, :]) & 1
 
     w_rows = jnp.take(w, row.reshape(-1), axis=0).reshape(
         label.shape[0], depth, -1)
@@ -964,6 +997,89 @@ def lower_pool3d(ctx, ins):
     return {"Out": [out]}
 
 
+@register("spp")
+def lower_spp(ctx, ins):
+    """Spatial pyramid pooling (reference spp_op.cc + spp_op.h): for level
+    l in [0, pyramid_height) pool NCHW input into a 2^l x 2^l grid
+    (kernel = ceil(in/bins), pad so kernel*bins covers the padded input,
+    stride = kernel — the reference's formula), flatten each level and
+    concat -> [N, C * sum(4^l)]."""
+    import jax.lax as lax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    height = ctx.attr("pyramid_height", 1)
+    ptype = ctx.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for level in range(height):
+        bins = 2 ** level
+        kh = -(-h // bins)
+        kw = -(-w // bins)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        window = (1, 1, kh, kw)
+        strides = (1, 1, kh, kw)
+        pads = ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                (pw, kw * bins - w - pw))
+        if ptype == "max":
+            o = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                  pads)
+        else:
+            ones = jnp.ones_like(x)
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                    pads)
+            o = s / cnt
+        outs.append(o.reshape(n, c * bins * bins))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register("max_pool3d_with_index")
+def lower_max_pool3d_with_index(ctx, ins):
+    """3-D max pool returning the flat argmax index within each input
+    [D, H, W] map (reference pool_with_index_op.cc MaxPool3dWithIndex)."""
+    import jax
+
+    jnp = _jnp()
+    x = ins["X"][0]
+    ks = ctx.attr("ksize", [2, 2, 2])
+    s = ctx.attr("strides", ks)
+    p = ctx.attr("paddings", [0, 0, 0])
+    if ctx.attr("global_pooling", False):
+        ks = list(x.shape[2:])
+        s = ks
+        p = [0, 0, 0]
+    n, c, d, h, w = x.shape
+    od = (d + 2 * p[0] - ks[0]) // s[0] + 1
+    oh = (h + 2 * p[1] - ks[1]) // s[1] + 1
+    ow = (w + 2 * p[2] - ks[2]) // s[2] + 1
+    # source coords per output cell: [od,oh,ow,kd,kh,kw]
+    zs = (jnp.arange(od) * s[0] - p[0])[:, None, None, None, None, None] + \
+        jnp.arange(ks[0])[None, None, None, :, None, None]
+    ys = (jnp.arange(oh) * s[1] - p[1])[None, :, None, None, None, None] + \
+        jnp.arange(ks[1])[None, None, None, None, :, None]
+    xs = (jnp.arange(ow) * s[2] - p[2])[None, None, :, None, None, None] + \
+        jnp.arange(ks[2])[None, None, None, None, None, :]
+    zs, ys, xs = jnp.broadcast_arrays(zs, ys, xs)
+    inb = ((zs >= 0) & (zs < d) & (ys >= 0) & (ys < h)
+           & (xs >= 0) & (xs < w))
+    zc = jnp.clip(zs, 0, d - 1)
+    yc = jnp.clip(ys, 0, h - 1)
+    xc = jnp.clip(xs, 0, w - 1)
+    vals = x[:, :, zc, yc, xc]              # [N,C,od,oh,ow,kd,kh,kw]
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    vals = jnp.where(inb[None, None], vals, neg)
+    flat = vals.reshape(n, c, od, oh, ow, -1)
+    best = jnp.argmax(flat, axis=-1)
+    out = jnp.take_along_axis(flat, best[..., None], axis=-1)[..., 0]
+    gidx = (zc * h + yc) * w + xc           # flat index into [d,h,w]
+    bidx = jnp.take_along_axis(
+        jnp.broadcast_to(gidx[None, None], vals.shape).reshape(
+            n, c, od, oh, ow, -1), best[..., None], axis=-1)[..., 0]
+    return {"Out": [out], "Mask": [bidx.astype(jnp.int32)]}
+
+
 @register("conv3d_transpose")
 def lower_conv3d_transpose(ctx, ins):
     """3D transpose conv as input-dilated conv (reference
@@ -1006,6 +1122,10 @@ def lower_max_pool2d_with_index(ctx, ins):
     ks = ctx.attr("ksize", [2, 2])
     s = ctx.attr("strides", ks)
     p = ctx.attr("paddings", [0, 0])
+    if ctx.attr("global_pooling", False):
+        ks = list(x.shape[2:])
+        s = ks
+        p = [0, 0]
     n, c, h, w = x.shape
     oh = (h + 2 * p[0] - ks[0]) // s[0] + 1
     ow = (w + 2 * p[1] - ks[1]) // s[1] + 1

@@ -266,9 +266,12 @@ def _attention_fuse(program: fw.Program, scope=None) -> int:
     bundled model (VERDICT r3 weak #5; reference analogue:
     attention_lstm_fuse / GraphPatternDetector-driven fusions).
 
-    Dropout on the attention WEIGHTS is re-sited onto the fused output —
-    the same documented substitution layers.contrib.fused_attention makes
-    (the streaming kernel cannot materialize the weight matrix).
+    Dropout on the attention WEIGHTS with upscale_in_train semantics is
+    folded INTO the fused op (the kernels apply the mask in-register via
+    the deterministic hash PRNG — exact weights-dropout semantics, see
+    kernels/attention.py).  downgrade_in_infer dropout (train-time output
+    is NOT upscaled) is not expressible in-kernel and is re-sited onto the
+    fused output, the documented approximation.
     """
     block = program.global_block()
     fetch_names = set(getattr(program, "fetch_var_names", []) or [])
@@ -333,17 +336,40 @@ def _attention_fuse(program: fw.Program, scope=None) -> int:
                     drop_spec = None
                     if with_dropout:
                         drop = m["drop"][1]
-                        fused_out = fw.unique_name("attn_fuse_out")
-                        block.create_var(name=fused_out, dtype=qvar.dtype)
-                        # dropout re-sited onto the fused output; the op is
-                        # REBUILT after the fused op (V's producer may sit
-                        # between the old dropout and AV matmul positions,
-                        # so the old dropout slot can precede V)
-                        drop_spec = (dict(drop.attrs),
-                                     {"X": [fused_out]},
-                                     {"Out": [av_out],
-                                      "Mask": drop.outputs.get("Mask", [])})
-                        out_name = fused_out
+                        d_impl = drop.attrs.get("dropout_implementation",
+                                                "downgrade_in_infer")
+                        # fold only plain train-mode dropout: an is_test or
+                        # fixed-seed dropout op carries semantics the fused
+                        # attrs can't express, and a consumed Mask output
+                        # needs its producer — re-site those instead
+                        mask_names = set(drop.outputs.get("Mask", []))
+                        mask_used = mask_names and any(
+                            mask_names & set(op2.input_arg_names())
+                            for op2 in block.ops if op2 is not drop)
+                        if (d_impl == "upscale_in_train"
+                                and not drop.attrs.get("is_test", False)
+                                and not drop.attrs.get("seed", 0)
+                                and not mask_used):
+                            # exact weights-dropout inside the kernel
+                            attrs["dropout_rate"] = drop.attrs.get(
+                                "dropout_prob", 0.5)
+                            attrs["rng_id"] = fw.unique_rng_id()
+                            out_name = av_out
+                        else:
+                            fused_out = fw.unique_name("attn_fuse_out")
+                            block.create_var(name=fused_out,
+                                             dtype=qvar.dtype)
+                            # dropout re-sited onto the fused output; the
+                            # op is REBUILT after the fused op (V's
+                            # producer may sit between the old dropout and
+                            # AV matmul positions, so the old dropout slot
+                            # can precede V)
+                            drop_spec = (dict(drop.attrs),
+                                         {"X": [fused_out]},
+                                         {"Out": [av_out],
+                                          "Mask": drop.outputs.get(
+                                              "Mask", [])})
+                            out_name = fused_out
                         remove_keys = ("qk", "add", "sm", "drop", "av")
                     else:
                         out_name = av_out

@@ -1,0 +1,134 @@
+"""Statistical + determinism tests for the counter-based hash PRNG
+(kernels/hash_rng.py) and its use by the dropout op.
+
+The reference's dropout contract (dropout_op.cc): mask ~ Bernoulli(1-p),
+identical mask applied in forward and backward.  Here the mask is
+regenerated (not saved), so the determinism properties ARE the contract.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels import hash_rng
+
+
+def _bits(seed, n):
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    return np.asarray(hash_rng.mix32(idx * jnp.uint32(hash_rng.GOLDEN)
+                                     + jnp.uint32(seed)))
+
+
+class TestHashBits:
+    def test_deterministic(self):
+        assert (_bits(123, 1000) == _bits(123, 1000)).all()
+
+    def test_seed_sensitivity(self):
+        # one-bit seed change flips ~half the mask decisions
+        a = _bits(0x1234, 1 << 14) >> 31
+        b = _bits(0x1235, 1 << 14) >> 31
+        frac = (a != b).mean()
+        assert 0.45 < frac < 0.55
+
+    def test_uniformity_chi_square(self):
+        # 256-bucket chi-square over the top byte; 3 sigma ~ 255 + 3*sqrt(510)
+        n = 1 << 16
+        top = _bits(42, n) >> 24
+        counts = np.bincount(top, minlength=256)
+        chi2 = ((counts - n / 256) ** 2 / (n / 256)).sum()
+        assert chi2 < 350, chi2
+
+    def test_mean_variance(self):
+        n = 1 << 16
+        u = _bits(7, n).astype(np.float64) / 2**32
+        assert abs(u.mean() - 0.5) < 0.01
+        assert abs(u.var() - 1 / 12) < 0.01
+
+    def test_adjacent_index_independence(self):
+        # lag-1 autocorrelation of the uniform stream ~ 0
+        n = 1 << 16
+        u = _bits(99, n).astype(np.float64) / 2**32
+        r = np.corrcoef(u[:-1], u[1:])[0, 1]
+        assert abs(r) < 0.02, r
+
+
+class TestKeepMask:
+    @pytest.mark.parametrize("rate", [0.1, 0.5, 0.9])
+    def test_keep_fraction(self, rate):
+        seed = jnp.uint32(31337)
+        m = np.asarray(hash_rng.keep_mask(seed, (256, 256), rate))
+        frac = m.mean()
+        assert abs(frac - (1.0 - rate)) < 0.02, (rate, frac)
+
+    def test_rate_zero_keeps_all(self):
+        m = np.asarray(hash_rng.keep_mask(jnp.uint32(5), (64,), 0.0))
+        assert m.all()
+
+    def test_base_index_tiles_match_full(self):
+        # blocked generation with base_index == slicing the full mask
+        seed = jnp.uint32(777)
+        full = np.asarray(hash_rng.keep_mask(seed, (4, 128), 0.3))
+        t0 = np.asarray(hash_rng.keep_mask(seed, (2, 128), 0.3, base_index=0))
+        t1 = np.asarray(hash_rng.keep_mask(seed, (2, 128), 0.3,
+                                           base_index=2 * 128))
+        assert (full[:2] == t0).all() and (full[2:] == t1).all()
+
+    def test_keep_mask_tile_matches_keep_mask(self):
+        seed = jnp.uint32(4242)
+        idx = jnp.arange(512, dtype=jnp.uint32).reshape(4, 128)
+        a = np.asarray(hash_rng.keep_mask(seed, (4, 128), 0.25))
+        b = np.asarray(hash_rng.keep_mask_tile(seed, idx, 0.25))
+        assert (a == b).all()
+
+    def test_site_independence(self):
+        # different rng_ids (seeds via seed_from_key) give uncorrelated masks
+        key = jax.random.key(0, impl="rbg")
+        m1 = np.asarray(hash_rng.keep_mask(
+            hash_rng.seed_from_key(key, 1), (1 << 14,), 0.5))
+        m2 = np.asarray(hash_rng.keep_mask(
+            hash_rng.seed_from_key(key, 2), (1 << 14,), 0.5))
+        agree = (m1 == m2).mean()
+        assert 0.45 < agree < 0.55
+
+    def test_step_independence(self):
+        # fold_in'ing the key (a new step) changes the mask
+        key = jax.random.key(0, impl="rbg")
+        k2 = jax.random.fold_in(key, 1)
+        m1 = np.asarray(hash_rng.keep_mask(
+            hash_rng.seed_from_key(key, 1), (1 << 14,), 0.5))
+        m2 = np.asarray(hash_rng.keep_mask(
+            hash_rng.seed_from_key(k2, 1), (1 << 14,), 0.5))
+        agree = (m1 == m2).mean()
+        assert 0.45 < agree < 0.55
+
+
+class TestDropoutOpUsesHash:
+    def test_train_fwd_bwd_mask_consistency(self):
+        """Grad of sum(dropout(x)) must be scale exactly where out != 0 —
+        i.e. the backward regenerated the forward's mask bit-exactly."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            x = layers.data(name="x", shape=[64, 64], dtype="float32")
+            x.stop_gradient = False
+            out = layers.dropout(x, dropout_prob=0.4,
+                                 dropout_implementation="upscale_in_train")
+            loss = layers.reduce_sum(out)
+            pt.append_backward(loss)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope)
+        xv = np.random.RandomState(0).randn(1, 64, 64).astype("float32")
+        outs = exe.run(prog, feed={"x": xv},
+                       fetch_list=[out.name, "x@GRAD"], scope=scope)
+        o, gx = np.asarray(outs[0]), np.asarray(outs[1])
+        scale = 1.0 / 0.6
+        kept = o != 0
+        assert np.allclose(gx[kept], scale, atol=1e-5)
+        assert np.allclose(gx[~kept], 0.0)
+        # keep fraction sane
+        assert abs(kept.mean() - 0.6) < 0.05

@@ -193,6 +193,158 @@ def test_flash_attention_bthd_format(name, tq, tk, bias_shape, causal):
             err_msg=name)
 
 
+@pytest.mark.parametrize(
+    "name,fmt,causal",
+    [
+        ("bhtd", "bhtd", False),
+        ("bhtd_causal", "bhtd", True),
+        ("bthd", "bthd", False),
+        ("bthd_causal", "bthd", True),
+    ],
+)
+def test_flash_attention_dropout_matches_reference(name, fmt, causal):
+    """In-kernel weights-dropout (deterministic hash mask) vs the pure-XLA
+    fallback with the SAME seed: outputs and all grads must match — i.e.
+    the fwd kernel, both bwd kernels, and the fallback all regenerate the
+    identical mask from (seed, global element index)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.attention import (
+        flash_attention,
+        reference_attention,
+    )
+
+    d, t = 64, 128
+    rate = 0.3
+    rng = np.random.RandomState(11)
+    seed = jnp.asarray([12345], jnp.uint32)
+    if fmt == "bhtd":
+        shape = (2, 2, t, d)
+    else:
+        shape = (2, t, 2, d)
+    q = jnp.asarray(rng.randn(*shape).astype("float32"))
+    k = jnp.asarray(rng.randn(*shape).astype("float32"))
+    v = jnp.asarray(rng.randn(*shape).astype("float32"))
+    scale = 1.0 / np.sqrt(d)
+
+    def ref(q, k, v):
+        if fmt == "bthd":
+            out = reference_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), None, scale, causal, rate, seed)
+            return out.transpose(0, 2, 1, 3)
+        return reference_attention(q, k, v, None, scale, causal, rate, seed)
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, None, scale=scale, causal=causal,
+                               block_q=64, block_k=64, fmt=fmt,
+                               dropout_rate=rate, dropout_seed=seed)
+
+    with jax.default_matmul_precision("highest"):
+        out_f = flash(q, k, v)
+        out_r = ref(q, k, v)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r),
+                                   atol=1e-5, err_msg=name)
+        # dropped entries really exist (mask is active)
+        assert not np.allclose(
+            np.asarray(out_f),
+            np.asarray(flash_attention(q, k, v, None, scale=scale,
+                                       causal=causal, block_q=64,
+                                       block_k=64, fmt=fmt)))
+
+        def mk_loss(fn):
+            return lambda *a: jnp.sum(fn(*a) * jnp.cos(fn(*a)))
+
+        gf = jax.grad(mk_loss(flash), (0, 1, 2))(q, k, v)
+        gr = jax.grad(mk_loss(ref), (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert np.all(np.isfinite(np.asarray(a))), name
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-3, err_msg=name)
+
+
+def test_flash_attention_dropout_bias_grad():
+    """Trainable-bias cotangent under in-kernel dropout (the _dbias_xla
+    recompute must apply the same hash mask)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.attention import (
+        flash_attention,
+        reference_attention,
+    )
+
+    d, t = 64, 128
+    rate = 0.2
+    rng = np.random.RandomState(3)
+    seed = jnp.asarray([777], jnp.uint32)
+    q = jnp.asarray(rng.randn(2, 2, t, d).astype("float32"))
+    k = jnp.asarray(rng.randn(2, 2, t, d).astype("float32"))
+    v = jnp.asarray(rng.randn(2, 2, t, d).astype("float32"))
+    bias = jnp.asarray(0.3 * rng.randn(2, 2, t, t).astype("float32"))
+    scale = 1.0 / np.sqrt(d)
+
+    def loss(fn, *a):
+        out = fn(a[0], a[1], a[2], a[3])
+        return jnp.sum(out * jnp.cos(out))
+
+    with jax.default_matmul_precision("highest"):
+        gf = jax.grad(
+            lambda *a: loss(
+                lambda q, k, v, b: flash_attention(
+                    q, k, v, b, scale=scale, block_q=64, block_k=64,
+                    dropout_rate=rate, dropout_seed=seed), *a),
+            (0, 1, 2, 3))(q, k, v, bias)
+        gr = jax.grad(
+            lambda *a: loss(
+                lambda q, k, v, b: reference_attention(
+                    q, k, v, b, scale, False, rate, seed), *a),
+            (0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-3)
+
+
+def test_fused_attention_layer_dropout_in_program():
+    """fused_attention layer with dropout_rate: in-kernel weights dropout —
+    train output differs from no-dropout but is deterministic per step,
+    and is_test mode disables it."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    def build(rate):
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            q = layers.data(name="q", shape=[2, 64, 32], dtype="float32")
+            k = layers.data(name="k", shape=[2, 64, 32], dtype="float32")
+            v = layers.data(name="v", shape=[2, 64, 32], dtype="float32")
+            out = layers.contrib.fused_attention(
+                q, k, v, scale=0.2, dropout_rate=rate)
+            s = layers.reduce_sum(out)
+        return prog, startup, out, s
+
+    rng = np.random.RandomState(0)
+    feed = {n: rng.randn(1, 2, 64, 32).astype("float32") for n in "qkv"}
+    exe = pt.Executor(pt.CPUPlace())
+
+    prog0, st0, out0, _ = build(0.0)
+    scope0 = pt.Scope()
+    with pt.scope_guard(scope0):
+        exe.run(st0, scope=scope0)
+        (base,) = exe.run(prog0, feed=feed, fetch_list=[out0], scope=scope0)
+
+    prog, st, out, _ = build(0.4)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(st, scope=scope)
+        (a,) = exe.run(prog, feed=feed, fetch_list=[out], scope=scope)
+        infer = prog.clone(for_test=True)
+        (b,) = exe.run(infer, feed=feed, fetch_list=[out], scope=scope)
+    assert not np.allclose(np.asarray(a), np.asarray(base))
+    np.testing.assert_allclose(np.asarray(b), np.asarray(base), atol=1e-5)
+
+
 def test_fused_attention_layer_in_program():
     from paddle_tpu import layers
 
