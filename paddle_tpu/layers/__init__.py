@@ -7,6 +7,7 @@ from .control_flow import (  # noqa: F401
     While,
     Switch,
     IfElse,
+    Print,
     StaticRNN,
     DynamicRNN,
     array_write,

@@ -505,3 +505,30 @@ class DynamicRNN(StaticRNN):
 
     def block(self):
         return self.step()
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug print of a tensor at run time (reference layers.Print /
+    print_op.cc).  Logs via a jax.debug.print host callback and returns
+    the value unchanged, so it can be chained inside a program.  LoD/type
+    toggles are accepted for API parity (dense padded tensors carry no
+    LoD; dtype rides in the shape line)."""
+    helper = LayerHelper("print", name=None)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "print",
+        inputs={"In": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "first_n": first_n,
+            "message": message or "",
+            "summarize": summarize,
+            "print_tensor_name": print_tensor_name,
+            "print_phase": print_phase,
+        },
+    )
+    out.shape = input.shape
+    return out

@@ -877,11 +877,15 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
 def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
              name=None, path_table=None, path_code=None, is_custom=False,
              is_sparse=False):
-    """Hierarchical sigmoid over the default complete binary tree
-    (reference: layers/nn.py hsigmoid, hierarchical_sigmoid_op.cc:1).
-    Custom trees (path_table/path_code) are not implemented."""
-    if is_custom or path_table is not None or path_code is not None:
-        raise NotImplementedError("hsigmoid: custom trees not implemented")
+    """Hierarchical sigmoid (reference: layers/nn.py hsigmoid,
+    hierarchical_sigmoid_op.cc:1).  Default complete binary tree, or a
+    CUSTOM tree via path_table/path_code Variables ([b, L] row-ids into W
+    with negative padding / 0-1 branch codes — matrix_bit_code.h
+    CustomCode semantics).  With a custom tree, num_classes is the number
+    of non-leaf nodes + 1 (W has num_classes - 1 rows), per the
+    reference API."""
+    if is_custom and (path_table is None or path_code is None):
+        raise ValueError("hsigmoid: is_custom needs path_table + path_code")
     helper = LayerHelper("hsigmoid", name=name, param_attr=param_attr,
                          bias_attr=bias_attr)
     dim = input.shape[-1]
@@ -889,6 +893,9 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
         attr=helper.param_attr(), shape=[num_classes - 1, dim],
         dtype=input.dtype)
     inputs = {"X": [input], "Label": [label], "W": [w]}
+    if path_table is not None:
+        inputs["PathTable"] = [path_table]
+        inputs["PathCode"] = [path_code]
     if helper.bias_attr() is not False:
         b = helper.create_parameter(
             attr=helper.bias_attr(), shape=[num_classes - 1],
@@ -1525,6 +1532,64 @@ def max_pool2d_with_index(input, pool_size, pool_stride=None, pool_padding=0,
                "paddings": _pair(pool_padding)},
     )
     return out, mask
+
+
+def max_pool3d_with_index(input, pool_size, pool_stride=None, pool_padding=0,
+                          global_pooling=False, name=None):
+    """3-D max pool returning (out, flat argmax indices into each [D,H,W]
+    map) — reference pool_with_index_op.cc MaxPool3dWithIndex."""
+    helper = LayerHelper("max_pool3d_with_index", name=name)
+
+    def _triple(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v, v]
+
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference("int32")
+    ks = _triple(pool_size)
+    helper.append_op(
+        "max_pool3d_with_index",
+        inputs={"X": [input]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={"ksize": ks,
+               "strides": _triple(pool_stride) if pool_stride else ks,
+               "paddings": _triple(pool_padding),
+               "global_pooling": global_pooling},
+    )
+    return out, mask
+
+
+def spp(input, pyramid_height=1, pool_type="max", name=None):
+    """Spatial pyramid pooling over NCHW input (reference spp_op.cc;
+    layer parity with nets-style SPPLayer): concat of 2^l x 2^l adaptive
+    poolings for l < pyramid_height -> [N, C * sum(4^l)]."""
+    helper = LayerHelper("spp", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "spp",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"pyramid_height": pyramid_height, "pooling_type": pool_type},
+    )
+    n, c = input.shape[0], input.shape[1]
+    out.shape = (n, c * sum(4 ** l for l in range(pyramid_height)))
+    return out
+
+
+def positive_negative_pair(score, label, qid, name=None):
+    """Ranking-pair metric (reference positive_negative_pair_op.cc +
+    metric_op.py): returns (positive, negative, neutral) pair counts over
+    intra-query item pairs."""
+    helper = LayerHelper("positive_negative_pair", name=name)
+    pos = helper.create_variable_for_type_inference("float32")
+    neg = helper.create_variable_for_type_inference("float32")
+    neu = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "positive_negative_pair",
+        inputs={"Score": [score], "Label": [label], "QueryID": [qid]},
+        outputs={"PositivePair": [pos], "NegativePair": [neg],
+                 "NeutralPair": [neu]},
+    )
+    return pos, neg, neu
 
 
 def py_func(func, x, out_shapes, out_dtypes, name=None):

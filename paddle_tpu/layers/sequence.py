@@ -493,3 +493,24 @@ def sequence_enumerate(input, win_size, pad_value=0, length=None, name=None):
     if input.shape:
         out.shape = tuple(input.shape[:2]) + (win_size,)
     return out
+
+
+def lod_reset(x, y=None, target_lod=None, name=None):
+    """Re-segment a padded batch (reference layers/nn.py:6030 lod_reset,
+    lod_reset_op.cc).  Returns (out, length): the data unchanged plus the
+    NEW per-sequence lengths — from `y` (offsets [n+1] or lengths [n]
+    tensor) or the static `target_lod` offsets list."""
+    helper = LayerHelper("lod_reset", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int64")
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    helper.append_op(
+        "lod_reset",
+        inputs=inputs,
+        outputs={"Out": [out], "Length": [length]},
+        attrs={"target_lod": list(target_lod) if target_lod else []},
+    )
+    out.shape = x.shape
+    return out, length

@@ -266,3 +266,33 @@ def lower_precision_recall(ctx, ins):
         "AccumMetrics": [metrics(accum_states)],
         "AccumStatesInfo": [accum_states],
     }
+
+
+@register("positive_negative_pair", no_grad=True)
+def lower_positive_negative_pair(ctx, ins):
+    """Ranking-pair metric (reference positive_negative_pair_op.cc): over
+    all intra-query item pairs with different labels, count pairs ranked
+    correctly (higher label got higher score), incorrectly, and tied.
+    Inputs: Score [N,1] f32, Label [N,1], QueryID [N,1] int; optional
+    Accumulate{Positive,Negative,Neutral}Pair carry totals across batches.
+    O(N²) pairwise on device — N is a batch, fine for a metric."""
+    jnp = _jnp()
+    score = ins["Score"][0].reshape(-1).astype(jnp.float32)
+    label = ins["Label"][0].reshape(-1).astype(jnp.float32)
+    qid = ins["QueryID"][0].reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    higher_label = label[:, None] > label[None, :]   # ordered pairs (i, j)
+    pair = same_q & higher_label
+    sdiff = score[:, None] - score[None, :]
+    pos = jnp.sum((pair & (sdiff > 0)).astype(jnp.float32))
+    neg = jnp.sum((pair & (sdiff < 0)).astype(jnp.float32))
+    neu = jnp.sum((pair & (sdiff == 0)).astype(jnp.float32))
+    if ins.get("AccumulatePositivePair"):
+        pos = pos + ins["AccumulatePositivePair"][0].reshape(())
+        neg = neg + ins["AccumulateNegativePair"][0].reshape(())
+        neu = neu + ins["AccumulateNeutralPair"][0].reshape(())
+    return {
+        "PositivePair": [pos.reshape((1,))],
+        "NegativePair": [neg.reshape((1,))],
+        "NeutralPair": [neu.reshape((1,))],
+    }

@@ -615,3 +615,47 @@ def lower_py_func(ctx, ins):
 
     outs = jax.pure_callback(host_fn, tuple(specs), *xs, vmap_method="sequential")
     return {"Out": list(outs)}
+
+
+@register("print", no_grad=False, infer_shape=_same_infer("Out", "In"))
+def lower_print(ctx, ins):
+    """Debug Print (reference print_op.cc + layers.Print): logs tensor
+    stats at run time and passes the value through unchanged.  Under jit
+    the log rides a jax.debug.callback (the TPU-native analogue of the
+    reference's CPU-side TensorFormatter); gradients pass through
+    (reference forwards grads when print_phase allows).  first_n limits
+    the prints via a host-side counter; summarize>0 prints that many
+    leading elements."""
+    import jax
+
+    x = ins["In"][0]
+    msg = ctx.attr("message", "") or ""
+    summarize = ctx.attr("summarize", -1)
+    first_n = ctx.attr("first_n", -1)
+    if ctx.attr("print_tensor_name", True):
+        name = ctx.op.input("In")[0] if ctx.op is not None else "var"
+        msg = f"{msg} {name}" if msg else name
+    shape = tuple(x.shape)
+    n_head = x.size if summarize < 0 else min(summarize, x.size)
+    counter = {"n": 0}
+
+    def _emit(mean, lo, hi, head):
+        if first_n >= 0 and counter["n"] >= first_n:
+            return
+        counter["n"] += 1
+        # msg is plain text, never a format string (user braces are safe)
+        print(f"{msg} shape={shape} mean={mean} min={lo} max={hi} "
+              f"first={head}", flush=True)
+
+    if summarize == 0:
+        def _emit0():
+            if first_n >= 0 and counter["n"] >= first_n:
+                return
+            counter["n"] += 1
+            print(f"{msg} shape={shape}", flush=True)
+
+        jax.debug.callback(lambda _: _emit0(), x.reshape(-1)[0])
+    else:
+        jax.debug.callback(_emit, x.mean(), x.min(), x.max(),
+                           x.reshape(-1)[:max(1, n_head)])
+    return {"Out": [x]}

@@ -537,3 +537,33 @@ def lower_sequence_enumerate(ctx, ins):
     gathered = x[:, jnp.minimum(idx, t - 1)]                  # [B, T, W]
     return {"Out": [jnp.where(valid, gathered,
                               jnp.asarray(pad, x.dtype))]}
+
+
+@register("lod_reset", no_grad=False)
+def lower_lod_reset(ctx, ins):
+    """Re-segment a batch (reference lod_reset_op.cc: replace X's LoD with
+    a target, keeping the data).  TPU-first mapping of LoD: data is padded
+    dense + a Length vector, so the op passes the data through and emits
+    the NEW per-sequence lengths — from input Y (a lengths tensor or a
+    [n+1] offsets tensor, dtype int) or the static `target_lod` attr
+    (reference convention: offsets)."""
+    jnp = _jnp()
+    x = ins["X"][0]
+    if ins.get("Y"):
+        y = ins["Y"][0].reshape(-1)
+        # a [batch+1] vector is an offsets table (the reference feeds
+        # offsets); a [batch] vector is already per-sequence lengths
+        if y.shape[0] == x.shape[0] + 1:
+            length = y[1:] - y[:-1]
+        else:
+            length = y
+        return {"Out": [x], "Length": [length.astype(jnp.int64)]}
+    lod = ctx.attr("target_lod", None)
+    if not lod:
+        return {"Out": [x], "Length": [jnp.full((x.shape[0],), x.shape[1]
+                                                if x.ndim > 1 else 1,
+                                                jnp.int64)]}
+    import numpy as _np
+
+    off = _np.asarray(lod, _np.int64)
+    return {"Out": [x], "Length": [jnp.asarray(off[1:] - off[:-1])]}
