@@ -254,6 +254,62 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
     return tps, flops_tok, last_loss
 
 
+def bench_ringattn(seq_len=8192, n_head=8, d_head=64, iters=8, warmup=2):
+    """Long-context attention kernel line (VERDICT r4 item 3): fwd+bwd
+    tokens/sec of the Pallas flash path vs the unfused reference at 8k+
+    sequence on one chip.  vs_baseline = flash/reference speedup — the
+    single-device leg of the long-context capability (the multi-device leg,
+    ring CP over a mesh, is exercised by tests/test_ring_attention.py and
+    dryrun_multichip's sp axis; one tunneled chip can't run a real ring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.attention import (
+        flash_attention,
+        reference_attention,
+    )
+
+    rng = np.random.RandomState(0)
+    shape = (1, n_head, seq_len, d_head)
+    q = jnp.asarray(rng.randn(*shape).astype("float32")).astype(jnp.bfloat16)
+    k = jnp.asarray(rng.randn(*shape).astype("float32")).astype(jnp.bfloat16)
+    v = jnp.asarray(rng.randn(*shape).astype("float32")).astype(jnp.bfloat16)
+    scale = 1.0 / np.sqrt(d_head)
+
+    def make(fn):
+        def loss(q, k, v):
+            o = fn(q, k, v, None, scale=scale, causal=True)
+            return jnp.sum(o.astype(jnp.float32) * 1e-3)
+        return jax.jit(jax.grad(loss, (0, 1, 2)))
+
+    def time_one(g):
+        r = g(q, k, v)
+        np.asarray(jax.tree_util.tree_leaves(r)[0][0, 0, 0])  # sync
+        for _ in range(warmup):
+            r = g(q, k, v)
+        np.asarray(jax.tree_util.tree_leaves(r)[0][0, 0, 0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = g(q, k, v)
+        np.asarray(jax.tree_util.tree_leaves(r)[0][0, 0, 0])
+        return (time.perf_counter() - t0) / iters
+
+    t_flash = time_one(make(flash_attention))
+    t_ref = time_one(make(reference_attention))
+    tps = seq_len / t_flash
+    return tps, t_ref / t_flash, t_flash, t_ref
+
+
+def run_ringattn(args, peak):
+    seq = 1024 if args.smoke else 8192
+    tps, speedup, t_flash, t_ref = bench_ringattn(seq_len=seq)
+    emit_metric("flash_attention_longseq_fwd_bwd_tokens_per_sec", tps,
+                "tokens/sec", speedup, None, 0.0,
+                {"seq_len": seq, "n_head": 8, "d_head": 64, "causal": True,
+                 "bf16": True, "flash_ms": round(t_flash * 1e3, 2),
+                 "reference_ms": round(t_ref * 1e3, 2)})
+
+
 def bert_train_flops_per_token(n_layer, d_model, d_ff, seq_len, vocab):
     """Analytic matmul FLOPs per token, encoder-only + MLM head (2 FLOPs
     per MAC, train = 3x fwd)."""
@@ -444,7 +500,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="all",
                    choices=["all", "resnet50", "transformer", "bert",
-                            "deepfm", "mnist"])
+                            "deepfm", "mnist", "ringattn"])
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for a fast correctness pass")
     p.add_argument("--no-amp", dest="amp", action="store_false")
@@ -471,6 +527,8 @@ def main():
         ran.append(run_guarded("mnist", run_mnist, args, peak))
     if args.model in ("all", "deepfm"):
         ran.append(run_guarded("deepfm", run_deepfm, args, peak))
+    if args.model in ("all", "ringattn"):
+        ran.append(run_guarded("ringattn", run_ringattn, args, peak))
     if args.model in ("all", "bert"):
         ran.append(run_guarded("bert", run_bert, args, peak))
     if args.model in ("all", "transformer"):

@@ -68,3 +68,140 @@ def test_ring_attention_grads_flow():
     g = jax.grad(loss)(q)
     assert np.isfinite(np.asarray(g)).all()
     assert float(jnp.abs(g).max()) > 0
+
+
+def _mesh(n):
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:n]), axis_names=("sp",))
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ring_attention_grads_match_reference(causal):
+    """dq/dk/dv of the custom-VJP ring (flash kernels inside, K/V re-rung
+    in backward) vs jax.grad of the single-device reference — d=64 so the
+    Pallas kernel path (interpret mode on CPU) actually engages."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.attention import reference_attention
+    from paddle_tpu.kernels.ring_attention import ring_attention_sharded
+
+    mesh = _mesh(8)
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(1, 2, 256, 64).astype("float32"))
+    k = jnp.asarray(rng.randn(1, 2, 256, 64).astype("float32"))
+    v = jnp.asarray(rng.randn(1, 2, 256, 64).astype("float32"))
+    scale = 0.125
+
+    def loss_ring(q, k, v):
+        o = ring_attention_sharded(q, k, v, mesh, "sp", scale=scale,
+                                   causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = reference_attention(q, k, v, None, scale=scale, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    with jax.default_matmul_precision("highest"):
+        gr = jax.grad(loss_ring, (0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        assert np.all(np.isfinite(np.asarray(a)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-4, rtol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ring_attention_uneven_sequence(causal):
+    """T=250 does not divide the 8-device axis: the sharded entry pads,
+    masks pad keys via the ring-traveling key bias, and slices — output
+    and grads must match the unpadded reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.attention import reference_attention
+    from paddle_tpu.kernels.ring_attention import ring_attention_sharded
+
+    mesh = _mesh(8)
+    rng = np.random.RandomState(3)
+    t = 250
+    q = jnp.asarray(rng.randn(1, 2, t, 64).astype("float32"))
+    k = jnp.asarray(rng.randn(1, 2, t, 64).astype("float32"))
+    v = jnp.asarray(rng.randn(1, 2, t, 64).astype("float32"))
+    scale = 0.125
+
+    with jax.default_matmul_precision("highest"):
+        ref = reference_attention(q, k, v, None, scale=scale, causal=causal)
+        out = ring_attention_sharded(q, k, v, mesh, "sp", scale=scale,
+                                     causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=2e-4)
+
+        def loss_ring(q):
+            o = ring_attention_sharded(q, k, v, mesh, "sp", scale=scale,
+                                       causal=causal)
+            return jnp.sum(o * jnp.cos(o))
+
+        def loss_ref(q):
+            o = reference_attention(q, k, v, None, scale=scale,
+                                    causal=causal)
+            return jnp.sum(o * jnp.cos(o))
+
+        gr = jax.grad(loss_ring)(q)
+        gf = jax.grad(loss_ref)(q)
+    np.testing.assert_allclose(np.asarray(gr), np.asarray(gf), atol=3e-4,
+                               rtol=2e-3)
+
+
+def test_ring_attention_memory_scales():
+    """The long-context claim (SURVEY §5.7): per-device temp memory of the
+    compiled ring is far below the reference attention's O(T²) score
+    matrix at the same total sequence — the compiled-program memory
+    analysis is the per-device peak the runtime would need, i.e. the proof
+    that contexts beyond one device's memory fit."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.attention import reference_attention
+    from paddle_tpu.kernels.ring_attention import ring_attention_sharded
+
+    mesh = _mesh(8)
+    b, h, t, d = 1, 4, 4096, 64
+    scale = 0.125
+    q = jax.ShapeDtypeStruct((b, h, t, d), jnp.float32)
+
+    def ring(q, k, v):
+        return ring_attention_sharded(q, k, v, mesh, "sp", scale=scale)
+
+    def ref(q, k, v):
+        return reference_attention(q, k, v, None, scale=scale)
+
+    mem_ring = jax.jit(ring).lower(q, q, q).compile().memory_analysis()
+    mem_ref = jax.jit(ref).lower(q, q, q).compile().memory_analysis()
+    # reference materializes [b,h,T,T] f32 scores ≈ 256 MB at these shapes;
+    # the ring's per-device temps stay orders of magnitude below
+    assert mem_ref.temp_size_in_bytes > 8 * mem_ring.temp_size_in_bytes, (
+        mem_ref.temp_size_in_bytes, mem_ring.temp_size_in_bytes)
+
+
+def test_ring_attention_causal_skips_future_chunks():
+    """The causal ring must place its chunk compute under lax.cond so
+    fully-masked (future) chunks skip — check the lowered HLO contains
+    conditionals, and results stay exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.ring_attention import ring_attention_sharded
+
+    mesh = _mesh(4)
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 1, 64, 64).astype("float32"))
+
+    def f(q):
+        return ring_attention_sharded(q, q, q, mesh, "sp", scale=0.125,
+                                      causal=True)
+
+    hlo = jax.jit(f).lower(q).as_text()
+    assert "cond" in hlo or "conditional" in hlo
