@@ -19,6 +19,7 @@ params-frozen-to-device behavior.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -133,6 +134,80 @@ def inference_transpile(program: fw.Program, scope: Scope) -> int:
     return folded
 
 
+AOT_DIRNAME = "__aot__"
+
+
+def _feed_signature(feed_names, feed):
+    return tuple(
+        (n, tuple(np.asarray(feed[n]).shape), str(np.asarray(feed[n]).dtype))
+        for n in feed_names
+    )
+
+
+def export_aot_bundle(dirname, feed_examples, place=None) -> int:
+    """Serialize AOT-compiled executables for the saved model at `dirname`
+    (reference gap: the C++ predictor serves without the framework in the
+    loop, api/paddle_api.h:153 — the TPU-native analogue is an XLA
+    executable serialized NEXT TO the save_inference_model artifact, so a
+    serving process loads and runs it with NO program re-trace).
+
+    feed_examples: list of feed dicts (one per signature to pre-compile).
+    Writes `<dirname>/__aot__/sig_<i>.bin` bundles; returns how many were
+    exported.  Loading falls back to the normal retrace path when a bundle
+    does not match the runtime (jax/platform change) — see Predictor."""
+    import pickle
+
+    import jax
+    from jax.experimental import serialize_executable as se
+
+    pred = Predictor(dirname, place=place, optimize=False, use_aot=False)
+    exe, scope, program = pred._exe, pred._scope, pred._program
+    out_dir = os.path.join(dirname, AOT_DIRNAME)
+    os.makedirs(out_dir, exist_ok=True)
+    n_ok = 0
+    for i, feed in enumerate(feed_examples):
+        # prime the executor cache (compiles exactly this signature); the
+        # cache is cleared first so the single surviving entry IS this
+        # signature's (a repeat signature would otherwise hit an older
+        # entry and [-1] would grab the wrong executable)
+        exe._cache.clear()
+        exe.run(program, feed=feed, fetch_list=pred._fetch_names,
+                scope=scope)
+        entry = list(exe._cache.values())[-1]
+        feed_names = sorted(feed)
+        feed_vals = [exe._to_device_array(program, n, feed[n])
+                     for n in feed_names]
+        rw_vals = [scope.find_var(n) for n in entry.rw_state]
+        ro_vals = [scope.find_var(n) for n in entry.ro_state]
+        args = (feed_vals, rw_vals, ro_vals)
+        if entry.needs_key:
+            from .core.executor import prng_key
+
+            args = args + (jax.random.fold_in(
+                prng_key(program.random_seed or 0), 0),)
+        payload, in_tree, out_tree = se.serialize(
+            entry.fn.lower(*args).compile())
+        bundle = {
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+            "signature": _feed_signature(feed_names, feed),
+            "feed_names": feed_names,
+            "rw_state": entry.rw_state,
+            "ro_state": entry.ro_state,
+            "state_writes": entry.state_writes,
+            "needs_key": entry.needs_key,
+            "fetch_names": pred._fetch_names,
+            "platform": jax.default_backend(),
+            "n_devices": 1,  # Predictor executables are single-device
+            "jax_version": jax.__version__,
+        }
+        with open(os.path.join(out_dir, f"sig_{i}.bin"), "wb") as f:
+            pickle.dump(bundle, f)
+        n_ok += 1
+    return n_ok
+
+
 class Predictor:
     """Load-once, serve-many inference API (reference: PaddlePredictor
     api/paddle_api.h:153 + NativePaddlePredictor api_impl.h:34).
@@ -142,7 +217,14 @@ class Predictor:
 
     Each distinct feed signature (shapes/dtypes) compiles exactly once;
     `pred.compile_count` exposes the executable-cache size for tests.
-    """
+
+    If the artifact carries an AOT bundle (save_inference_model
+    aot_feed_examples / export_aot_bundle), matching-signature calls serve
+    straight from the DESERIALIZED XLA EXECUTABLE — the program is never
+    re-traced, the reference's no-framework-in-the-loop serving property.
+    A bundle that fails to load (different platform / incompatible jax)
+    falls back to the retrace path; `pred.aot_signatures` lists live
+    bundles."""
 
     def __init__(
         self,
@@ -151,6 +233,7 @@ class Predictor:
         optimize: bool = True,
         model_filename: Optional[str] = None,
         params_filename: Optional[str] = None,
+        use_aot: bool = True,
     ):
         self._scope = Scope()
         self._exe = Executor(place or CPUPlace())
@@ -162,9 +245,46 @@ class Predictor:
             )
         )
         self._fetch_names = [v.name for v in self._fetch_vars]
+        self._aot: Dict[tuple, dict] = {}
+        if use_aot:
+            self._load_aot_bundles(dirname)
         self.folded_ops = 0
-        if optimize:
+        # BN-folding mutates the SAME scope params the AOT executables were
+        # compiled against (they bake the unfolded program in) — folding
+        # under live bundles would silently corrupt AOT results.  XLA fuses
+        # inference BN anyway, so the fold is skipped when bundles loaded.
+        if optimize and not self._aot:
             self.folded_ops = inference_transpile(self._program, self._scope)
+
+    def _load_aot_bundles(self, dirname):
+        import glob
+        import pickle
+
+        import jax
+        from jax.experimental import serialize_executable as se
+
+        for path in sorted(
+                glob.glob(os.path.join(dirname, AOT_DIRNAME, "sig_*.bin"))):
+            try:
+                with open(path, "rb") as f:
+                    bundle = pickle.load(f)
+                if bundle["platform"] != jax.default_backend():
+                    raise RuntimeError(
+                        f"bundle platform {bundle['platform']} != runtime "
+                        f"{jax.default_backend()}")
+                loaded = se.deserialize_and_load(
+                    bundle["payload"], bundle["in_tree"],
+                    bundle["out_tree"],
+                    execution_devices=jax.devices()[:bundle.get(
+                        "n_devices", 1)])
+                bundle["loaded"] = loaded
+                self._aot[tuple(bundle["signature"])] = bundle
+            except Exception as e:  # noqa: BLE001 — any mismatch: retrace
+                from .log import vlog
+
+                vlog(1, f"Predictor: AOT bundle {path} unusable "
+                        f"({type(e).__name__}: {e}); falling back to "
+                        "retrace")
 
     @property
     def feed_names(self) -> List[str]:
@@ -182,11 +302,45 @@ class Predictor:
     def compile_count(self) -> int:
         return len(self._exe._cache)
 
+    @property
+    def aot_signatures(self):
+        return list(self._aot)
+
+    def _run_aot(self, bundle, feed, return_numpy):
+        import jax
+
+        feed_names = bundle["feed_names"]
+        feed_vals = [self._exe._to_device_array(self._program, n, feed[n])
+                     for n in feed_names]
+        rw_vals = [self._scope.find_var(n) for n in bundle["rw_state"]]
+        ro_vals = [self._scope.find_var(n) for n in bundle["ro_state"]]
+        args = (feed_vals, rw_vals, ro_vals)
+        if bundle["needs_key"]:
+            from .core.executor import prng_key
+
+            self._exe._run_counter += 1
+            args = args + (jax.random.fold_in(
+                prng_key(self._program.random_seed or 0),
+                self._exe._run_counter),)
+        fetches, new_state = bundle["loaded"](*args)
+        for n, v in zip(bundle["state_writes"], new_state):
+            self._scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
     def run(self, feed: Dict[str, np.ndarray], return_numpy: bool = True):
-        """Serve one batch; compiles on first call per feed signature."""
+        """Serve one batch; a matching AOT bundle serves without any trace,
+        otherwise compiles on first call per feed signature."""
         missing = [n for n in self._feed_names if n not in feed]
         if missing:
             raise KeyError(f"Predictor.run: missing feeds {missing}")
+        if self._aot:
+            feed = {n: feed[n] for n in self._feed_names}
+            sig = _feed_signature(sorted(feed), feed)
+            bundle = self._aot.get(sig)
+            if bundle is not None:
+                return self._run_aot(bundle, feed, return_numpy)
         return self._exe.run(
             self._program,
             feed={n: feed[n] for n in self._feed_names},

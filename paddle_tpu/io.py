@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -171,7 +171,15 @@ def save_inference_model(
     model_filename: Optional[str] = None,
     params_filename: Optional[str] = None,
     scope: Optional[Scope] = None,
+    aot_feed_examples: Optional[List[Dict]] = None,
 ):
+    """Save a pruned test-mode program + params (reference io.py:570).
+
+    aot_feed_examples: optional list of feed dicts; for each, an
+    AOT-COMPILED XLA EXECUTABLE is serialized next to the artifact
+    (`<dirname>/__aot__/`) so a serving process (Predictor) can run that
+    feed signature with NO re-trace — the TPU-native analogue of the
+    reference's out-of-Python C++ serving (api/paddle_api.h:153)."""
     main_program = main_program or fw.default_main_program()
     scope = scope or global_scope()
     os.makedirs(dirname, exist_ok=True)
@@ -191,6 +199,11 @@ def save_inference_model(
         executor, dirname, pruned, vars=persist,
         filename=params_filename or "__params__", scope=scope,
     )
+    if aot_feed_examples:
+        from .inference import export_aot_bundle
+
+        export_aot_bundle(dirname, aot_feed_examples,
+                          place=getattr(executor, "place", None))
     return target_names
 
 

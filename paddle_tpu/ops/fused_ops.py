@@ -83,8 +83,10 @@ def lower_ring_attention(ctx, ins):
     Lowers to shard_map(ring) when the executor's mesh has the `axis_name`
     axis; otherwise (single-device trace, tests, dryrun without an sp axis)
     falls back to the numerically-identical reference attention.  Supports
-    causal masking; additive bias is not supported on the ring path (pad-
-    free batches or pure-causal decoders)."""
+    causal masking and sequence lengths that do not divide the axis (the
+    sharded entry pads and masks via the ring-traveling key bias);
+    additive bias is not supported on the ring path (pad-free batches or
+    pure-causal decoders)."""
     from ..kernels.attention import reference_attention
     from ..kernels.ring_attention import ring_attention_sharded
 
@@ -96,7 +98,6 @@ def lower_ring_attention(ctx, ins):
     if (
         mesh is None
         or axis_name not in getattr(mesh, "axis_names", ())
-        or q.shape[2] % mesh.shape[axis_name] != 0
     ):
         out = reference_attention(q, k, v, None, scale=scale, causal=causal)
     else:
