@@ -27,6 +27,7 @@ __all__ = [
     "detection_map",
     "yolov3_loss",
     "generate_proposals",
+    "generate_proposal_labels",
     "rpn_target_assign",
     "polygon_box_transform",
     "roi_perspective_transform",
@@ -158,6 +159,9 @@ def _roi(op_type, input, rois, pooled_height, pooled_width, spatial_scale,
             **extra_attrs,
         },
     )
+    if rois.shape and input.shape:
+        out.shape = (rois.shape[0], input.shape[1], pooled_height,
+                     pooled_width)
     return out
 
 
@@ -643,3 +647,59 @@ def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
                "pooled_width": pooled_width},
     )
     return out
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=False, name=None):
+    """Second-stage RoI sampling + targets (reference layers/detection.py
+    generate_proposal_labels, generate_proposal_labels_op.cc:1).  Dense:
+    returns (rois [N, B, 4], labels_int32 [N, B, 1], bbox_targets
+    [N, B, 4*C], bbox_inside_weights, bbox_outside_weights, rois_valid
+    [N, B, 1]) with B = batch_size_per_im; unfilled rows carry label -1,
+    zero weights, rois_valid 0."""
+    if use_random:
+        raise NotImplementedError(
+            "generate_proposal_labels: use_random sampling is not "
+            "supported under jit; sampling is deterministic (top-IoU fg, "
+            "first bg)")
+    helper = LayerHelper("generate_proposal_labels", name=name)
+    outs = {
+        "Rois": helper.create_variable_for_type_inference("float32"),
+        "LabelsInt32": helper.create_variable_for_type_inference("int32"),
+        "BboxTargets": helper.create_variable_for_type_inference("float32"),
+        "BboxInsideWeights":
+            helper.create_variable_for_type_inference("float32"),
+        "BboxOutsideWeights":
+            helper.create_variable_for_type_inference("float32"),
+        "RoisValid": helper.create_variable_for_type_inference("float32"),
+    }
+    helper.append_op(
+        "generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtBoxes": [gt_boxes],
+                "ImInfo": [im_info]},
+        outputs={k: [v] for k, v in outs.items()},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums},
+    )
+    nb = rpn_rois.shape[0] if rpn_rois.shape else -1
+    b = batch_size_per_im
+    c4 = 4 * class_nums if class_nums else -1
+    outs["Rois"].shape = (nb, b, 4)
+    outs["LabelsInt32"].shape = (nb, b, 1)
+    outs["BboxTargets"].shape = (nb, b, c4)
+    outs["BboxInsideWeights"].shape = (nb, b, c4)
+    outs["BboxOutsideWeights"].shape = (nb, b, c4)
+    outs["RoisValid"].shape = (nb, b, 1)
+    for v in outs.values():
+        v.stop_gradient = True
+    return (outs["Rois"], outs["LabelsInt32"], outs["BboxTargets"],
+            outs["BboxInsideWeights"], outs["BboxOutsideWeights"],
+            outs["RoisValid"])

@@ -435,3 +435,136 @@ def lower_psroi_pool(ctx, ins):
 
     out = jax.vmap(one)(rois, bidx)
     return {"Out": [out]}
+
+
+@register("generate_proposal_labels", no_grad=True)
+def lower_generate_proposal_labels(ctx, ins):
+    """Second-stage RoI sampling + target assignment (reference
+    detection/generate_proposal_labels_op.cc:1 SampleRoisForOneImage):
+    concat gt boxes with RPN proposals, IoU-match against gt, sample
+    foreground (IoU > fg_thresh) and background (bg_lo <= IoU < bg_hi)
+    rois to batch_size_per_im with at most fg_fraction foreground, and
+    emit per-roi class labels + per-class encoded bbox regression targets
+    with inside/outside weights.
+
+    TPU-first dense idiom (static shapes, like rpn_target_assign): inputs
+    are batched [N, R, 4] proposals + [N, G, ...] padded gts (a gt row of
+    all zeros is padding); outputs are [N, B, ...] with exactly
+    B = batch_size_per_im rows per image — unfilled rows carry label -1
+    and zero weights (the reference emits variable row counts via LoD).
+    Sampling is deterministic under jit: top-IoU foregrounds, first-index
+    backgrounds (the reference's use_random reservoir is host-side
+    state).
+    """
+    import jax
+
+    jnp = _jnp()
+    from .detection_ops import _center_size, _iou_matrix
+
+    rois_in = ins["RpnRois"][0]                 # [N, R, 4]
+    gt_classes = ins["GtClasses"][0]            # [N, G]
+    gt_boxes = ins["GtBoxes"][0]                # [N, G, 4]
+    is_crowd = ins.get("IsCrowd", [None])[0]    # [N, G]
+    im_info = ins.get("ImInfo", [None])[0]      # [N, 3]
+    bs = ctx.attr("batch_size_per_im", 256)
+    fg_frac = ctx.attr("fg_fraction", 0.25)
+    fg_th = ctx.attr("fg_thresh", 0.5)
+    bg_hi = ctx.attr("bg_thresh_hi", 0.5)
+    bg_lo = ctx.attr("bg_thresh_lo", 0.0)
+    reg_w = ctx.attr("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    class_nums = ctx.attr("class_nums", 81)
+    n, g = gt_boxes.shape[0], gt_boxes.shape[1]
+    fg_quota = int(bs * fg_frac)
+
+    def one(rois_i, gtc_i, gtb_i, crowd_i, info_i):
+        scale = 1.0 if info_i is None else info_i[2]
+        rois_i = rois_i / scale
+        boxes = jnp.concatenate([gtb_i, rois_i], axis=0)     # [P, 4]
+        p = boxes.shape[0]
+        valid_gt = jnp.any(jnp.abs(gtb_i) >= 1e-6, axis=1)   # [G]
+        iou = _iou_matrix(boxes, gtb_i, True)                # [P, G]
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        max_ov = jnp.max(iou, axis=1)
+        gt_assign = jnp.argmax(iou, axis=1)                  # [P]
+        # crowd/padded gt rows of the concat never sample (reference sets
+        # their max_overlap to -1)
+        head_bad = ~valid_gt
+        if crowd_i is not None:
+            head_bad |= crowd_i.reshape(-1) > 0.5
+        bad = jnp.concatenate([head_bad, jnp.zeros((p - g,), bool)])
+        max_ov = jnp.where(bad, -1.0, max_ov)
+
+        fg = max_ov > fg_th
+        bg = (max_ov >= bg_lo) & (max_ov < bg_hi) & ~fg
+        # deterministic subsample: top-IoU fg, first-index bg
+        fg_rank = jnp.argsort(jnp.argsort(-jnp.where(fg, max_ov, -2.0)))
+        fg_keep = fg & (fg_rank < fg_quota)
+        n_fg = jnp.sum(fg_keep.astype(jnp.int32))
+        bg_rank = jnp.cumsum(bg.astype(jnp.int32)) - 1
+        bg_keep = bg & (bg_rank < (bs - n_fg))
+        # order rows: kept fg (by IoU rank), then kept bg, then invalid
+        prio = jnp.where(fg_keep, fg_rank,
+                         jnp.where(bg_keep, fg_quota + bg_rank,
+                                   2 * (p + bs)))
+        order = jnp.argsort(prio)[:bs]                        # [min(P,B)]
+        row_fg = jnp.take(fg_keep, order)
+        row_valid = jnp.take(fg_keep | bg_keep, order)
+        if p < bs:
+            # fewer candidates than the per-image quota: pad with invalid
+            pad = bs - p
+            order = jnp.concatenate([order, jnp.zeros((pad,), order.dtype)])
+            row_fg = jnp.concatenate([row_fg, jnp.zeros((pad,), bool)])
+            row_valid = jnp.concatenate([row_valid,
+                                         jnp.zeros((pad,), bool)])
+
+        sel_boxes = jnp.take(boxes, order, axis=0)            # [B, 4]
+        sel_gt = jnp.take(gt_assign, order)
+        labels = jnp.where(
+            row_fg, jnp.take(gtc_i.reshape(-1).astype(jnp.int32), sel_gt),
+            jnp.where(row_valid, 0, -1)).astype(jnp.int32)
+
+        # encoded deltas vs matched gt (reference bbox_util.h BoxToDelta,
+        # normalized by bbox_reg_weights), only meaningful on fg rows
+        mg = jnp.take(gtb_i, sel_gt, axis=0)                  # [B, 4]
+        acx, acy, aw, ah = _center_size(sel_boxes, 1.0)
+        gcx, gcy, gw, gh = _center_size(mg, 1.0)
+        aw = jnp.maximum(aw, 1e-6)
+        ah = jnp.maximum(ah, 1e-6)
+        gw = jnp.maximum(gw, 1e-6)
+        gh = jnp.maximum(gh, 1e-6)
+        w = jnp.asarray(reg_w, jnp.float32)
+        tgt = jnp.stack([
+            (gcx - acx) / aw / w[0], (gcy - acy) / ah / w[1],
+            jnp.log(gw / aw) / w[2], jnp.log(gh / ah) / w[3]], axis=1)
+        tgt = jnp.where(row_fg[:, None], tgt, 0.0)            # [B, 4]
+
+        # expand to per-class columns: 4 cols at class label for fg rows
+        cls = jnp.clip(labels, 0, class_nums - 1)
+        onehot = (jax.nn.one_hot(cls, class_nums)
+                  * row_fg[:, None].astype(jnp.float32))      # [B, C]
+        targets = (onehot[:, :, None] * tgt[:, None, :]).reshape(
+            bs, 4 * class_nums)
+        inside = jnp.repeat(onehot, 4, axis=1).reshape(bs, 4 * class_nums)
+        rois_out = sel_boxes * scale
+        return (rois_out, labels[:, None], targets, inside, inside,
+                row_valid[:, None].astype(jnp.float32))
+
+    crowd = (None if is_crowd is None
+             else is_crowd.reshape(n, -1).astype(jnp.float32))
+    info = im_info
+
+    def dispatch(i):
+        return one(rois_in[i], gt_classes[i], gt_boxes[i],
+                   None if crowd is None else crowd[i],
+                   None if info is None else info[i])
+
+    outs = jax.vmap(dispatch)(jnp.arange(n))
+    rois, labels, targets, inw, outw, valid = outs
+    return {
+        "Rois": [rois],
+        "LabelsInt32": [labels],
+        "BboxTargets": [targets],
+        "BboxInsideWeights": [inw],
+        "BboxOutsideWeights": [outw],
+        "RoisValid": [valid],
+    }
