@@ -99,11 +99,18 @@ def build_train_net(embedding_size=10, hash_dim=HASH_DIM, is_sparse=True,
 
 
 def make_batch(batch_size, hash_dim=HASH_DIM, rng=None):
+    """Synthetic CTR batch with a LEARNABLE click signal: the label
+    depends on the dense features (plus noise), so a training loop can
+    drive log-loss below ln 2 — the bench asserts that decrease
+    (self-validating record; random labels would pin loss at ln 2)."""
     import numpy as np
 
     rng = rng or np.random.RandomState(0)
-    feed = {"dense_input": rng.rand(batch_size, DENSE_DIM).astype("float32")}
+    dense = rng.rand(batch_size, DENSE_DIM).astype("float32")
+    feed = {"dense_input": dense}
     for i in range(SPARSE_SLOTS):
         feed[f"C{i}"] = rng.randint(0, hash_dim, (batch_size, 1)).astype("int64")
-    feed["click"] = rng.randint(0, 2, (batch_size, 1)).astype("int64")
+    logit = 4.0 * (dense[:, 0] - 0.5) + 2.0 * (dense[:, 1] - 0.5)
+    p = 1.0 / (1.0 + np.exp(-logit))
+    feed["click"] = (rng.rand(batch_size) < p).astype("int64")[:, None]
     return feed
