@@ -39,6 +39,15 @@ class ShardingPlan:
         """param_rules: [(name regex, PartitionSpec)] — first match wins.
         zero_stage >= 1 shards unmatched params' optimizer moments over the
         data axis; stage >= 2 shards the params themselves.
+
+        ZeRO stage mapping under GSPMD (deepspeed numbering): stage 1 =
+        optimizer state sharded; stages 2 and 3 COINCIDE here — once a
+        param is sharded over the data axis (stage >= 2), XLA SPMD
+        materializes its gradient reduce-scattered (classic stage 2) and
+        all-gathers the param at its use sites on the fly (classic stage
+        3); there is no separate grad/param bucketing to manage.
+        zero_stage=3 is accepted as an explicit alias and behaves
+        identically to 2 (parity-tested in tests/test_sharding.py).
         feed_rules: [(feed-name regex, PartitionSpec)] — overrides the
         default batch-over-data_axis feed sharding; use to shard the
         sequence dim for context parallelism, e.g.
