@@ -144,6 +144,22 @@ def _feed_signature(feed_names, feed):
     )
 
 
+def _aot_trees(n_feed, n_rw, n_ro, needs_key, n_fetch, n_state):
+    """Reconstruct the executable's in/out pytree structures from the
+    executor calling convention — fn((feed_list, rw_list, ro_list[, key]),
+    {}) -> (fetch_list, state_list).  Rebuilding them from counts keeps the
+    on-disk bundle pickle-free (a JSON manifest + raw XLA payload; an
+    untrusted model directory must never execute code at load time)."""
+    import jax
+
+    args = ([0] * n_feed, [0] * n_rw, [0] * n_ro)
+    if needs_key:
+        args = args + (0,)
+    in_tree = jax.tree_util.tree_structure((args, {}))
+    out_tree = jax.tree_util.tree_structure(([0] * n_fetch, [0] * n_state))
+    return in_tree, out_tree
+
+
 def export_aot_bundle(dirname, feed_examples, place=None) -> int:
     """Serialize AOT-compiled executables for the saved model at `dirname`
     (reference gap: the C++ predictor serves without the framework in the
@@ -152,10 +168,11 @@ def export_aot_bundle(dirname, feed_examples, place=None) -> int:
     serving process loads and runs it with NO program re-trace).
 
     feed_examples: list of feed dicts (one per signature to pre-compile).
-    Writes `<dirname>/__aot__/sig_<i>.bin` bundles; returns how many were
-    exported.  Loading falls back to the normal retrace path when a bundle
-    does not match the runtime (jax/platform change) — see Predictor."""
-    import pickle
+    Writes `<dirname>/__aot__/sig_<i>.json` manifests + `sig_<i>.xla`
+    payloads; returns how many were exported.  Loading falls back to the
+    normal retrace path when a bundle does not match the runtime
+    (jax/platform change) — see Predictor."""
+    import json
 
     import jax
     from jax.experimental import serialize_executable as se
@@ -187,23 +204,32 @@ def export_aot_bundle(dirname, feed_examples, place=None) -> int:
                 prng_key(program.random_seed or 0), 0),)
         payload, in_tree, out_tree = se.serialize(
             entry.fn.lower(*args).compile())
-        bundle = {
-            "payload": payload,
-            "in_tree": in_tree,
-            "out_tree": out_tree,
+        # the bundle stores only counts; verify the rebuilt trees match
+        # the real ones so a convention drift fails at EXPORT, not serve
+        want_in, want_out = _aot_trees(
+            len(feed_vals), len(entry.rw_state), len(entry.ro_state),
+            entry.needs_key, len(pred._fetch_names),
+            len(entry.state_writes))
+        if want_in != in_tree or want_out != out_tree:
+            raise RuntimeError(
+                "export_aot_bundle: executable pytree structure diverged "
+                "from the executor calling convention — update _aot_trees")
+        manifest = {
             "signature": _feed_signature(feed_names, feed),
             "feed_names": feed_names,
             "rw_state": entry.rw_state,
             "ro_state": entry.ro_state,
             "state_writes": entry.state_writes,
-            "needs_key": entry.needs_key,
+            "needs_key": bool(entry.needs_key),
             "fetch_names": pred._fetch_names,
             "platform": jax.default_backend(),
             "n_devices": 1,  # Predictor executables are single-device
             "jax_version": jax.__version__,
         }
-        with open(os.path.join(out_dir, f"sig_{i}.bin"), "wb") as f:
-            pickle.dump(bundle, f)
+        with open(os.path.join(out_dir, f"sig_{i}.json"), "w") as f:
+            json.dump(manifest, f)
+        with open(os.path.join(out_dir, f"sig_{i}.xla"), "wb") as f:
+            f.write(payload)
         n_ok += 1
     return n_ok
 
@@ -257,28 +283,40 @@ class Predictor:
             self.folded_ops = inference_transpile(self._program, self._scope)
 
     def _load_aot_bundles(self, dirname):
+        """Pickle-free load: JSON manifest + raw XLA payload; the in/out
+        pytrees rebuild from the manifest counts (_aot_trees), so loading
+        an untrusted model directory never executes code."""
         import glob
-        import pickle
+        import json
 
         import jax
         from jax.experimental import serialize_executable as se
 
         for path in sorted(
-                glob.glob(os.path.join(dirname, AOT_DIRNAME, "sig_*.bin"))):
+                glob.glob(os.path.join(dirname, AOT_DIRNAME,
+                                       "sig_*.json"))):
             try:
-                with open(path, "rb") as f:
-                    bundle = pickle.load(f)
+                with open(path) as f:
+                    bundle = json.load(f)
                 if bundle["platform"] != jax.default_backend():
                     raise RuntimeError(
                         f"bundle platform {bundle['platform']} != runtime "
                         f"{jax.default_backend()}")
+                with open(path[:-5] + ".xla", "rb") as f:
+                    payload = f.read()
+                in_tree, out_tree = _aot_trees(
+                    len(bundle["feed_names"]), len(bundle["rw_state"]),
+                    len(bundle["ro_state"]), bundle["needs_key"],
+                    len(bundle["fetch_names"]),
+                    len(bundle["state_writes"]))
                 loaded = se.deserialize_and_load(
-                    bundle["payload"], bundle["in_tree"],
-                    bundle["out_tree"],
+                    payload, in_tree, out_tree,
                     execution_devices=jax.devices()[:bundle.get(
                         "n_devices", 1)])
                 bundle["loaded"] = loaded
-                self._aot[tuple(bundle["signature"])] = bundle
+                sig = tuple((n, tuple(shape), dt)
+                            for n, shape, dt in bundle["signature"])
+                self._aot[sig] = bundle
             except Exception as e:  # noqa: BLE001 — any mismatch: retrace
                 from .log import vlog
 

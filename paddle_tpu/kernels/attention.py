@@ -118,7 +118,7 @@ def _keep_tile(seed, shape, head_base, tq, tk, q_lo, k_lo, rate):
     # constvars, which a pallas_call refuses to lower)
     hseed = hash_rng.attn_head_seed(seed, gh)
     return hash_rng.keep_mask_tile(hseed, q_idx * np.uint32(tk) + k_idx,
-                                   rate)
+                                   rate, fast=True)
 
 
 # ---------------------------------------------------------------------------

@@ -94,16 +94,36 @@ def keep_mask(seed, shape, rate: float, base_index: int = 0):
     return bits >= np.uint32(keep_threshold(rate))
 
 
-def keep_mask_tile(seed, global_idx, rate: float):
+def keep_mask_tile(seed, global_idx, rate: float, fast: bool = False):
     """keep-mask from explicit global element indices (uint32 array) —
     the in-kernel form: build `global_idx` from grid/iota coordinates so a
-    backward kernel walking a different grid regenerates identical bits."""
+    backward kernel walking a different grid regenerates identical bits.
+    fast=True uses the cheaper mix32_fast (attention-weights masks)."""
     import jax.numpy as jnp
     import numpy as np
 
-    bits = mix32(global_idx.astype(jnp.uint32) * np.uint32(GOLDEN)
+    mixer = mix32_fast if fast else mix32
+    bits = mixer(global_idx.astype(jnp.uint32) * np.uint32(GOLDEN)
                  + seed.astype(jnp.uint32))
     return bits >= np.uint32(keep_threshold(rate))
+
+
+def mix32_fast(x):
+    """Cheaper 2-round mixer for the in-kernel attention-dropout masks:
+    one multiply + two xor-shifts (vs lowbias32's two multiplies + three).
+    The threshold compare consumes all 32 bits, and the per-head seed is
+    already avalanche-mixed (attn_head_seed uses full mix32), so the
+    per-element mixing only needs to decorrelate neighboring indices —
+    the O(T²·H) hash regenerated in three flash kernels is the measured
+    cost of in-kernel weights-dropout, so every op counts."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * np.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    return x
 
 
 def attn_head_seed(seed, bh_idx):
@@ -137,4 +157,5 @@ def keep_mask_attn(seed, shape, rate: float):
     q_idx = jax.lax.broadcasted_iota(u32, shape, 2)
     k_idx = jax.lax.broadcasted_iota(u32, shape, 3)
     hseed = attn_head_seed(seed, bh)
-    return keep_mask_tile(hseed, q_idx * np.uint32(tk) + k_idx, rate)
+    return keep_mask_tile(hseed, q_idx * np.uint32(tk) + k_idx, rate,
+                          fast=True)

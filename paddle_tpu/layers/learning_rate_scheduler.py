@@ -92,14 +92,23 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
                      power=1.0, cycle=False):
     step = _global_step_counter()
     if cycle:
-        raise NotImplementedError(
-            "polynomial_decay(cycle=True) requires data-dependent ceil; use "
-            "staircase-style schedules on TPU"
+        # reference learning_rate_scheduler.py polynomial_decay: the decay
+        # horizon stretches to decay_steps * max(ceil(step/decay_steps), 1)
+        # — plain elementwise math, fine under jit
+        div = T.scale(step, scale=1.0 / decay_steps)
+        helper = LayerHelper("ceil")
+        ceil_div = helper.create_variable_for_type_inference("float32")
+        helper.append_op("ceil", inputs={"X": [div]},
+                         outputs={"Out": [ceil_div]})
+        ceil_div = T.elementwise_max(
+            ceil_div, T.fill_constant([1], "float32", 1.0))
+        horizon = T.scale(ceil_div, scale=float(decay_steps))
+        ratio = T.elementwise_div(step, horizon)
+    else:
+        capped = T.elementwise_min(
+            step, T.fill_constant([1], "float32", float(decay_steps))
         )
-    capped = T.elementwise_min(
-        step, T.fill_constant([1], "float32", float(decay_steps))
-    )
-    ratio = T.scale(capped, scale=1.0 / decay_steps)
+        ratio = T.scale(capped, scale=1.0 / decay_steps)
     one_minus = T.scale(ratio, scale=-1.0, bias=1.0)
     poly = T.elementwise_pow(
         one_minus, T.fill_constant([1], "float32", float(power))

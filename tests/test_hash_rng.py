@@ -132,3 +132,27 @@ class TestDropoutOpUsesHash:
         assert np.allclose(gx[~kept], 0.0)
         # keep fraction sane
         assert abs(kept.mean() - 0.6) < 0.05
+
+
+class TestFastMixer:
+    """mix32_fast backs the in-kernel attention masks — same statistical
+    contract at lower op count (the per-head seed is full-mix32)."""
+
+    def test_keep_fraction_and_seed_mix(self):
+        key = jax.random.key(0, impl="rbg")
+        seed = hash_rng.seed_from_key(key, 5)
+        for rate in (0.1, 0.5):
+            m = np.asarray(hash_rng.keep_mask_attn(seed, (2, 4, 64, 64),
+                                                   rate))
+            assert abs(m.mean() - (1 - rate)) < 0.02, (rate, m.mean())
+        # different heads decorrelated (seed path uses full mix32)
+        m = np.asarray(hash_rng.keep_mask_attn(seed, (1, 2, 64, 64), 0.5))
+        agree = (m[0, 0] == m[0, 1]).mean()
+        assert 0.45 < agree < 0.55
+
+    def test_adjacent_index_independence_fast(self):
+        idx = jnp.arange(1 << 14, dtype=jnp.uint32)
+        m = np.asarray(hash_rng.keep_mask_tile(jnp.uint32(99), idx, 0.5,
+                                               fast=True))
+        r = np.corrcoef(m[:-1], m[1:])[0, 1]
+        assert abs(r) < 0.03, r

@@ -175,7 +175,8 @@ class TestAotServingExport:
         from paddle_tpu.inference import Predictor
 
         feed, expected = self._save_model(tmp_path)
-        assert (tmp_path / "m" / "__aot__" / "sig_0.bin").exists()
+        assert (tmp_path / "m" / "__aot__" / "sig_0.json").exists()
+        assert (tmp_path / "m" / "__aot__" / "sig_0.xla").exists()
 
         pred = Predictor(str(tmp_path / "m"))
         assert pred.aot_signatures, "AOT bundle did not load"
@@ -234,9 +235,9 @@ print("AOT_SERVE_OK")
         from paddle_tpu.inference import Predictor
 
         feed, expected = self._save_model(tmp_path)
-        # corrupt the bundle: loader must fall back to the retrace path
-        p = tmp_path / "m" / "__aot__" / "sig_0.bin"
-        p.write_bytes(b"not a bundle")
+        # corrupt the payload: loader must fall back to the retrace path
+        p = tmp_path / "m" / "__aot__" / "sig_0.xla"
+        p.write_bytes(b"not an executable")
         pred = Predictor(str(tmp_path / "m"))
         assert not pred.aot_signatures
         (out,) = pred.run(feed)

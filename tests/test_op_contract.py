@@ -922,3 +922,36 @@ class TestHsigmoidCustomTree(OpTest):
                                                scope=scope)[0]))
                       for _ in range(15)]
         assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_polynomial_decay_cycle():
+    """cycle=True stretches the horizon to ceil(step/decay_steps) periods
+    (reference learning_rate_scheduler.py polynomial_decay)."""
+    import paddle_tpu as pt
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        lr = layers.learning_rate_scheduler.polynomial_decay(
+            0.1, decay_steps=10,
+                                     end_learning_rate=0.01, power=1.0,
+                                     cycle=True)
+        x = layers.data(name="x", shape=[1], dtype="float32")
+        out = layers.elementwise_mul(x, lr)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        vals = []
+        for _ in range(25):
+            (v,) = exe.run(prog, feed={"x": np.ones((1, 1), "float32")},
+                           fetch_list=[out], scope=scope)
+            vals.append(float(np.asarray(v).ravel()[0]))
+
+    def expect(step):
+        horizon = 10 * max(np.ceil(step / 10), 1)
+        return (0.1 - 0.01) * (1 - step / horizon) + 0.01
+
+    # the step counter increments per run, starting at 1 on the first call
+    for i, v in enumerate(vals):
+        np.testing.assert_allclose(v, expect(i + 1), rtol=1e-5, atol=1e-6,
+                                   err_msg=f"step {i + 1}")
