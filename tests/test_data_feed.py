@@ -3,6 +3,7 @@ framework/data_feed.cc MultiSlotDataFeed, async_executor.cc RunFromFile,
 dist_ctr.py pattern)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import layers
@@ -123,3 +124,64 @@ def test_multislot_uint64_ids(tmp_path):
     feed = list(pt.MultiSlotDataFeed(desc).read_file(str(path)))[0]
     assert feed["ids"][0, 0] == big % 1000
     assert (feed["ids"] < 1000).all()
+
+
+class TestNativeMultiSlotParser:
+    """native/multislot.cc vs the Python parser: identical rows, identical
+    malformed-line behavior (reference parses in C++ the same way,
+    data_feed.cc ParseOneInstance)."""
+
+    def _desc(self):
+        from paddle_tpu.data_feed import DataFeedDesc
+
+        desc = DataFeedDesc(batch_size=4)
+        desc.add_slot("dense_f", type="float", is_dense=True, dim=3)
+        desc.add_slot("ids", type="uint64", max_len=5, id_space=1000)
+        return desc
+
+    def test_native_matches_python(self):
+        from paddle_tpu import data_feed as dfm
+        from paddle_tpu.data_feed import MultiSlotDataFeed
+
+        lib = dfm._native_multislot()
+        assert lib is not None, "g++ toolchain expected in this image"
+        feed = MultiSlotDataFeed(self._desc())
+        lines = []
+        rng = np.random.RandomState(0)
+        for _ in range(64):
+            f = rng.randn(3)
+            ids = rng.randint(0, 2**63, size=rng.randint(1, 5),
+                              dtype=np.uint64)
+            lines.append("3 " + " ".join(f"{v:.6f}" for v in f) + f" {len(ids)} "
+                         + " ".join(str(int(i)) for i in ids))
+        buf = ("\n".join(lines) + "\n").encode()
+        native_rows = feed.parse_buffer(buf)
+        py_rows = [feed.parse_line(ln) for ln in lines]
+        assert len(native_rows) == len(py_rows) == 64
+        for nr, pr in zip(native_rows, py_rows):
+            np.testing.assert_allclose(nr[0], pr[0], rtol=1e-6)
+            assert (nr[1] == pr[1]).all()
+            assert nr[1].dtype == np.uint64  # >= 2^63 ids survive
+
+    def test_malformed_lines_raise(self):
+        from paddle_tpu.data_feed import MultiSlotDataFeed
+
+        feed = MultiSlotDataFeed(self._desc())
+        with pytest.raises(ValueError):
+            feed.parse_buffer(b"3 1.0 2.0\n")   # truncated dense group
+        with pytest.raises(ValueError):
+            feed.parse_buffer(b"3 1.0 2.0 3.0 2 5 6 extra\n")  # trailing
+
+    def test_read_file_batches_via_native(self, tmp_path):
+        from paddle_tpu.data_feed import MultiSlotDataFeed
+
+        feed = MultiSlotDataFeed(self._desc())
+        p = tmp_path / "data.txt"
+        p.write_text("\n".join(
+            "3 0.5 1.5 2.5 2 7 8" for _ in range(10)) + "\n")
+        batches = list(feed.read_file(str(p)))
+        assert [b["dense_f"].shape[0] for b in batches] == [4, 4, 2]
+        np.testing.assert_allclose(batches[0]["dense_f"][0],
+                                   [0.5, 1.5, 2.5])
+        assert batches[0]["ids"][0, :2].tolist() == [7, 8]
+        assert batches[0]["ids__len"][0] == 2

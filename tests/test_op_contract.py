@@ -931,10 +931,11 @@ def test_polynomial_decay_cycle():
 
     prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(prog, startup):
+        # decay_steps=7: a value where scale-by-reciprocal would
+        # mis-round ceil at cycle boundaries (float32(21/7) -> 3.0000002)
         lr = layers.learning_rate_scheduler.polynomial_decay(
-            0.1, decay_steps=10,
-                                     end_learning_rate=0.01, power=1.0,
-                                     cycle=True)
+            0.1, decay_steps=7, end_learning_rate=0.01, power=1.0,
+            cycle=True)
         x = layers.data(name="x", shape=[1], dtype="float32")
         out = layers.elementwise_mul(x, lr)
     exe = pt.Executor(pt.CPUPlace())
@@ -948,10 +949,62 @@ def test_polynomial_decay_cycle():
             vals.append(float(np.asarray(v).ravel()[0]))
 
     def expect(step):
-        horizon = 10 * max(np.ceil(step / 10), 1)
+        horizon = 7 * max(np.ceil(step / 7), 1)
         return (0.1 - 0.01) * (1 - step / horizon) + 0.01
 
     # the step counter increments per run, starting at 1 on the first call
     for i, v in enumerate(vals):
         np.testing.assert_allclose(v, expect(i + 1), rtol=1e-5, atol=1e-6,
                                    err_msg=f"step {i + 1}")
+
+
+def test_matmul_col_stats_kernel():
+    """kernels/matmul_stats.py: fused y = x@w + per-column sum/sum² (the
+    measured-and-parked ResNet conv+stats candidate — PERF.md r5). The
+    kernel path (interpret on CPU) must match plain XLA exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.matmul_stats import matmul_col_stats
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1024, 128).astype("float32"))
+    w = jnp.asarray(rng.randn(128, 256).astype("float32"))
+    y, s1, s2 = jax.jit(matmul_col_stats)(x, w)
+    y0 = x @ w
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y0), rtol=1e-5,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(y0.sum(0)),
+                               rtol=1e-4, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(s2),
+                               np.asarray((y0 * y0).sum(0)),
+                               rtol=1e-4, atol=1e-1)
+
+
+def test_matmul_col_stats_grads():
+    """The custom vjp folds the stats cotangents into dY (module doc):
+    compare against jax.grad of the plain XLA composition."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.matmul_stats import matmul_col_stats
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(256, 128).astype("float32"))
+    w = jnp.asarray(rng.randn(128, 128).astype("float32"))
+
+    def loss_fused(x, w):
+        y, s1, s2 = matmul_col_stats(x, w)
+        return jnp.sum(y * 0.3) + jnp.sum(jnp.cos(s1)) + 1e-4 * jnp.sum(s2)
+
+    def loss_ref(x, w):
+        y = x @ w
+        ys = y.astype(jnp.float32)
+        return (jnp.sum(y * 0.3) + jnp.sum(jnp.cos(ys.sum(0)))
+                + 1e-4 * jnp.sum((ys * ys).sum(0)))
+
+    gf = jax.grad(loss_fused, (0, 1))(x, w)
+    gr = jax.grad(loss_ref, (0, 1))(x, w)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-3)
