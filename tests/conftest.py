@@ -2,8 +2,10 @@ import os
 
 # Force a virtual 8-device CPU mesh for all tests (SURVEY.md §4 test plan:
 # multi-host behavior simulated via xla_force_host_platform_device_count).
-# PT_TEST_PLATFORM=tpu runs the suite against a real TPU backend (exercises
-# the actual Mosaic kernel paths); default is deterministic CPU.
+# PT_TEST_PLATFORM=axon runs the suite against the real (tunneled) TPU
+# backend — exercises the actual compiled Mosaic kernel paths (the flash
+# attention + in-kernel dropout tests pass there; multi-device tests need
+# the CPU mesh).  Default is deterministic CPU.
 _platform = os.environ.get("PT_TEST_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
