@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# CI entry (reference role: paddle/scripts/paddle_build.sh — cmake_gen:58,
+# run_test:408).  Runs the full validation ladder on a plain CPU host:
+#   1. full test suite on the virtual 8-device CPU mesh
+#   2. bench smoke (real chip if present, else CPU)
+#   3. compile-check + multichip dryrun (the driver's graft contract)
+# Usage: tools/run_ci.sh [fast]   — "fast" skips the bench smoke.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== [1/3] test suite (virtual 8-device CPU mesh)"
+python -m pytest tests/ -q
+
+if [[ "${1:-}" != "fast" ]]; then
+  echo "== [2/3] bench smoke"
+  python bench.py --smoke
+fi
+
+echo "== [3/3] entry compile-check + multichip dryrun"
+python __graft_entry__.py
+
+echo "CI OK"
