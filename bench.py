@@ -69,23 +69,64 @@ def run_guarded(name, fn, *args, retries=2):
             time.sleep(5.0 * (attempt + 1))
     return False
 
-def timed_steps(exe, prog, feed, fetch, scope, warmup, calls):
+def _step_monitor(name, examples_per_call=None, tokens_per_call=None,
+                  flops_per_call=None):
+    """A StepMonitor when FLAGS.monitor is on, else None.  One bench
+    "step" is one run_steps call (scan_steps fused steps); JSONL goes to
+    FLAGS.monitor_jsonl when set."""
+    from paddle_tpu.flags import FLAGS
+
+    if not FLAGS.monitor:
+        return None
+    from paddle_tpu.monitor import StepMonitor
+
+    return StepMonitor(
+        name=f"bench.{name}",
+        examples_per_step=examples_per_call,
+        tokens_per_step=tokens_per_call,
+        flops_per_step=flops_per_call,
+        jsonl_path=FLAGS.monitor_jsonl or None,
+    )
+
+
+def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None):
     """Shared warmup + timing loop: returns (seconds, first_loss,
     last_loss).  first_loss is step 0 of the first (warmup) call, so
     last_loss < first_loss certifies the timed program actually LEARNS on
     its (fixed, memorizable) batches — the reference's book tests assert
-    loss thresholds the same way (tests/book/test_recognize_digits.py)."""
+    loss thresholds the same way (tests/book/test_recognize_digits.py).
+
+    `mon`: optional StepMonitor (see _step_monitor) — records per-call
+    loss/throughput/MFU telemetry for the timed calls."""
     first_loss = None
     for i in range(max(warmup, 1)):
         (losses,) = exe.run_steps(prog, feed=feed, fetch_list=fetch,
                                   scope=scope)
         if i == 0:
             first_loss = float(np.asarray(losses).reshape(-1)[0])
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        (losses,) = exe.run_steps(prog, feed=feed, fetch_list=fetch,
-                                  scope=scope)
-    dt = time.perf_counter() - t0
+    try:
+        # inside the timed region only a perf_counter stamp is taken per
+        # call; the registry/JSONL writes replay AFTER dt is measured so
+        # telemetry cost never lands in the reported throughput
+        stamps = []
+        if mon is not None:
+            mon.step(now=time.perf_counter())  # arm at region start
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            (losses,) = exe.run_steps(prog, feed=feed, fetch_list=fetch,
+                                      scope=scope)
+            if mon is not None:
+                stamps.append((time.perf_counter(), losses))
+        dt = time.perf_counter() - t0
+        if mon is not None:
+            for now_i, lv in stamps:
+                mon.step(loss=float(np.asarray(lv).reshape(-1)[-1]),
+                         now=now_i)
+    finally:
+        # run_guarded retries whole workloads: a leaked handle per retry
+        # would outlive the StepMonitor that opened it
+        if mon is not None:
+            mon.close()
     return dt, first_loss, float(np.asarray(losses).reshape(-1)[-1])
 
 
@@ -122,20 +163,15 @@ DEEPFM_TARGET_EXAMPLES_PER_SEC = 40000.0
 # incl. final fc) -> 8.18 GFLOPs fwd; training fwd+bwd ~= 3x fwd.
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.089e9
 
-# bf16 peak FLOP/s by PJRT device_kind
-TPU_PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-
 def _peak_flops():
+    """bf16 peak FLOP/s of device 0.  The committed per-chip table lives
+    with StepMonitor (library users get MFU without this script); the
+    import is function-local so `--help`/bad-flag invocations exit in
+    argparse without loading the framework — a real run pays the import
+    here, moments before the workloads would anyway."""
     import jax
+
+    from paddle_tpu.monitor.step import TPU_PEAK_FLOPS
 
     d = jax.devices()[0]
     return TPU_PEAK_FLOPS.get(getattr(d, "device_kind", ""), None)
@@ -269,12 +305,16 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
     ]
     feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
 
-    dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost], scope, warmup, calls)
-    # tokens counted on the decoded (trg) stream, the convention for MT
-    tps = batch_size * seq_len * scan_steps * calls / dt
     flops_tok = transformer_train_flops_per_token(
         cfg["n_layer"], cfg["d_model"], cfg["d_inner_hid"], cfg["n_head"],
         cfg["d_key"], seq_len, cfg["vocab"])
+    toks_per_call = batch_size * seq_len * scan_steps
+    mon = _step_monitor("transformer", tokens_per_call=toks_per_call,
+                        flops_per_call=flops_tok * toks_per_call)
+    dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
+                                            scope, warmup, calls, mon=mon)
+    # tokens counted on the decoded (trg) stream, the convention for MT
+    tps = batch_size * seq_len * scan_steps * calls / dt
     return tps, flops_tok, first_loss, last_loss
 
 
@@ -369,10 +409,14 @@ def bench_bert(batch_size=32, seq_len=128, scan_steps=8, calls=4, warmup=1,
                for s in range(scan_steps)]
     feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
 
-    dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_loss], scope, warmup, calls)
-    tps = batch_size * seq_len * scan_steps * calls / dt
     flops_tok = bert_train_flops_per_token(
         cfg["n_layer"], cfg["d_model"], cfg["d_ff"], seq_len, cfg["vocab"])
+    toks_per_call = batch_size * seq_len * scan_steps
+    mon = _step_monitor("bert", tokens_per_call=toks_per_call,
+                        flops_per_call=flops_tok * toks_per_call)
+    dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_loss],
+                                            scope, warmup, calls, mon=mon)
+    tps = batch_size * seq_len * scan_steps * calls / dt
     return tps, flops_tok, first_loss, last_loss
 
 
@@ -399,7 +443,10 @@ def bench_deepfm(batch_size=4096, scan_steps=8, calls=4, warmup=1,
                for s in range(scan_steps)]
     feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
 
-    dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost], scope, warmup, calls)
+    mon = _step_monitor("deepfm",
+                        examples_per_call=batch_size * scan_steps)
+    dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
+                                            scope, warmup, calls, mon=mon)
     eps = batch_size * scan_steps * calls / dt
     return eps, first_loss, last_loss
 
@@ -429,7 +476,9 @@ def bench_mnist(batch_size=512, scan_steps=16, calls=2, warmup=1, amp=True):
             k = int(y[s, b, 0])
             x[s, b, 0, k:k + 3, k:k + 3] += 1.0
     feed = {"pixel": x, "label": y}
-    dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost], scope, warmup, calls)
+    mon = _step_monitor("mnist", examples_per_call=batch_size * scan_steps)
+    dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
+                                            scope, warmup, calls, mon=mon)
     ips = batch_size * scan_steps * calls / dt
     return ips, first_loss, last_loss
 
@@ -568,6 +617,10 @@ def main():
                    help="resnet50: stream fresh host batches through the "
                         "double-buffer prefetcher instead of a cached "
                         "device batch")
+    p.add_argument("--monitor-snapshot", default=None, metavar="PATH",
+                   help="with FLAGS_monitor=1: write a Prometheus-text "
+                        "metrics snapshot to PATH after all workloads "
+                        "(plus PATH.jsonl with the JSONL exposition)")
     args = p.parse_args()
 
     peak = _peak_flops()
@@ -598,6 +651,31 @@ def main():
                 "error": "workload failed after retries (see stderr)",
             }), flush=True)
         ran.append(ok)
+
+    if args.monitor_snapshot:
+        from paddle_tpu.flags import FLAGS
+        from paddle_tpu.monitor import default_registry
+
+        if FLAGS.monitor:
+            # a bad path must not turn a measured bench run into a
+            # failure — the metric lines already printed are the product
+            try:
+                import os
+
+                d = os.path.dirname(args.monitor_snapshot)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                reg = default_registry()
+                reg.write_prometheus(args.monitor_snapshot)
+                reg.write_jsonl(args.monitor_snapshot + ".jsonl")
+                print(f"[bench] metrics snapshot: {args.monitor_snapshot} "
+                      f"(+ .jsonl)", file=sys.stderr)
+            except OSError as e:
+                print(f"[bench] metrics snapshot failed: {e}",
+                      file=sys.stderr)
+        else:
+            print("[bench] --monitor-snapshot ignored: FLAGS_monitor is "
+                  "off", file=sys.stderr)
     # exit 0 if ANY workload produced a number
     return 0 if any(ran) else 1
 

@@ -64,6 +64,7 @@ from .data_feed import (  # noqa: F401
     MultiSlotDataFeed,
 )
 from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401
 from . import amp  # noqa: F401
 from . import inference  # noqa: F401
 from . import contrib  # noqa: F401

@@ -349,6 +349,37 @@ def analyze_block_io(
 
 
 # ---------------------------------------------------------------------------
+# Telemetry (paddle_tpu.monitor, gated on FLAGS.monitor)
+# ---------------------------------------------------------------------------
+
+
+# Named components of each call-mode's cache key, parallel to the key
+# tuples built in run()/run_steps()/run_accumulated().  The recompile
+# detector diffs consecutive keys against these names so a silent retrace
+# storm logs WHICH component keeps changing (feed-signature churn from
+# ragged batch shapes is the classic one).
+_RUN_KEY_PARTS = (
+    "program-stamp", "amp-mode", "is-test-mode", "check-nan-inf",
+    "scope-signature", "feed-names", "feed-signature", "fetch-list",
+)
+_STEPS_KEY_PARTS = (
+    "call-mode", "program-stamp", "amp-mode", "is-test-mode",
+    "check-nan-inf", "scope-signature", "steps", "feed-names",
+    "feed-signature", "fetch-list",
+)
+_ACC_KEY_PARTS = (
+    "call-mode", "program-stamp", "amp-mode", "check-nan-inf",
+    "scope-signature", "accumulate-steps", "feed-names", "feed-signature",
+    "fetch-list",
+)
+
+# compile times are seconds-scale (XLA), run times sub-second: separate
+# bucket ladders keep both histograms informative
+_COMPILE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                    10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+# ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
 
@@ -391,6 +422,13 @@ class Executor:
         self._cache: Dict[Any, _CompiledEntry] = {}
         self._ref_names_cache: Dict[Any, tuple] = {}
         self._run_counter = 0
+        # recompile detector state: last cache key per (mode, program)
+        # + the program-stamps that have compiled at least once (a later
+        # miss on a seen stamp IS a recompile); only written when
+        # FLAGS.monitor is on
+        self._last_key_by_program = {}
+        self._compiled_stamps = set()
+        self._pending_stamp = None
         # debug mode, parity with the reference's FLAGS_check_nan_inf
         # (operator.cc:943): validate every op's outputs are finite
         if check_nan_inf is None:
@@ -412,9 +450,35 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,
     ):
-        # CompiledProgram / ShardedProgram delegate via their _run hook
+        # CompiledProgram / ShardedProgram delegate via their _run hook.
+        # Their data-parallel/sharded paths keep private compile caches, so
+        # only coarse telemetry (calls, wall time, errors) is recorded
+        # here; a non-parallel CompiledProgram calls back into run() below
+        # and gets the full instrumentation under a distinct namespace.
         if program is not None and hasattr(program, "_run"):
-            return program._run(self, feed, fetch_list, scope, return_numpy)
+            from ..monitor import enabled as _mon_enabled
+
+            if not _mon_enabled():
+                return program._run(self, feed, fetch_list, scope,
+                                    return_numpy)
+            import time as _time
+
+            from .. import monitor, profiler
+
+            t0 = _time.perf_counter()
+            try:
+                outs = program._run(self, feed, fetch_list, scope,
+                                    return_numpy)
+            except Exception:
+                # namespaced: the non-parallel path re-enters run(),
+                # whose own _count_error already bumps executor.errors
+                monitor.counter("executor.delegated.errors").inc()
+                raise
+            dt = _time.perf_counter() - t0
+            monitor.counter("executor.delegated.calls").inc()
+            monitor.histogram("executor.delegated_seconds").observe(dt)
+            profiler.add_event("executor.delegated", dt)
+            return outs
 
         if program is None:
             program = fw.default_main_program()
@@ -445,10 +509,19 @@ class Executor:
         )
 
         entry = self._cache.get(key) if use_program_cache else None
+        compiled_now = entry is None
+        mon, t0 = self._begin_monitored(_RUN_KEY_PARTS, key,
+                                        not compiled_now)
         if entry is None:
-            entry = self._compile(program, feed, feed_names, fetch_names, scope)
+            try:
+                entry = self._compile(program, feed, feed_names,
+                                      fetch_names, scope)
+            except Exception:
+                self._count_error(mon)
+                raise
             if use_program_cache:
                 self._cache[key] = entry
+                self._commit_stamp()
 
         rw_vals = [scope.find_var(n) for n in entry.rw_state]
         ro_vals = [scope.find_var(n) for n in entry.ro_state]
@@ -457,12 +530,17 @@ class Executor:
         import jax
 
         self._run_counter += 1
-        if entry.needs_key:
-            seed = program.random_seed or 0
-            key_arr = jax.random.fold_in(prng_key(seed), self._run_counter)
-            result = entry.fn(feed_vals, rw_vals, ro_vals, key_arr)
-        else:
-            result = entry.fn(feed_vals, rw_vals, ro_vals)
+        try:
+            if entry.needs_key:
+                seed = program.random_seed or 0
+                key_arr = jax.random.fold_in(prng_key(seed),
+                                             self._run_counter)
+                result = entry.fn(feed_vals, rw_vals, ro_vals, key_arr)
+            else:
+                result = entry.fn(feed_vals, rw_vals, ro_vals)
+        except Exception:
+            self._count_error(mon)
+            raise
         if entry.nan_check_ops is not None:
             fetches, new_state, nan_flags = result
         else:
@@ -482,14 +560,14 @@ class Executor:
                 if not ok
             ]
             if bad:
+                self._count_error(mon)
                 raise FloatingPointError(
                     "check_nan_inf: non-finite output from op(s):\n  "
                     + "\n  ".join(bad)
                 )
 
-        if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+        return self._finish_monitored("run", mon, t0, compiled_now,
+                                      feed_vals, fetches, return_numpy)
 
     def run_steps(
         self,
@@ -551,11 +629,19 @@ class Executor:
             tuple(fetch_names),
         )
         entry = self._cache.get(key)
+        compiled_now = entry is None
+        mon, t0 = self._begin_monitored(_STEPS_KEY_PARTS, key,
+                                        not compiled_now)
         if entry is None:
-            entry = self._compile_steps(
-                program, feed_names, fetch_names, scope, steps
-            )
+            try:
+                entry = self._compile_steps(
+                    program, feed_names, fetch_names, scope, steps
+                )
+            except Exception:
+                self._count_error(mon)
+                raise
             self._cache[key] = entry
+            self._commit_stamp()
 
         rw_vals = [scope.find_var(n) for n in entry.rw_state]
         ro_vals = [scope.find_var(n) for n in entry.ro_state]
@@ -568,7 +654,11 @@ class Executor:
         base_key = jax.random.fold_in(
             prng_key(seed), self._run_counter
         )
-        result = entry.fn(feed_vals, rw_vals, ro_vals, base_key)
+        try:
+            result = entry.fn(feed_vals, rw_vals, ro_vals, base_key)
+        except Exception:
+            self._count_error(mon)
+            raise
         if entry.nan_check_ops is not None:
             fetches, new_state, nan_flags = result
         else:
@@ -587,13 +677,13 @@ class Executor:
                 if not ok
             ]
             if bad:
+                self._count_error(mon)
                 raise FloatingPointError(
                     "check_nan_inf: non-finite output from op(s):\n  "
                     + "\n  ".join(bad)
                 )
-        if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+        return self._finish_monitored("run_steps", mon, t0, compiled_now,
+                                      feed_vals, fetches, return_numpy)
 
     def run_startup_missing(self, startup_program=None, scope=None):
         """Run only the startup ops whose outputs are NOT yet in the scope
@@ -689,11 +779,19 @@ class Executor:
             tuple(fetch_names),
         )
         entry = self._cache.get(key)
+        compiled_now = entry is None
+        mon, t0 = self._begin_monitored(_ACC_KEY_PARTS, key,
+                                        not compiled_now)
         if entry is None:
-            entry = self._compile_accumulated(
-                program, feed_names, fetch_names, scope, k
-            )
+            try:
+                entry = self._compile_accumulated(
+                    program, feed_names, fetch_names, scope, k
+                )
+            except Exception:
+                self._count_error(mon)
+                raise
             self._cache[key] = entry
+            self._commit_stamp()
 
         rw_vals = [scope.find_var(n) for n in entry.rw_state]
         ro_vals = [scope.find_var(n) for n in entry.ro_state]
@@ -701,8 +799,12 @@ class Executor:
         self._run_counter += 1
         seed = program.random_seed or 0
         base_key = jax.random.fold_in(prng_key(seed), self._run_counter)
-        fetches, new_state, nan_flags = entry.fn(
-            feed_vals, rw_vals, ro_vals, base_key)
+        try:
+            fetches, new_state, nan_flags = entry.fn(
+                feed_vals, rw_vals, ro_vals, base_key)
+        except Exception:
+            self._count_error(mon)
+            raise
         for n, v in zip(entry.state_writes, new_state):
             scope.set_var(n, v)
         if entry.nan_check_ops:
@@ -713,12 +815,13 @@ class Executor:
             per_op = np.concatenate([per_op, np.asarray(suffix_flags)])
             bad = [d for d, ok in zip(entry.nan_check_ops, per_op) if not ok]
             if bad:
+                self._count_error(mon)
                 raise FloatingPointError(
                     "check_nan_inf: non-finite output from op(s):\n  "
                     + "\n  ".join(bad))
-        if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+        return self._finish_monitored("run_accumulated", mon, t0,
+                                      compiled_now, feed_vals, fetches,
+                                      return_numpy)
 
     def _compile_accumulated(self, program, feed_names, fetch_names, scope,
                              k):
@@ -935,6 +1038,122 @@ class Executor:
             nan_check_ops=nan_check_ops if check else None,
             jitted=jitted,
         )
+
+    # -- telemetry internals (callers gate on monitor.enabled()) ---------
+    def _note_cache_lookup(self, part_names, key, hit: bool):
+        """Count the executable-cache hit/miss and run the RECOMPILE
+        DETECTOR.  A miss is a RECOMPILE iff this program-stamp compiled
+        before (any key): a program whose keys keep missing — ragged feed
+        shapes, churning fetch lists — counts one recompile per miss, and
+        the cache-key delta vs the previous lookup is VLOG(1)'d naming
+        the changed component.  A program's FIRST compile (startup, a new
+        eval program mid-training) is never a recompile, no matter what
+        the previous lookup was."""
+        from .. import monitor
+        from ..log import vlog, vlog_is_on
+
+        monitor.counter(
+            "executor.cache_hit" if hit else "executor.cache_miss").inc()
+        # mode-qualified stamp: run/run_steps/run_accumulated executables
+        # are distinct, so each mode gets its own first compile for free
+        stamp = (part_names, key[part_names.index("program-stamp")])
+        # per-(mode, program) history: diffing against another program's
+        # (or call mode's) key would blame program-stamp/call-mode and
+        # bury the component that actually churned
+        prev = self._last_key_by_program.get(stamp)
+        self._last_key_by_program[stamp] = key
+        self._pending_stamp = None
+        if hit:
+            return
+        if stamp not in self._compiled_stamps:
+            # first compile of this program — registered only once the
+            # entry lands in the cache (_commit_stamp), so retrying a
+            # failed compile is still not a recompile
+            self._pending_stamp = stamp
+            return
+        monitor.counter("executor.recompiles").inc()
+        if not vlog_is_on(1):
+            return
+        if prev is None:
+            changed = ["(no prior lookup of this program)"]
+        else:
+            changed = [n for n, a, b in zip(part_names, prev, key)
+                       if a != b] or ["(key unchanged; cache bypassed)"]
+        vlog(1, "executor recompile: changed key component(s): %s",
+             ", ".join(changed))
+
+    def _commit_stamp(self):
+        """The compiled entry reached the cache: future misses of this
+        program-stamp (in this call mode) are recompiles — even if the
+        first execution later fails (e.g. check_nan_inf raises)."""
+        if self._pending_stamp is not None:
+            self._compiled_stamps.add(self._pending_stamp)
+            self._pending_stamp = None
+
+    def _begin_monitored(self, part_names, key, hit: bool):
+        """Telemetry prologue shared by run/run_steps/run_accumulated:
+        returns (enabled, t0).  Zero registry work when FLAGS.monitor is
+        off — the hot path pays one flag read."""
+        from ..monitor import enabled
+
+        if not enabled():
+            return False, 0.0
+        import time as _time
+
+        self._note_cache_lookup(part_names, key, hit)
+        return True, _time.perf_counter()
+
+    def _finish_monitored(self, mode, mon, t0, compiled_now, feed_vals,
+                          fetches, return_numpy):
+        """Telemetry epilogue shared by the three run modes: convert the
+        fetches (the device sync) and record the call's metrics."""
+        if return_numpy:
+            outs = [np.asarray(v) for v in fetches]
+        else:
+            outs = list(fetches)
+        if mon:
+            self._record_run_metrics(mode, t0, compiled_now, feed_vals,
+                                     outs if return_numpy else None)
+        return outs
+
+    def _count_error(self, mon):
+        """Failed compile/execution: count it so cache_miss vs compiles
+        divergence during an incident is explained by executor.errors."""
+        if mon:
+            from .. import monitor
+
+            monitor.counter("executor.errors").inc()
+
+    def _record_run_metrics(self, mode, t0, compiled_now, feed_vals,
+                            np_outs):
+        """Registry writes for one finished executor call: run wall-time
+        (and compile wall-time when this call traced+compiled — jax.jit
+        compiles lazily, so the miss call's duration IS the compile cost),
+        plus host->device feed bytes and device->host fetch bytes."""
+        import time as _time
+
+        from .. import monitor, profiler
+
+        dt = _time.perf_counter() - t0
+        monitor.counter(f"executor.{mode}.calls").inc()
+        if compiled_now:
+            # the miss call's wall time IS trace+compile(+first run);
+            # keep it OUT of run_seconds so run-latency percentiles are
+            # not dominated by seconds-scale compile outliers
+            monitor.counter("executor.compiles").inc()
+            monitor.histogram(
+                "executor.compile_seconds",
+                buckets=_COMPILE_BUCKETS).observe(dt)
+            profiler.add_event("executor.compile", dt)
+        else:
+            monitor.histogram("executor.run_seconds").observe(dt)
+            profiler.add_event(f"executor.{mode}", dt)
+        fb = sum(int(getattr(v, "nbytes", 0) or 0) for v in feed_vals)
+        if fb:
+            monitor.counter("executor.feed_bytes").inc(fb)
+        if np_outs:
+            monitor.counter("executor.fetch_bytes").inc(
+                sum(int(getattr(o, "nbytes", 0) or 0) for o in np_outs))
 
     # -- internals -------------------------------------------------------
     def _scope_signature(self, program, feed_names, scope) -> frozenset:

@@ -165,8 +165,9 @@ class MultiSlotDataFeed:
                 line = line.strip()
                 if not line:
                     continue
-                r = self.parse_line(line)
+                r = self._parse_line_or_none(line)
                 if r is None:
+                    self._count_malformed(1)
                     raise ValueError(
                         f"malformed MultiSlot line: {line[:80]!r}")
                 rows.append(r)
@@ -186,9 +187,7 @@ class MultiSlotDataFeed:
         if n_rows < 0:
             raise ValueError("multislot native parse: capacity exceeded")
         if used[2] > 0:
-            raise ValueError(
-                f"malformed MultiSlot line(s): {int(used[2])} skipped by "
-                "the native parser")
+            raise self._malformed_error(buf, int(used[2]))
         counts = counts[:n_rows * len(slots)].reshape(n_rows, len(slots))
         rows: List[List[np.ndarray]] = []
         fo = io_ = 0
@@ -204,6 +203,42 @@ class MultiSlotDataFeed:
                     io_ += k
             rows.append(vals)
         return rows
+
+    @staticmethod
+    def _count_malformed(n: int):
+        from . import monitor
+
+        if monitor.enabled():
+            monitor.counter("data_feed.malformed_lines").inc(n)
+
+    def _malformed_error(self, buf: bytes, n_skipped: int) -> ValueError:
+        """The native parser only reports HOW MANY lines it skipped; for an
+        actionable exception, re-run the failing chunk through the Python
+        parser and name the FIRST malformed line (number + prefix)."""
+        self._count_malformed(n_skipped)
+        for lineno, line in enumerate(
+                buf.decode(errors="replace").splitlines(), 1):
+            line = line.strip()
+            if not line:
+                continue
+            if self._parse_line_or_none(line) is None:
+                return ValueError(
+                    f"malformed MultiSlot line(s): {n_skipped} skipped by "
+                    f"the native parser; first at chunk line {lineno}: "
+                    f"{line[:80]!r}")
+        return ValueError(
+            f"malformed MultiSlot line(s): {n_skipped} skipped by the "
+            "native parser (Python re-parse accepted every line; "
+            "native/Python parser disagreement)")
+
+    def _parse_line_or_none(self, line: str):
+        """parse_line with every malformed-line mode collapsed to None —
+        the ONE definition of malformed shared by the Python-fallback
+        parse path and the native-parser error report."""
+        try:
+            return self.parse_line(line)
+        except (ValueError, OverflowError):
+            return None  # non-numeric token etc. — malformed either way
 
     def parse_line(self, line: str) -> Optional[List[np.ndarray]]:
         toks = line.split()
@@ -361,15 +396,36 @@ class AsyncExecutor:
         for t in threads:
             t.start()
 
+        # input-pipeline telemetry (FLAGS.monitor): queue depth after each
+        # take + cumulative consumer stall time blocked on the queue — the
+        # two numbers that tell "device starved" from "device bound"
+        from . import monitor
+
+        mon = monitor.enabled()
+        if mon:
+            import time as _time
+
+            depth_gauge = monitor.gauge("data_feed.queue_depth")
+            stall_ctr = monitor.counter("data_feed.stall_seconds")
+            batch_ctr = monitor.counter("data_feed.batches")
+
         results: List[List[float]] = []
         done = 0
         while done < len(threads):
-            item = q.get()
+            if mon:
+                t0 = _time.perf_counter()
+                item = q.get()
+                stall_ctr.inc(_time.perf_counter() - t0)
+                depth_gauge.set(q.qsize())
+            else:
+                item = q.get()
             if item is end:
                 done += 1
                 continue
             if isinstance(item, _Err):
                 raise item.exc
+            if mon:
+                batch_ctr.inc()
             outs = self.executor.run(
                 program, feed=item, fetch_list=fetch_list, scope=scope)
             results.append([float(np.asarray(o).reshape(-1)[0])

@@ -132,3 +132,13 @@ FLAGS.define(
     "vlog", int, 0,
     "verbose logging level, like glog's VLOG(n) (reference init.cc "
     "InitGLOG); see paddle_tpu.log")
+FLAGS.define(
+    "monitor", bool, False,
+    "enable the runtime telemetry registry (paddle_tpu.monitor): executor "
+    "compile/run/recompile counters, data-feed queue gauges, inference "
+    "latency histograms, collective byte counters; off = zero writes on "
+    "the hot paths")
+FLAGS.define(
+    "monitor_jsonl", str, "",
+    "path for StepMonitor per-step JSONL records (bench.py/trainer "
+    "loops); empty keeps records in memory only")

@@ -148,8 +148,11 @@ def _aot_trees(n_feed, n_rw, n_ro, needs_key, n_fetch, n_state):
     """Reconstruct the executable's in/out pytree structures from the
     executor calling convention — fn((feed_list, rw_list, ro_list[, key]),
     {}) -> (fetch_list, state_list).  Rebuilding them from counts keeps the
-    on-disk bundle pickle-free (a JSON manifest + raw XLA payload; an
-    untrusted model directory must never execute code at load time)."""
+    MANIFEST pickle-free (JSON + raw XLA payload).  SECURITY: the payload
+    itself is NOT safe — jax's deserialize_and_load runs an unrestricted
+    unpickler over it, so loading a bundle from an untrusted model
+    directory can execute arbitrary code.  That is why Predictor defaults
+    use_aot=False (explicit opt-in for trusted artifacts)."""
     import jax
 
     args = ([0] * n_feed, [0] * n_rw, [0] * n_ro)
@@ -171,7 +174,12 @@ def export_aot_bundle(dirname, feed_examples, place=None) -> int:
     Writes `<dirname>/__aot__/sig_<i>.json` manifests + `sig_<i>.xla`
     payloads; returns how many were exported.  Loading falls back to the
     normal retrace path when a bundle does not match the runtime
-    (jax/platform change) — see Predictor."""
+    (jax/platform change) — see Predictor.
+
+    SECURITY: the sig_<i>.xla payload is deserialized via jax's
+    serialize_executable, which uses pickle under the hood — a bundle is a
+    TRUSTED artifact (like a pickle checkpoint), and Predictor only loads
+    one when constructed with use_aot=True."""
     import json
 
     import jax
@@ -245,12 +253,17 @@ class Predictor:
     `pred.compile_count` exposes the executable-cache size for tests.
 
     If the artifact carries an AOT bundle (save_inference_model
-    aot_feed_examples / export_aot_bundle), matching-signature calls serve
-    straight from the DESERIALIZED XLA EXECUTABLE — the program is never
-    re-traced, the reference's no-framework-in-the-loop serving property.
-    A bundle that fails to load (different platform / incompatible jax)
-    falls back to the retrace path; `pred.aot_signatures` lists live
-    bundles."""
+    aot_feed_examples / export_aot_bundle) AND the Predictor is built with
+    `use_aot=True`, matching-signature calls serve straight from the
+    DESERIALIZED XLA EXECUTABLE — the program is never re-traced, the
+    reference's no-framework-in-the-loop serving property.  A bundle that
+    fails to load (different platform / incompatible jax) falls back to
+    the retrace path; `pred.aot_signatures` lists live bundles.
+
+    use_aot defaults to FALSE: bundle deserialization runs jax's
+    serialize_executable unpickler over the payload, so a bundle must be
+    treated like a pickle file — opt in only for model directories you
+    trust (ones your own pipeline exported)."""
 
     def __init__(
         self,
@@ -259,7 +272,7 @@ class Predictor:
         optimize: bool = True,
         model_filename: Optional[str] = None,
         params_filename: Optional[str] = None,
-        use_aot: bool = True,
+        use_aot: bool = False,
     ):
         self._scope = Scope()
         self._exe = Executor(place or CPUPlace())
@@ -283,14 +296,15 @@ class Predictor:
             self.folded_ops = inference_transpile(self._program, self._scope)
 
     def _load_aot_bundles(self, dirname):
-        """Pickle-free load: JSON manifest + raw XLA payload; the in/out
-        pytrees rebuild from the manifest counts (_aot_trees), so loading
-        an untrusted model directory never executes code."""
+        """Load serialized executables (use_aot=True opt-in ONLY).  The
+        manifest is plain JSON, but deserialize_and_load runs an
+        unrestricted unpickler over the sig_*.xla payload — loading a
+        bundle from an untrusted model directory can execute arbitrary
+        code, which is exactly why this path is off by default."""
         import glob
         import json
 
         import jax
-        from jax.experimental import serialize_executable as se
 
         for path in sorted(
                 glob.glob(os.path.join(dirname, AOT_DIRNAME,
@@ -309,10 +323,11 @@ class Predictor:
                     len(bundle["ro_state"]), bundle["needs_key"],
                     len(bundle["fetch_names"]),
                     len(bundle["state_writes"]))
-                loaded = se.deserialize_and_load(
+                from .kernels.jax_compat import deserialize_and_load
+
+                loaded = deserialize_and_load(
                     payload, in_tree, out_tree,
-                    execution_devices=jax.devices()[:bundle.get(
-                        "n_devices", 1)])
+                    n_devices=bundle.get("n_devices", 1))
                 bundle["loaded"] = loaded
                 sig = tuple((n, tuple(shape), dt)
                             for n, shape, dt in bundle["signature"])
@@ -369,7 +384,35 @@ class Predictor:
 
     def run(self, feed: Dict[str, np.ndarray], return_numpy: bool = True):
         """Serve one batch; a matching AOT bundle serves without any trace,
-        otherwise compiles on first call per feed signature."""
+        otherwise compiles on first call per feed signature.
+
+        With FLAGS.monitor on, each call lands in the
+        `inference.request_seconds` latency histogram and the
+        `inference.requests` counter (QPS = rate over scrapes)."""
+        from . import monitor
+
+        if not monitor.enabled():
+            return self._run_impl(feed, return_numpy)
+
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            outs = self._run_impl(feed, return_numpy)
+        except Exception:
+            monitor.counter("inference.request_errors").inc()
+            raise
+        dt = _time.perf_counter() - t0
+        monitor.counter("inference.requests").inc()
+        monitor.histogram("inference.request_seconds").observe(dt)
+        # batch size comes from the FEED (fetches may be scalars/reduced)
+        shape = getattr(feed.get(self._feed_names[0])
+                        if self._feed_names else None, "shape", None)
+        monitor.counter("inference.examples").inc(
+            int(shape[0]) if shape else 1)
+        return outs
+
+    def _run_impl(self, feed, return_numpy):
         missing = [n for n in self._feed_names if n not in feed]
         if missing:
             raise KeyError(f"Predictor.run: missing feeds {missing}")

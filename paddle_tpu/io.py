@@ -177,9 +177,11 @@ def save_inference_model(
 
     aot_feed_examples: optional list of feed dicts; for each, an
     AOT-COMPILED XLA EXECUTABLE is serialized next to the artifact
-    (`<dirname>/__aot__/`) so a serving process (Predictor) can run that
-    feed signature with NO re-trace — the TPU-native analogue of the
-    reference's out-of-Python C++ serving (api/paddle_api.h:153)."""
+    (`<dirname>/__aot__/`) so a serving process (Predictor built with
+    use_aot=True — bundles deserialize via jax's pickle-based executable
+    loader, so they are trusted artifacts) can run that feed signature
+    with NO re-trace — the TPU-native analogue of the reference's
+    out-of-Python C++ serving (api/paddle_api.h:153)."""
     main_program = main_program or fw.default_main_program()
     scope = scope or global_scope()
     os.makedirs(dirname, exist_ok=True)

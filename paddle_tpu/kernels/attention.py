@@ -1077,6 +1077,16 @@ def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
         if dropout_seed is None:
             raise ValueError("flash_attention: dropout_rate > 0 needs "
                              "dropout_seed")
+        # the per-head mask plane is keyed by the uint32 index q*Tk + k,
+        # max tq*tk - 1: past 2^32 elements it would wrap and CORRELATE
+        # mask bits across rows — refuse rather than silently degrade
+        tq_d, tk_d = _dims(q, fmt)[2], _dims(k, fmt)[2]
+        if tq_d * tk_d > 2 ** 32:
+            raise ValueError(
+                f"flash_attention: weights-dropout mask plane Tq*Tk = "
+                f"{tq_d}*{tk_d} > 2^32 would wrap the uint32 hash index "
+                "and correlate mask bits; drop out the attention OUTPUT "
+                "(a [T, D] site) instead of the weights at this length")
         seed = jnp.reshape(dropout_seed, (1,)).astype(jnp.uint32)
     else:
         seed = jnp.zeros((1,), jnp.uint32)

@@ -145,12 +145,23 @@ def attn_head_seed(seed, bh_idx):
 def keep_mask_attn(seed, shape, rate: float):
     """Attention-weights keep-mask over a full [b, h, tq, tk] array —
     the pure-XLA counterpart of the kernels' _keep_tile: bit-identical
-    masks from (seed, b*h, q, k)."""
+    masks from (seed, b*h, q, k).
+
+    Raises when tq*tk > 2^32 (max in-plane index tq*tk - 1 no longer
+    fits uint32): the index q*tk + k would wrap and silently correlate
+    mask bits between distant rows (the failure mode attn_head_seed
+    exists to avoid on the b*h axis).  At such lengths apply dropout at
+    the attention OUTPUT site instead."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     b, h, tq, tk = shape
+    if int(tq) * int(tk) > 2 ** 32:
+        raise ValueError(
+            f"keep_mask_attn: mask plane tq*tk = {tq}*{tk} > 2^32 wraps "
+            "the uint32 hash index and correlates mask bits; use "
+            "output-site dropout for sequences this long")
     u32 = jnp.uint32
     bh = (jax.lax.broadcasted_iota(u32, shape, 0) * np.uint32(h)
           + jax.lax.broadcasted_iota(u32, shape, 1))

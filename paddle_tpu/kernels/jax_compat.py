@@ -1,0 +1,63 @@
+"""Version-tolerant wrappers for jax APIs that moved between releases.
+
+The framework targets current jax (top-level `jax.shard_map`, the
+varying-type system's `jax.lax.pvary`), but CI hosts may carry an older
+jaxlib where shard_map still lives in jax.experimental (param `check_rep`
+instead of `check_vma`) and pvary does not exist (no varying-type checks,
+so identity is the correct degenerate form).
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    import inspect
+
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        fn = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as fn
+    # the check param was renamed check_rep -> check_vma independently of
+    # the experimental->top-level promotion; probe the actual signature
+    if "check_vma" in inspect.signature(fn).parameters:
+        kw = {"check_vma": check_vma}
+    else:
+        kw = {"check_rep": check_vma}
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def pvary(x, axis_name):
+    import jax
+
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_name)
+    return x
+
+
+def axis_size(axis_name):
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    # old jax: psum of a Python-int constant folds to a static int
+    return jax.lax.psum(1, axis_name)
+
+
+def deserialize_and_load(payload, in_tree, out_tree, n_devices: int = 1):
+    """serialize_executable.deserialize_and_load grew an
+    execution_devices kwarg; older jax derives placement from the
+    payload.  (The payload is pickle-deserialized either way — callers
+    must treat it as a trusted artifact.)"""
+    import inspect
+
+    import jax
+    from jax.experimental import serialize_executable as se
+
+    params = inspect.signature(se.deserialize_and_load).parameters
+    if "execution_devices" in params:
+        return se.deserialize_and_load(
+            payload, in_tree, out_tree,
+            execution_devices=jax.devices()[:n_devices])
+    return se.deserialize_and_load(payload, in_tree, out_tree)

@@ -137,9 +137,10 @@ def _zeros_like_chunk(q, axis_name):
     # pvary: constants made inside a shard_map are unvaried over the mesh
     # axis; lax.cond demands both branches match the compute branch's
     # device-varying type
-    return (jax.lax.pvary(jnp.zeros(q.shape, q.dtype), axis_name),
-            jax.lax.pvary(jnp.full((b, h, t), -jnp.inf, jnp.float32),
-                          axis_name))
+    from .jax_compat import pvary
+
+    return (pvary(jnp.zeros(q.shape, q.dtype), axis_name),
+            pvary(jnp.full((b, h, t), -jnp.inf, jnp.float32), axis_name))
 
 
 def _ring_fwd(q, k, v, kbias, axis_name, scale, causal, block_q, block_k):
@@ -148,7 +149,9 @@ def _ring_fwd(q, k, v, kbias, axis_name, scale, causal, block_q, block_k):
     import jax
     import jax.numpy as jnp
 
-    n = jax.lax.axis_size(axis_name)
+    from .jax_compat import axis_size
+
+    n = axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -218,7 +221,9 @@ def _ring_bwd(q, k, v, kbias, out, lse, g, axis_name, scale, causal,
     import jax
     import jax.numpy as jnp
 
-    n = jax.lax.axis_size(axis_name)
+    from .jax_compat import axis_size
+
+    n = axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -242,7 +247,9 @@ def _ring_bwd(q, k, v, kbias, out, lse, g, axis_name, scale, causal,
 
         def skip_fn(args):
             qq, kk, vv, _ = args
-            pv = functools.partial(jax.lax.pvary, axis_name=axis_name)
+            from .jax_compat import pvary
+
+            pv = functools.partial(pvary, axis_name=axis_name)
             return (pv(jnp.zeros(qq.shape, qq.dtype)),
                     pv(jnp.zeros(kk.shape, kk.dtype)),
                     pv(jnp.zeros(vv.shape, vv.dtype)))
@@ -325,6 +332,8 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=1.0,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from .jax_compat import shard_map as _shard_map
+
     n = mesh.shape[axis_name]
     b, h, t, d = q.shape
     pad = (-t) % n
@@ -339,7 +348,7 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=1.0,
 
     spec = P(None, None, axis_name, None)
     if kbias is None:
-        fn = jax.shard_map(
+        fn = _shard_map(
             functools.partial(ring_attention, axis_name=axis_name,
                               scale=scale, causal=causal),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -347,7 +356,7 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=1.0,
         )
         return fn(q, k, v)
     kb_spec = P(None, None, None, axis_name)   # kbias seq dim is LAST
-    fn = jax.shard_map(
+    fn = _shard_map(
         lambda q, k, v, kb: ring_attention(q, k, v, axis_name, scale,
                                            causal, kbias=kb),
         mesh=mesh, in_specs=(spec, spec, spec, kb_spec), out_specs=spec,

@@ -29,6 +29,13 @@ def enable_default_handler(level=logging.INFO):
     return h
 
 
+def vlog_is_on(level: int) -> bool:
+    """glog's VLOG_IS_ON(n): lets call sites skip building expensive log
+    arguments (e.g. the executor's recompile cache-key delta) when the
+    line would be dropped anyway."""
+    return FLAGS.vlog >= level
+
+
 def vlog(level: int, msg: str, *args):
     """VLOG(n)-style verbose logging, gated on FLAGS.vlog."""
     if FLAGS.vlog >= level:

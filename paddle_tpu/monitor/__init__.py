@@ -1,0 +1,42 @@
+"""Runtime telemetry subsystem (reference role: the glog VLOG counters +
+platform/profiler.h host ranges + the benchmark/fluid metric prints; none of
+which exposed a scrapeable registry — this is the production-serving gap
+named in ROADMAP.md).
+
+Three pieces:
+
+  * `registry.py` — a thread-safe metrics registry (counters, gauges,
+    histograms with bounded buckets) with Prometheus-text and JSONL
+    exposition.  A process-wide default registry backs the module-level
+    `counter()/gauge()/histogram()` helpers.
+  * `step.py` — `StepMonitor`, per-step training telemetry (loss,
+    examples/sec, tokens/sec, rolling MFU via `profiler.cost_analysis` or
+    analytic FLOPs) written as BENCH-format-compatible JSONL.
+  * instrumentation call-sites live in the runtime itself
+    (`core/executor.py` compile/run/recompile, `data_feed.py` queue
+    gauges, `inference.py` request histograms, `parallel/distributed.py`
+    collective counters), every one gated on `FLAGS.monitor` so the hot
+    paths pay nothing when telemetry is off.
+
+Usage:
+
+    from paddle_tpu.flags import FLAGS
+    FLAGS.monitor = True                      # or env FLAGS_monitor=1
+    ... run training ...
+    import paddle_tpu.monitor as monitor
+    print(monitor.default_registry().prometheus_text())
+"""
+
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    DEFAULT_BUCKETS,
+    counter,
+    gauge,
+    histogram,
+    default_registry,
+    enabled,
+)
+from .step import StepMonitor  # noqa: F401

@@ -1,0 +1,290 @@
+"""Metrics registry: counters, gauges, bounded-bucket histograms.
+
+Prometheus-inspired but dependency-free; metric names are dotted strings
+("executor.cache_miss") which the Prometheus exposition sanitizes to
+underscore form.  All mutation goes through per-metric locks so parse
+workers / serving threads can hammer the same counter safely (the GIL makes
+`+=` *mostly* atomic in CPython, but "mostly" is not a contract).
+
+The registry itself is intentionally always-on and cheap; the FLAGS.monitor
+gate lives at the instrumentation call-sites (executor, data_feed,
+inference, collectives) so the hot paths skip even the helper call when
+telemetry is off.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+def enabled() -> bool:
+    """Whether telemetry call-sites should write (the FLAGS.monitor gate)."""
+    from ..flags import FLAGS
+
+    return FLAGS.monitor
+
+
+# latency-flavored default buckets (seconds): 100us .. 30s, bounded
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class Counter:
+    """Monotonically increasing float counter."""
+
+    kind = "counter"
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0):
+        if n < 0:
+            raise ValueError(f"counter {self.name!r}: negative increment {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"metric": self.name, "type": self.kind, "value": self._value}
+
+
+class Gauge:
+    """Instantaneous value (queue depth, last loss, ...)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float):
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0):
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"metric": self.name, "type": self.kind, "value": self._value}
+
+
+class Histogram:
+    """Fixed-bucket histogram (bounded memory: len(buckets)+1 counts).
+
+    `buckets` are upper bounds in ascending order; an implicit +Inf bucket
+    catches the tail.  Exposition is cumulative (Prometheus `le` form).
+    """
+
+    kind = "histogram"
+    __slots__ = ("name", "help", "buckets", "_lock", "_counts", "_sum",
+                 "_count")
+
+    def __init__(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 help: str = ""):
+        bs = tuple(float(b) for b in buckets)
+        if not bs or list(bs) != sorted(bs):
+            raise ValueError(
+                f"histogram {name!r}: buckets must be ascending, got {bs}")
+        self.name = name
+        self.help = help
+        self.buckets = bs
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(bs) + 1)  # +1: the +Inf tail
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float):
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum, cum_counts = 0, []
+        for le, c in zip(self.buckets + (float("inf"),), counts):
+            cum += c
+            cum_counts.append([le, cum])
+        return {"metric": self.name, "type": self.kind, "count": total,
+                "sum": s, "buckets": cum_counts}
+
+
+class MetricsRegistry:
+    """Name -> metric store; get-or-create, thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, name, cls, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help=help)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None,
+                  help: str = "") -> Histogram:
+        h = self._get_or_create(
+            name, Histogram, buckets=buckets or DEFAULT_BUCKETS, help=help)
+        # explicit buckets that don't match the live metric would put
+        # observations past the old top bucket in +Inf; warn (never
+        # raise — instrumentation must not be able to fail a run)
+        if buckets is not None and tuple(float(b) for b in buckets) != h.buckets:
+            from ..log import warning
+
+            warning(
+                "histogram %r already registered with buckets %s; "
+                "requested %s ignored", name, h.buckets, tuple(buckets))
+        return h
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+
+    # -- exposition ------------------------------------------------------
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        return [m.snapshot() for m in metrics]
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (scrape-ready)."""
+        lines = []
+        for snap in self.snapshot():
+            name = _prom_name(snap["metric"])
+            lines.append(f"# TYPE {name} {snap['type']}")
+            if snap["type"] == "histogram":
+                for le, cum in snap["buckets"]:
+                    le_s = "+Inf" if le == float("inf") else _prom_num(le)
+                    lines.append(f'{name}_bucket{{le="{le_s}"}} {cum}')
+                lines.append(f"{name}_sum {_prom_num(snap['sum'])}")
+                lines.append(f"{name}_count {snap['count']}")
+            else:
+                lines.append(f"{name} {_prom_num(snap['value'])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def jsonl(self) -> str:
+        """One JSON object per line per metric (BENCH-artifact style).
+        Non-finite values (a NaN loss gauge from a diverged run) become
+        strings so the output stays strict JSON."""
+        ts = time.time()
+        return "\n".join(
+            json.dumps(_json_safe(dict(snap, ts=round(ts, 3))))
+            for snap in self.snapshot()
+        ) + ("\n" if self._metrics else "")
+
+    def write_jsonl(self, path: str):
+        with open(path, "w") as f:
+            f.write(self.jsonl())
+
+    def write_prometheus(self, path: str):
+        with open(path, "w") as f:
+            f.write(self.prometheus_text())
+
+
+def _json_safe(v):
+    import math
+
+    if isinstance(v, float) and not math.isfinite(v):
+        return "NaN" if math.isnan(v) else (
+            "Infinity" if v > 0 else "-Infinity")
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    return v
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_num(v) -> str:
+    import math
+
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+_default = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _default
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _default.counter(name, help=help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _default.gauge(name, help=help)
+
+
+def histogram(name: str, buckets: Optional[Sequence[float]] = None,
+              help: str = "") -> Histogram:
+    return _default.histogram(name, buckets=buckets, help=help)

@@ -1,0 +1,242 @@
+"""StepMonitor: per-step training telemetry.
+
+Used by trainer-style loops and bench.py: each `step()` call records loss,
+examples/sec, tokens/sec, and rolling MFU, mirrors them into the metrics
+registry, and (optionally) appends one JSON line per step in the BENCH
+record shape ({"metric", "value", "unit", ...} plus step fields), so the
+same tooling that reads BENCH_r*.json can plot a training run.
+
+FLOPs for MFU come from either an analytic `flops_per_step`, or lazily from
+XLA's own compiled cost model via `cost_from=(program, feed, fetch_list
+[, scope])` — the `profiler.cost_analysis` path, exact and without
+executing.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import time
+from typing import Optional, Sequence
+
+from . import registry as _registry
+
+import itertools as _itertools
+
+# distinguishes records when several StepMonitors append to one JSONL
+# file (bench workloads, run_guarded retries restarting step numbers)
+_RUN_SEQ = _itertools.count(1)
+
+# bf16 peak FLOP/s by PJRT device_kind — the committed per-chip table
+# (bench.py reuses this for its MFU lines)
+TPU_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+class StepMonitor:
+    def __init__(
+        self,
+        name: str = "train",
+        examples_per_step: Optional[float] = None,
+        tokens_per_step: Optional[float] = None,
+        flops_per_step: Optional[float] = None,
+        cost_from: Optional[Sequence] = None,
+        peak_flops: Optional[float] = None,
+        jsonl_path: Optional[str] = None,
+        window: int = 20,
+        registry: Optional[_registry.MetricsRegistry] = None,
+    ):
+        """name: metric prefix ("<name>.step" in records); window: rolling
+        MFU/rate horizon in steps; cost_from: args for
+        profiler.cost_analysis, evaluated lazily on the first step()."""
+        self.name = name
+        self.examples_per_step = examples_per_step
+        self.tokens_per_step = tokens_per_step
+        self._flops_per_step = flops_per_step
+        self._cost_from = cost_from
+        # analytic flops_per_step is a single-device count; cost_analysis
+        # sums over every partition — the peak denominator must match
+        self._flops_whole_fleet = flops_per_step is None
+        self.peak_flops = peak_flops
+        self.jsonl_path = jsonl_path
+        self._file = None
+        self._window = collections.deque(maxlen=max(1, window))
+        self._step = 0
+        self._last_t: Optional[float] = None
+        self._reg = registry or _registry.default_registry()
+        self.run_id = next(_RUN_SEQ)
+        self.records = []  # in-memory mirror (bounded by window*50)
+        self._records_cap = max(1, window) * 50
+
+    @property
+    def flops_per_step(self) -> Optional[float]:
+        if self._flops_per_step is None and self._cost_from is not None:
+            cost_from, self._cost_from = self._cost_from, None
+            # telemetry must not fail the run: a cost-analysis error
+            # (backend without support, bad feed/fetch) just drops MFU
+            try:
+                from ..profiler import cost_analysis
+
+                cost = cost_analysis(*cost_from)
+                flops = float(cost.get("flops", 0.0)) if cost else 0.0
+            except Exception as e:
+                from ..log import warning
+
+                warning("StepMonitor: cost_analysis failed (%s); MFU "
+                        "disabled", e)
+                flops = 0.0
+            self._flops_per_step = flops or None
+        return self._flops_per_step
+
+    def _resolve_peak(self) -> Optional[float]:
+        if self.peak_flops is not None:
+            return self.peak_flops
+        try:
+            import jax
+
+            devs = jax.devices()
+            kind = getattr(devs[0], "device_kind", "")
+        except Exception:  # pragma: no cover - no backend at all
+            return None
+        per_chip = TPU_PEAK_FLOPS.get(kind)
+        if per_chip is None:
+            self.peak_flops = None
+        elif self._flops_whole_fleet:
+            # cost_analysis FLOPs sum over every partition of a multi-
+            # device program: the denominator is the whole fleet's peak
+            self.peak_flops = per_chip * len(devs)
+        else:
+            # analytic flops_per_step counts one device's work
+            self.peak_flops = per_chip
+        return self.peak_flops
+
+    def step(self, loss: Optional[float] = None,
+             examples: Optional[float] = None,
+             tokens: Optional[float] = None,
+             now: Optional[float] = None) -> Optional[dict]:
+        """Mark one training step done.  The first call only arms the
+        timer (there is no preceding interval to rate) and returns None.
+
+        `now`: optional perf_counter() timestamp taken when the step
+        actually finished — lets a timed loop stamp cheaply in-loop and
+        replay the records afterwards, keeping registry/JSONL writes out
+        of the measured region (bench.py timed_steps does this)."""
+        if self._last_t is None:
+            # resolve lazy cost_from FLOPs now — it may run a seconds-
+            # scale XLA compile, which must not leak into step 1's dt
+            _ = self.flops_per_step
+            self._last_t = now if now is not None else time.perf_counter()
+            return None
+        if now is None:
+            now = time.perf_counter()
+        dt = max(now - self._last_t, 1e-9)
+        self._last_t = now
+        self._step += 1
+
+        examples = examples if examples is not None else self.examples_per_step
+        tokens = tokens if tokens is not None else self.tokens_per_step
+        eps = (examples / dt) if examples else None
+        tps = (tokens / dt) if tokens else None
+        flops = self.flops_per_step
+        peak = self._resolve_peak() if flops else None
+        mfu = (flops / dt / peak) if (flops and peak) else None
+
+        self._window.append((dt, loss, mfu))
+        win_dt = sum(w[0] for w in self._window)
+        win_mfus = [w[2] for w in self._window if w[2] is not None]
+        rolling_mfu = (sum(win_mfus) / len(win_mfus)) if win_mfus else None
+
+        rec = {
+            "metric": f"{self.name}.step",
+            "value": round(eps if eps is not None else 1.0 / dt, 2),
+            "unit": "examples/sec" if eps is not None else "steps/sec",
+            "run": self.run_id,  # disambiguates retries sharing one file
+            "step": self._step,
+            "step_seconds": round(dt, 6),
+        }
+        if loss is not None:
+            rec["loss"] = round(float(loss), 6)
+        if tps is not None:
+            rec["tokens_per_sec"] = round(tps, 2)
+        if mfu is not None:
+            rec["mfu"] = round(mfu, 4)
+        if rolling_mfu is not None:
+            rec["rolling_mfu"] = round(rolling_mfu, 4)
+        if len(self._window) > 1:
+            rec["rolling_steps_per_sec"] = round(len(self._window) / win_dt, 3)
+
+        self._reg.counter(f"{self.name}.steps").inc()
+        self._reg.histogram(f"{self.name}.step_seconds").observe(dt)
+        if loss is not None:
+            self._reg.gauge(f"{self.name}.loss").set(float(loss))
+        if eps is not None:
+            self._reg.gauge(f"{self.name}.examples_per_sec").set(eps)
+        if tps is not None:
+            self._reg.gauge(f"{self.name}.tokens_per_sec").set(tps)
+        if rolling_mfu is not None:
+            self._reg.gauge(f"{self.name}.rolling_mfu").set(rolling_mfu)
+
+        self.records.append(rec)
+        if len(self.records) > self._records_cap:
+            del self.records[: len(self.records) - self._records_cap]
+        if self.jsonl_path:
+            # telemetry must not be able to fail the run: a bad path or
+            # a full disk drops records (with one warning), not training
+            try:
+                if self._file is None:
+                    self._file = open(self.jsonl_path, "a")
+                # _json_safe: a diverged run's NaN loss must not produce
+                # non-strict JSON in the archived artifact
+                self._file.write(
+                    json.dumps(_registry._json_safe(rec)) + "\n")
+                self._file.flush()
+            except OSError as e:
+                from ..log import warning
+
+                warning("StepMonitor: cannot write %s (%s); per-step "
+                        "JSONL disabled", self.jsonl_path, e)
+                self.jsonl_path = None
+        return rec
+
+    def summary(self) -> dict:
+        """Aggregate over the rolling window (for an end-of-run print)."""
+        if not self._window:
+            return {"metric": f"{self.name}.summary", "steps": self._step}
+        win_dt = sum(w[0] for w in self._window)
+        losses = [w[1] for w in self._window if w[1] is not None]
+        mfus = [w[2] for w in self._window if w[2] is not None]
+        out = {
+            "metric": f"{self.name}.summary",
+            "steps": self._step,
+            "steps_per_sec": round(len(self._window) / win_dt, 3),
+        }
+        if self.examples_per_step:
+            out["examples_per_sec"] = round(
+                self.examples_per_step * len(self._window) / win_dt, 2)
+        if self.tokens_per_step:
+            out["tokens_per_sec"] = round(
+                self.tokens_per_step * len(self._window) / win_dt, 2)
+        if losses:
+            out["loss"] = round(losses[-1], 6)
+        if mfus:
+            out["mfu"] = round(sum(mfus) / len(mfus), 4)
+        return out
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

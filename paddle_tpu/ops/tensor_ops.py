@@ -240,11 +240,34 @@ def _cast_infer(ctx):
         ctx.set_output("Out", xs, ctx.attr("out_dtype", "float32"))
 
 
+def _canon_i64():
+    """int64 clamped through jax's canonical-dtype helper: index outputs
+    (argmax/top_k/...) keep reference int64 semantics under x64 but become
+    int32 EXPLICITLY when x64 is off, instead of truncate-and-warn on
+    every trace."""
+    import jax
+
+    return jax.dtypes.canonicalize_dtype(np.int64)
+
+
+def _requested_dtype(attr_value):
+    """Program dtype attr -> the dtype JAX will actually produce: bfloat16
+    stays symbolic, everything else is clamped through jax's canonical-
+    dtype helper so an int64/float64 request with x64 disabled becomes
+    int32/float32 EXPLICITLY instead of letting jnp truncate-and-warn on
+    every trace (the bench-visible UserWarning at fill_constant sites)."""
+    import jax
+
+    jnp = _jnp()
+    dtype = convert_dtype(attr_value)
+    if dtype == "bfloat16":
+        return jnp.bfloat16
+    return jax.dtypes.canonicalize_dtype(np.dtype(dtype))
+
+
 @register("cast", infer_shape=_cast_infer)
 def lower_cast(ctx, ins):
-    jnp = _jnp()
-    dtype = convert_dtype(ctx.attr("out_dtype", "float32"))
-    target = jnp.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)
+    target = _requested_dtype(ctx.attr("out_dtype", "float32"))
     return {"Out": [ins["X"][0].astype(target)]}
 
 
@@ -255,8 +278,7 @@ def _fill_constant_infer(ctx):
 @register("fill_constant", infer_shape=_fill_constant_infer, no_grad=True)
 def lower_fill_constant(ctx, ins):
     jnp = _jnp()
-    dtype = convert_dtype(ctx.attr("dtype", "float32"))
-    target = jnp.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)
+    target = _requested_dtype(ctx.attr("dtype", "float32"))
     shape = tuple(int(s) for s in ctx.attr("shape", [1]))
     return {"Out": [jnp.full(shape, ctx.attr("value", 0.0), dtype=target)]}
 
@@ -300,7 +322,7 @@ def lower_one_hot(ctx, ins):
 def lower_arg_max(ctx, ins):
     jnp = _jnp()
     return {
-        "Out": [jnp.argmax(ins["X"][0], axis=ctx.attr("axis", -1)).astype(jnp.int64)]
+        "Out": [jnp.argmax(ins["X"][0], axis=ctx.attr("axis", -1)).astype(_canon_i64())]
     }
 
 
@@ -308,7 +330,7 @@ def lower_arg_max(ctx, ins):
 def lower_arg_min(ctx, ins):
     jnp = _jnp()
     return {
-        "Out": [jnp.argmin(ins["X"][0], axis=ctx.attr("axis", -1)).astype(jnp.int64)]
+        "Out": [jnp.argmin(ins["X"][0], axis=ctx.attr("axis", -1)).astype(_canon_i64())]
     }
 
 
@@ -318,18 +340,17 @@ def lower_argsort(ctx, ins):
     x = ins["X"][0]
     axis = ctx.attr("axis", -1)
     idx = jnp.argsort(x, axis=axis)
-    return {"Out": [jnp.take_along_axis(x, idx, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [jnp.take_along_axis(x, idx, axis=axis)], "Indices": [idx.astype(_canon_i64())]}
 
 
 @register("top_k", no_grad=True)
 def lower_top_k(ctx, ins):
     import jax
 
-    jnp = _jnp()
     x = ins["X"][0]
     k = ctx.attr("k", 1)
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(_canon_i64())]}
 
 
 @register("cumsum")

@@ -80,9 +80,41 @@ def global_device_mesh(axis_names=("data",), shape=None):
 # -- collective ops usable inside shard_map regions -------------------------
 
 
+def _count_collective(kind: str, x):
+    """Telemetry (FLAGS.monitor): per-collective op and byte counters.
+
+    Collectives execute inside compiled XLA programs, so runtime counting
+    is impossible from Python — these count at TRACE time: one increment
+    per collective op per compilation, with the per-shard payload bytes
+    from the traced aval.  Multiply by steps-run to estimate wire traffic;
+    the point is spotting WHICH collectives a program emits and how big
+    they are (the reference's VLOG'd nccl call sites)."""
+    from .. import monitor
+
+    if not monitor.enabled():
+        return
+
+    nbytes = 0
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        n = 1
+        for s in shape:
+            try:
+                n *= int(s)
+            except TypeError:  # symbolic dim: bytes unknown
+                n = 0
+                break
+        nbytes = n * getattr(dtype, "itemsize", 0)
+    monitor.counter(f"collective.{kind}.ops").inc()
+    if nbytes:
+        monitor.counter(f"collective.{kind}.bytes").inc(nbytes)
+
+
 def all_reduce(x, axis_name="data", op="sum"):
     import jax
 
+    _count_collective("all_reduce", x)
     if op == "sum":
         return jax.lax.psum(x, axis_name)
     if op == "mean":
@@ -95,16 +127,19 @@ def all_reduce(x, axis_name="data", op="sum"):
 def all_gather(x, axis_name="data", axis=0):
     import jax
 
+    _count_collective("all_gather", x)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
 
 
 def reduce_scatter(x, axis_name="data", axis=0):
     import jax
 
+    _count_collective("reduce_scatter", x)
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
 
 
 def ppermute(x, axis_name, perm):
     import jax
 
+    _count_collective("ppermute", x)
     return jax.lax.ppermute(x, axis_name, perm)

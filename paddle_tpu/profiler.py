@@ -15,35 +15,56 @@ from typing import Optional
 
 class _HostEvents:
     def __init__(self):
+        import threading
+
         self.totals = defaultdict(float)
         self.counts = defaultdict(int)
         self.maxes = defaultdict(float)
-        self._stack = []
+        # per-thread range stack: concurrent record_event() ranges on
+        # different threads must not pop each other's (name, t0)
+        self._local = threading.local()
+        # add() is reached from serving/executor threads via
+        # profiler.add_event; unlocked += would drop increments
+        self._lock = threading.Lock()
+
+    @property
+    def _stack(self):
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
 
     def push(self, name):
         self._stack.append((name, time.perf_counter()))
 
     def pop(self):
         name, t0 = self._stack.pop()
-        dt = time.perf_counter() - t0
-        self.totals[name] += dt
-        self.counts[name] += 1
-        self.maxes[name] = max(self.maxes[name], dt)
+        self.add(name, time.perf_counter() - t0)
+
+    def add(self, name, dt):
+        with self._lock:
+            self.totals[name] += dt
+            self.counts[name] += 1
+            self.maxes[name] = max(self.maxes[name], dt)
 
     def summary(self, sorted_key="total"):
         rows = []
-        for name in self.totals:
-            total = self.totals[name]
-            cnt = self.counts[name]
-            rows.append((name, cnt, total, total / cnt, self.maxes[name]))
+        with self._lock:  # add() on worker threads may insert new names
+            names = list(self.totals)
+            for name in names:
+                total = self.totals[name]
+                cnt = self.counts[name]
+                rows.append(
+                    (name, cnt, total, total / cnt, self.maxes[name]))
         key_idx = {"total": 2, "calls": 1, "ave": 3, "max": 4}.get(sorted_key, 2)
         rows.sort(key=lambda r: r[key_idx], reverse=True)
         return rows
 
     def reset(self):
-        self.totals.clear()
-        self.counts.clear()
-        self.maxes.clear()
+        with self._lock:  # don't interleave with a worker thread's add()
+            self.totals.clear()
+            self.counts.clear()
+            self.maxes.clear()
 
 
 _events = _HostEvents()
@@ -58,6 +79,20 @@ def record_event(name):
         yield
     finally:
         _events.pop()
+
+
+def add_event(name, seconds: float):
+    """Record an already-measured host range into the event table — used
+    by instrumentation that owns its timer (the executor's monitored
+    run/compile paths), so the profiler summary covers the runtime hot
+    paths without nesting context managers through their control flow."""
+    _events.add(name, seconds)
+
+
+def host_events(sorted_key="total"):
+    """Rows of (name, calls, total_s, avg_s, max_s) from the host event
+    table, without printing (stop_profiler's table, accessor form)."""
+    return _events.summary(sorted_key)
 
 
 def start_profiler(state="All", trace_dir: Optional[str] = None):
@@ -133,7 +168,17 @@ def cost_analysis(program, feed, fetch_list=None, scope=None):
                                  ex.prng_key(0))
     else:
         lowered = entry.fn.lower(feed_vals, rw_vals, ro_vals)
-    return lowered.compile().cost_analysis()
+    cost = lowered.compile().cost_analysis()
+    # jax returns one properties dict per partition on some versions and a
+    # bare dict on others; normalize to ONE dict (numeric keys summed)
+    if isinstance(cost, (list, tuple)):
+        merged = {}
+        for entry_props in cost:
+            for k, v in (entry_props or {}).items():
+                if isinstance(v, (int, float)):
+                    merged[k] = merged.get(k, 0.0) + v
+        cost = merged
+    return cost
 
 
 def xplane_op_table(trace_dir: str, top_k: int = 30):

@@ -178,7 +178,7 @@ class TestAotServingExport:
         assert (tmp_path / "m" / "__aot__" / "sig_0.json").exists()
         assert (tmp_path / "m" / "__aot__" / "sig_0.xla").exists()
 
-        pred = Predictor(str(tmp_path / "m"))
+        pred = Predictor(str(tmp_path / "m"), use_aot=True)
         assert pred.aot_signatures, "AOT bundle did not load"
 
         calls = {"n": 0}
@@ -214,7 +214,7 @@ import paddle_tpu as pt
 from paddle_tpu.core.executor import Executor
 from paddle_tpu.inference import Predictor
 
-pred = Predictor({str(tmp_path / 'm')!r})
+pred = Predictor({str(tmp_path / 'm')!r}, use_aot=True)
 assert pred.aot_signatures
 
 # loading the artifact may compile load-ops; SERVING must not trace
@@ -238,7 +238,7 @@ print("AOT_SERVE_OK")
         # corrupt the payload: loader must fall back to the retrace path
         p = tmp_path / "m" / "__aot__" / "sig_0.xla"
         p.write_bytes(b"not an executable")
-        pred = Predictor(str(tmp_path / "m"))
+        pred = Predictor(str(tmp_path / "m"), use_aot=True)
         assert not pred.aot_signatures
         (out,) = pred.run(feed)
         np.testing.assert_allclose(out, expected, atol=1e-5)
@@ -269,7 +269,7 @@ def test_aot_with_batchnorm_model_consistent(tmp_path):
         pt.io.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe,
                                    main_program=prog, scope=scope,
                                    aot_feed_examples=[feed])
-    p = Predictor(str(tmp_path / "m"))
+    p = Predictor(str(tmp_path / "m"), use_aot=True)
     assert p.aot_signatures
     (out,) = p.run(feed)
     np.testing.assert_allclose(out, np.asarray(expected), atol=1e-5)

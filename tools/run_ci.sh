@@ -12,8 +12,13 @@ echo "== [1/3] test suite (virtual 8-device CPU mesh)"
 python -m pytest tests/ -q
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [2/3] bench smoke"
-  python bench.py --smoke
+  echo "== [2/3] bench smoke (telemetry on; snapshot artifact)"
+  mkdir -p ci_artifacts
+  rm -f ci_artifacts/bench_steps.jsonl  # StepMonitor appends; keep one run
+  FLAGS_monitor=1 FLAGS_monitor_jsonl=ci_artifacts/bench_steps.jsonl \
+    python bench.py --smoke --monitor-snapshot ci_artifacts/metrics.prom
+  echo "-- metrics snapshot:"
+  head -40 ci_artifacts/metrics.prom || true
 fi
 
 echo "== [3/3] entry compile-check + multichip dryrun"
