@@ -86,7 +86,27 @@ def _step_monitor(name, examples_per_call=None, tokens_per_call=None,
         tokens_per_step=tokens_per_call,
         flops_per_step=flops_per_call,
         jsonl_path=FLAGS.monitor_jsonl or None,
+        watchdog=_bench_watchdog(),
     )
+
+
+_WATCHDOG = None
+
+
+def _bench_watchdog():
+    """One process-wide watchdog shared by every workload's StepMonitor
+    (armed by FLAGS_watchdog=1; hang monitor rides a daemon thread)."""
+    global _WATCHDOG
+    from paddle_tpu.flags import FLAGS
+
+    if not (FLAGS.monitor and FLAGS.watchdog):
+        return None
+    if _WATCHDOG is None:
+        from paddle_tpu.monitor import Watchdog
+
+        _WATCHDOG = Watchdog()
+        _WATCHDOG.arm()
+    return _WATCHDOG
 
 
 def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None):
@@ -98,6 +118,19 @@ def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None):
 
     `mon`: optional StepMonitor (see _step_monitor) — records per-call
     loss/throughput/MFU telemetry for the timed calls."""
+    from paddle_tpu.flags import FLAGS
+
+    # Two stepping modes.  Measurement mode (default): inside the timed
+    # region only a perf_counter stamp is taken per call; registry/JSONL
+    # writes replay AFTER dt is measured so telemetry cost never lands in
+    # the reported throughput.  Live mode (a watchdog is wired or a
+    # flight dir is armed): mon.step() runs IN the loop — the watchdog
+    # must see NaN/hang at the step it happens and a SIGTERM dump must
+    # name the last completed step, which deferred replay cannot give.
+    # Cost: ~tens of µs of writes per multi-ms call — the price of a
+    # black box; leave watchdog/flight off for measurement-grade runs.
+    live = mon is not None and (mon.watchdog is not None
+                                or bool(FLAGS.flight_dir))
     first_loss = None
     for i in range(max(warmup, 1)):
         (losses,) = exe.run_steps(prog, feed=feed, fetch_list=fetch,
@@ -105,9 +138,6 @@ def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None):
         if i == 0:
             first_loss = float(np.asarray(losses).reshape(-1)[0])
     try:
-        # inside the timed region only a perf_counter stamp is taken per
-        # call; the registry/JSONL writes replay AFTER dt is measured so
-        # telemetry cost never lands in the reported throughput
         stamps = []
         if mon is not None:
             mon.step(now=time.perf_counter())  # arm at region start
@@ -115,7 +145,10 @@ def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None):
         for _ in range(calls):
             (losses,) = exe.run_steps(prog, feed=feed, fetch_list=fetch,
                                       scope=scope)
-            if mon is not None:
+            if live:
+                mon.step(loss=float(np.asarray(losses).reshape(-1)[-1]),
+                         now=time.perf_counter())
+            elif mon is not None:
                 stamps.append((time.perf_counter(), losses))
         dt = time.perf_counter() - t0
         if mon is not None:
@@ -622,6 +655,21 @@ def main():
                         "metrics snapshot to PATH after all workloads "
                         "(plus PATH.jsonl with the JSONL exposition)")
     args = p.parse_args()
+
+    from paddle_tpu.flags import FLAGS
+
+    if FLAGS.monitor:
+        # black box + scrape endpoint for the whole bench run: a SIGTERM'd
+        # or crashed bench leaves flight-*.jsonl under FLAGS_flight_dir,
+        # and FLAGS_monitor_port serves /metrics /health /flight live
+        from paddle_tpu.monitor import flight, serve
+
+        flight.install()
+        try:
+            serve.start()
+        except OSError as e:  # port taken: telemetry must not fail the run
+            print(f"[bench] monitor endpoint disabled: {e}",
+                  file=sys.stderr)
 
     peak = _peak_flops()
     # Default run prints one metric line per workload, each emitted the

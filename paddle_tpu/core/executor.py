@@ -213,8 +213,18 @@ def trace_block(block: fw.Block, env: Dict[str, Any], tctx: TraceContext,
     suffix).
     """
     from .. import amp as _amp
+    from ..flags import FLAGS
 
-    for op in (block.ops if ops is None else ops):
+    op_list = block.ops if ops is None else ops
+    if FLAGS.record_lowered_ops:
+        # executed-op recording (test flag): the op-contract gate asserts
+        # every registered op reaches a trace — trace-time only, so the
+        # run hot path never sees this
+        from ..monitor import flight as _flight
+
+        _flight.note_lowered_ops([op.type for op in op_list])
+
+    for op in op_list:
         lower = registry.get_grad_lowering(op.type) if op.type.endswith("_grad") else None
         if lower is None:
             lower = registry.get(op.type).lower
@@ -1054,6 +1064,17 @@ class Executor:
 
         monitor.counter(
             "executor.cache_hit" if hit else "executor.cache_miss").inc()
+        if len(part_names) != len(key):
+            # parallel-array drift guard: a cache-key component added
+            # without updating the *_KEY_PARTS tuple would silently
+            # mis-attribute recompile causes (zip truncates); telemetry
+            # must not raise, so warn and skip the diff instead
+            from ..log import warning
+
+            warning("recompile detector: %d key parts named but key has "
+                    "%d components — update the _*_KEY_PARTS tuple in "
+                    "core/executor.py", len(part_names), len(key))
+            return
         # mode-qualified stamp: run/run_steps/run_accumulated executables
         # are distinct, so each mode gets its own first compile for free
         stamp = (part_names, key[part_names.index("program-stamp")])
@@ -1072,15 +1093,20 @@ class Executor:
             self._pending_stamp = stamp
             return
         monitor.counter("executor.recompiles").inc()
-        if not vlog_is_on(1):
-            return
         if prev is None:
             changed = ["(no prior lookup of this program)"]
         else:
             changed = [n for n, a, b in zip(part_names, prev, key)
                        if a != b] or ["(key unchanged; cache bypassed)"]
-        vlog(1, "executor recompile: changed key component(s): %s",
-             ", ".join(changed))
+        # the flight recorder keeps the recompile CAUSE history — after a
+        # retrace storm kills a run, the dump names which key component
+        # churned (tools/trace_report.py aggregates these)
+        from ..monitor import flight as _flight
+
+        _flight.record("executor.recompile", changed=changed)
+        if vlog_is_on(1):
+            vlog(1, "executor recompile: changed key component(s): %s",
+                 ", ".join(changed))
 
     def _commit_stamp(self):
         """The compiled entry reached the cache: future misses of this
@@ -1120,9 +1146,17 @@ class Executor:
         """Failed compile/execution: count it so cache_miss vs compiles
         divergence during an incident is explained by executor.errors."""
         if mon:
+            import sys
+
             from .. import monitor
+            from ..monitor import flight as _flight
 
             monitor.counter("executor.errors").inc()
+            exc = sys.exc_info()[1]
+            _flight.record(
+                "executor.error",
+                error=(f"{type(exc).__name__}: {str(exc)[:200]}"
+                       if exc is not None else "unknown"))
 
     def _record_run_metrics(self, mode, t0, compiled_now, feed_vals,
                             np_outs):
@@ -1133,8 +1167,12 @@ class Executor:
         import time as _time
 
         from .. import monitor, profiler
+        from ..monitor import flight as _flight
 
         dt = _time.perf_counter() - t0
+        # span start on the wall clock (flight events ride the unified
+        # timeline, which bridges to the xplane trace clock via epoch)
+        t0_epoch = _time.time() - dt
         monitor.counter(f"executor.{mode}.calls").inc()
         if compiled_now:
             # the miss call's wall time IS trace+compile(+first run);
@@ -1145,9 +1183,13 @@ class Executor:
                 "executor.compile_seconds",
                 buckets=_COMPILE_BUCKETS).observe(dt)
             profiler.add_event("executor.compile", dt)
+            _flight.record("executor.compile", mode=mode, t0=t0_epoch,
+                           dur=round(dt, 6))
         else:
             monitor.histogram("executor.run_seconds").observe(dt)
             profiler.add_event(f"executor.{mode}", dt)
+            _flight.record(f"executor.{mode}", t0=t0_epoch,
+                           dur=round(dt, 6))
         fb = sum(int(getattr(v, "nbytes", 0) or 0) for v in feed_vals)
         if fb:
             monitor.counter("executor.feed_bytes").inc(fb)

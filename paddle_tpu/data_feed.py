@@ -400,6 +400,7 @@ class AsyncExecutor:
         # take + cumulative consumer stall time blocked on the queue — the
         # two numbers that tell "device starved" from "device bound"
         from . import monitor
+        from .monitor import flight as _flight
 
         mon = monitor.enabled()
         if mon:
@@ -409,14 +410,22 @@ class AsyncExecutor:
             stall_ctr = monitor.counter("data_feed.stall_seconds")
             batch_ctr = monitor.counter("data_feed.batches")
 
+        # flight spans only for real stalls (device starved): recording
+        # every sub-ms take would flood the bounded ring with noise
+        _STALL_SPAN_S = 0.005
+
         results: List[List[float]] = []
         done = 0
         while done < len(threads):
             if mon:
                 t0 = _time.perf_counter()
                 item = q.get()
-                stall_ctr.inc(_time.perf_counter() - t0)
+                stall = _time.perf_counter() - t0
+                stall_ctr.inc(stall)
                 depth_gauge.set(q.qsize())
+                if stall > _STALL_SPAN_S:
+                    _flight.record("feed.stall", t0=_time.time() - stall,
+                                   dur=round(stall, 6), depth=q.qsize())
             else:
                 item = q.get()
             if item is end:

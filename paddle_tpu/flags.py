@@ -142,3 +142,33 @@ FLAGS.define(
     "monitor_jsonl", str, "",
     "path for StepMonitor per-step JSONL records (bench.py/trainer "
     "loops); empty keeps records in memory only")
+FLAGS.define(
+    "flight_dir", str, "",
+    "directory for flight-recorder JSONL dumps (monitor/flight.py): on "
+    "crash, SIGTERM/SIGUSR1, or watchdog trip the in-memory event ring "
+    "is written here so a dead run leaves a black box; empty disables "
+    "dumping (the ring still records in memory while FLAGS.monitor is on)")
+FLAGS.define(
+    "flight_events", int, 2048,
+    "capacity of the flight-recorder event ring (bounded memory; oldest "
+    "events are evicted first)")
+FLAGS.define(
+    "monitor_port", int, 0,
+    "TCP port for the scrape endpoint (monitor/serve.py): /metrics "
+    "Prometheus text, /health, /flight last-N events; 0 disables the "
+    "server")
+FLAGS.define(
+    "record_lowered_ops", bool, False,
+    "test/debug flag: the executor trace records every lowered op type "
+    "into the flight recorder (monitor/flight.py lowered_op_types) — the "
+    "op-contract gate asserts registry coverage against this set")
+FLAGS.define(
+    "watchdog", bool, False,
+    "arm the training anomaly watchdog (monitor/watchdog.py) in "
+    "StepMonitor-instrumented loops: NaN/Inf loss, loss-spike z-score, "
+    "throughput collapse, and a hang monitor on a daemon thread")
+FLAGS.define(
+    "watchdog_action", str, "dump",
+    "what a watchdog trip does: 'log' (warn only), 'dump' (warn + write "
+    "a flight record to FLAGS.flight_dir), or 'raise' (dump, then raise "
+    "WatchdogError / interrupt the main thread — for tests)")

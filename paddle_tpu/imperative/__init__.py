@@ -81,6 +81,14 @@ def _run_op(session: EagerSession, block, op):
     opdef = registry.lookup(op.type)
     if opdef is None:
         raise RuntimeError(f"no lowering registered for op {op.type!r}")
+    from ..flags import FLAGS
+
+    if FLAGS.record_lowered_ops:
+        # eager twin of the executor-trace hook: ops exercised only in
+        # dygraph still count toward the op-contract executed set
+        from ..monitor import flight as _flight
+
+        _flight.note_lowered_ops([op.type])
 
     ins = {
         slot: [session.values.get(n) if n else None for n in names]

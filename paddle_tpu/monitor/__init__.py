@@ -3,7 +3,7 @@ platform/profiler.h host ranges + the benchmark/fluid metric prints; none of
 which exposed a scrapeable registry — this is the production-serving gap
 named in ROADMAP.md).
 
-Three pieces:
+Five pieces:
 
   * `registry.py` — a thread-safe metrics registry (counters, gauges,
     histograms with bounded buckets) with Prometheus-text and JSONL
@@ -12,6 +12,15 @@ Three pieces:
   * `step.py` — `StepMonitor`, per-step training telemetry (loss,
     examples/sec, tokens/sec, rolling MFU via `profiler.cost_analysis` or
     analytic FLOPs) written as BENCH-format-compatible JSONL.
+  * `flight.py` — the flight recorder: a bounded ring of structured
+    runtime events (steps, compile/run spans, recompile causes, feed
+    stalls, collective traces) dumped as JSONL on crash / SIGTERM /
+    watchdog trip, so a dead run leaves a black box.
+  * `watchdog.py` — anomaly detection fed by StepMonitor: NaN/Inf loss,
+    loss-spike z-score, throughput collapse, and a hang monitor on a
+    daemon thread; actions log / dump / raise.
+  * `serve.py` — stdlib-http exposition: /metrics (Prometheus), /health,
+    /flight (last-N events), behind FLAGS.monitor_port.
   * instrumentation call-sites live in the runtime itself
     (`core/executor.py` compile/run/recompile, `data_feed.py` queue
     gauges, `inference.py` request histograms, `parallel/distributed.py`
@@ -40,3 +49,7 @@ from .registry import (  # noqa: F401
     enabled,
 )
 from .step import StepMonitor  # noqa: F401
+from . import flight  # noqa: F401
+from .flight import FlightRecorder  # noqa: F401
+from .watchdog import Watchdog, WatchdogError  # noqa: F401
+from . import serve  # noqa: F401

@@ -1,0 +1,273 @@
+"""Flight recorder: a bounded in-memory ring of structured runtime events
+that survives to disk when the run does not.
+
+Reference role: the pieces of the reference that *notice* a dying run —
+check_nan_inf's offending-op naming (operator.cc:943), the profiler's host
+event tables (platform/profiler.cc), and the master service that detects
+dead/stuck workers (go/master/service.go:313) — none of which left an
+artifact when a multi-hour run crashed.  Here every subsystem that already
+emits FLAGS.monitor metrics (executor compile/run/recompile, data-feed
+stalls, trace-time collectives, StepMonitor steps) also appends one
+structured event to a process-wide ring buffer, and the ring is dumped as
+JSONL to FLAGS.flight_dir:
+
+  * on interpreter crash (sys.excepthook chain),
+  * at interpreter exit (atexit; trigger "atexit", cheap and idempotent),
+  * on SIGTERM / SIGUSR1 (SIGUSR1 dumps and continues — a live-run probe;
+    SIGTERM dumps and re-raises so the exit code stays 143),
+  * on watchdog trip (monitor/watchdog.py calls dump()).
+
+Every dump starts with one header line: config/flags snapshot, argv, jax
+backend, the trigger, and the LAST COMPLETED STEP (maintained by
+StepMonitor via note_step) — the first three questions of any postmortem.
+
+Gating matches the PR-1 registry: `record()` is a no-op unless
+FLAGS.monitor is on (call sites pay one flag read); the module holds no
+threads and opens no files until install()/dump().
+
+The module also owns the executed-op set for the op-contract gate
+(FLAGS.record_lowered_ops): trace-time recording of every op type the
+executor lowers, exposed via lowered_op_types().
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .registry import _json_safe, enabled
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of event dicts + JSONL dump."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            from ..flags import FLAGS
+
+            capacity = FLAGS.flight_events
+        # RLock, not Lock: the SIGTERM/SIGUSR1 handlers run on the main
+        # thread and call record()/dump(); if the signal lands while that
+        # same thread is inside record() a plain lock would deadlock the
+        # dying process instead of dumping
+        self._lock = threading.RLock()
+        self._ring: "collections.deque[dict]" = collections.deque(
+            maxlen=max(16, int(capacity)))
+        self._seq = itertools.count(1)
+        self._dropped = 0
+        # postmortem header state (last completed step, last loss), kept
+        # outside the ring so eviction can't lose it
+        self.last_step: Optional[int] = None
+        self.last_loss: Optional[float] = None
+        self.last_step_ts: Optional[float] = None
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one structured event.  `t0`/`dur` (epoch seconds /
+        seconds) mark span events — the unified-timeline export renders
+        those as chrome-trace slices; everything else is an instant."""
+        ev = {"seq": next(self._seq), "ts": round(time.time(), 6),
+              "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(ev)
+
+    def note_step(self, step: int, loss: Optional[float] = None) -> None:
+        """StepMonitor marks a completed step (header state for dumps)."""
+        self.last_step = step
+        if loss is not None:
+            self.last_loss = float(loss)
+        self.last_step_ts = time.time()
+
+    def events(self, n: Optional[int] = None,
+               kind: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self._ring)
+        if kind is not None:
+            evs = [e for e in evs if e["kind"] == kind
+                   or e["kind"].startswith(kind + ".")]
+        if n is not None:
+            evs = evs[-n:]
+        return evs
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._dropped = 0
+        self.last_step = self.last_loss = self.last_step_ts = None
+
+    # -- dumping ---------------------------------------------------------
+    def header(self, trigger: str, extra: Optional[dict] = None) -> dict:
+        """The postmortem header: what run, how configured, why dumped."""
+        import sys
+
+        from ..flags import FLAGS
+
+        flag_defs = object.__getattribute__(FLAGS, "_defs")
+        hdr = {
+            "kind": "flight.header",
+            "ts": round(time.time(), 6),
+            "trigger": trigger,
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "last_step": self.last_step,
+            "last_loss": self.last_loss,
+            "last_step_ts": self.last_step_ts,
+            "events_dropped": self._dropped,
+            "flags": {n: getattr(FLAGS, n) for n in sorted(flag_defs)},
+        }
+        try:  # backend info must never block a crash dump
+            import jax
+
+            hdr["jax_backend"] = jax.default_backend()
+            hdr["jax_device_count"] = jax.device_count()
+        except Exception:
+            pass
+        if extra:
+            hdr.update(extra)
+        return hdr
+
+    def dump(self, path: Optional[str] = None, trigger: str = "manual",
+             extra: Optional[dict] = None) -> Optional[str]:
+        """Write header + every ring event as JSONL.  `path` defaults to
+        FLAGS.flight_dir/flight-<pid>-<trigger>.jsonl; returns the path
+        written, or None when no destination is configured.  Never raises
+        (a crash dump must not mask the crash)."""
+        try:
+            if path is None:
+                from ..flags import FLAGS
+
+                d = FLAGS.flight_dir
+                if not d:
+                    return None
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(
+                    d, f"flight-{os.getpid()}-{trigger}.jsonl")
+            with self._lock:
+                evs = list(self._ring)
+            with open(path, "w") as f:
+                f.write(json.dumps(_json_safe(
+                    self.header(trigger, extra))) + "\n")
+                for ev in evs:
+                    f.write(json.dumps(_json_safe(ev)) + "\n")
+            return path
+        except Exception:
+            return None
+
+
+_default = FlightRecorder()
+
+
+def default_recorder() -> FlightRecorder:
+    return _default
+
+
+def record(kind: str, **fields) -> None:
+    """Module-level append, gated on FLAGS.monitor (one flag read when
+    telemetry is off — same contract as the PR-1 registry helpers)."""
+    if enabled():
+        _default.record(kind, **fields)
+
+
+def note_step(step: int, loss: Optional[float] = None) -> None:
+    if enabled():
+        _default.note_step(step, loss)
+
+
+def dump(path: Optional[str] = None, trigger: str = "manual",
+         extra: Optional[dict] = None) -> Optional[str]:
+    return _default.dump(path, trigger, extra)
+
+
+# ---------------------------------------------------------------------------
+# Crash / signal / exit hooks
+# ---------------------------------------------------------------------------
+
+_installed = False
+_prev_excepthook = None
+
+
+def install(signals: bool = True) -> None:
+    """Arm the black box: dump on unhandled exception, at exit, and on
+    SIGTERM/SIGUSR1.  Idempotent; signal handlers are only installed from
+    the main thread (signal module restriction).  A dead run then leaves
+    flight-<pid>-<trigger>.jsonl under FLAGS.flight_dir instead of
+    silence."""
+    global _installed, _prev_excepthook
+    if _installed:
+        return
+    _installed = True
+    import atexit
+    import sys
+
+    _prev_excepthook = sys.excepthook
+
+    def _excepthook(tp, val, tb):
+        _default.record("crash", error=f"{tp.__name__}: {val}")
+        _default.dump(trigger="crash",
+                      extra={"error": f"{tp.__name__}: {val}"})
+        (_prev_excepthook or sys.__excepthook__)(tp, val, tb)
+
+    sys.excepthook = _excepthook
+    atexit.register(lambda: _default.dump(trigger="atexit"))
+
+    if not signals:
+        return
+    try:
+        import signal
+
+        def _on_sigterm(signum, frame):
+            _default.record("signal", signum=int(signum), name="SIGTERM")
+            _default.dump(trigger="sigterm")
+            # restore + re-raise so the exit code is the conventional 143
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        def _on_sigusr1(signum, frame):
+            _default.record("signal", signum=int(signum), name="SIGUSR1")
+            _default.dump(trigger="sigusr1")  # probe: dump and continue
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        if hasattr(signal, "SIGUSR1"):
+            signal.signal(signal.SIGUSR1, _on_sigusr1)
+    except (ValueError, OSError):
+        # not the main thread / restricted env: excepthook+atexit still armed
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Executed-op recording (FLAGS.record_lowered_ops — the op-contract gate)
+# ---------------------------------------------------------------------------
+
+_lowered_ops: set = set()
+_lowered_lock = threading.Lock()
+
+
+def note_lowered_ops(op_types) -> None:
+    """Called by the executor trace (core/executor.py trace_block) and the
+    imperative dispatcher for every op they lower, when
+    FLAGS.record_lowered_ops is on.  Accumulates the process-wide executed
+    set (always) and appends a flight event naming NEW types (only while
+    FLAGS.monitor is on, like every other call site)."""
+    with _lowered_lock:
+        new = [t for t in op_types if t not in _lowered_ops]
+        _lowered_ops.update(new)
+    if new and enabled():
+        _default.record("ops.lowered", new_types=sorted(set(new)))
+
+
+def lowered_op_types() -> frozenset:
+    """Every op type lowered in this process (under the recording flag)."""
+    with _lowered_lock:
+        return frozenset(_lowered_ops)
+
+
+def reset_lowered_ops() -> None:
+    with _lowered_lock:
+        _lowered_ops.clear()

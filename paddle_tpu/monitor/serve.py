@@ -1,0 +1,140 @@
+"""Scrape endpoint for long runs: stdlib http.server, no dependencies.
+
+Reference role: the reference had VLOG counters and profiler tables but no
+way to ASK a live training job how it was doing; production serving (the
+ROADMAP north star) needs scrape-based monitoring.  Three endpoints:
+
+  * /metrics — Prometheus text exposition of the default registry
+    (PR-1 counters/gauges/histograms; scrape-ready);
+  * /health  — JSON {status, last_step, last_loss, seconds_since_step};
+    returns 503 when a step monitor exists but nothing stepped for 10
+    minutes (a load balancer can evict a hung trainer);
+  * /flight  — last-N flight-recorder events as JSONL (?n=100, ?kind=...).
+
+Start with `start(port)` (FLAGS.monitor_port; port 0 picks an ephemeral
+port — tests read it from the return value).  The server runs daemon
+threads and holds no locks while rendering, so a wedged training loop can
+still be probed — that is the point.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import flight as _flight
+from . import registry as _registry
+
+_server: Optional[ThreadingHTTPServer] = None
+_thread: Optional[threading.Thread] = None
+
+HEALTH_STALL_S = 600.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-monitor/1.0"
+
+    def log_message(self, fmt, *args):  # quiet: route through vlog(2)
+        from ..log import vlog
+
+        vlog(2, "monitor.serve: " + fmt, *args)
+
+    def _send(self, code: int, body: str, ctype: str = "text/plain"):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype + "; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        try:
+            url = urlparse(self.path)
+            if url.path in ("/metrics", "/"):
+                self._send(
+                    200, _registry.default_registry().prometheus_text())
+            elif url.path == "/health":
+                self._health()
+            elif url.path == "/flight":
+                q = parse_qs(url.query)
+                n = int(q.get("n", ["100"])[0])
+                kind = q.get("kind", [None])[0]
+                rec = _flight.default_recorder()
+                lines = [json.dumps(_registry._json_safe(
+                    rec.header("serve")))]
+                lines += [json.dumps(_registry._json_safe(e))
+                          for e in rec.events(n=n, kind=kind)]
+                self._send(200, "\n".join(lines) + "\n",
+                           "application/jsonl")
+            else:
+                self._send(404, "not found: try /metrics /health /flight\n")
+        except Exception as e:  # serving must not kill the run
+            try:
+                self._send(500, f"error: {type(e).__name__}: {e}\n")
+            except OSError:
+                pass
+
+    def _health(self):
+        import time
+
+        rec = _flight.default_recorder()
+        since = (time.time() - rec.last_step_ts
+                 if rec.last_step_ts is not None else None)
+        stalled = since is not None and since > HEALTH_STALL_S
+        body = {
+            "status": "stalled" if stalled else "ok",
+            "monitor": _registry.enabled(),
+            "last_step": rec.last_step,
+            "last_loss": rec.last_loss,
+            "seconds_since_step":
+                round(since, 1) if since is not None else None,
+        }
+        self._send(503 if stalled else 200,
+                   json.dumps(_registry._json_safe(body)) + "\n",
+                   "application/json")
+
+
+def start(port: Optional[int] = None,
+          host: str = "127.0.0.1") -> Optional[int]:
+    """Start the exposition server (idempotent); returns the bound port,
+    or None when disabled (port 0/unset and FLAGS.monitor_port unset).
+
+    Binds loopback by default: /flight and /health expose argv and the
+    full flags snapshot, which must not be readable by arbitrary network
+    peers on a shared host — pass host="0.0.0.0" explicitly (behind your
+    scrape network's ACLs) to export off-box."""
+    global _server, _thread
+    if _server is not None:
+        return _server.server_address[1]
+    if port is None:
+        from ..flags import FLAGS
+
+        port = FLAGS.monitor_port
+        if not port:
+            return None
+    srv = ThreadingHTTPServer((host, int(port)), _Handler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever,
+                         name="paddle-tpu-monitor-serve", daemon=True)
+    t.start()
+    _server, _thread = srv, t
+    bound = srv.server_address[1]
+    from ..log import vlog
+
+    vlog(1, "monitor.serve: listening on %s:%d "
+            "(/metrics /health /flight)", host, bound)
+    return bound
+
+
+def stop() -> None:
+    global _server, _thread
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()
+        _server = None
+    if _thread is not None:
+        _thread.join(timeout=2.0)
+        _thread = None

@@ -20,8 +20,15 @@ import time
 from typing import Optional, Sequence
 
 from . import registry as _registry
+from . import flight as _flight
 
 import itertools as _itertools
+
+# perf_counter -> wall-clock bridge: step() stamps with perf_counter (so
+# timed loops can replay cheaply), but flight spans live on the epoch
+# clock the unified timeline uses; both clocks tick at the same rate, so
+# one offset sampled at import converts
+_EPOCH_OFFSET = time.time() - time.perf_counter()
 
 # distinguishes records when several StepMonitors append to one JSONL
 # file (bench workloads, run_guarded retries restarting step numbers)
@@ -52,10 +59,13 @@ class StepMonitor:
         jsonl_path: Optional[str] = None,
         window: int = 20,
         registry: Optional[_registry.MetricsRegistry] = None,
+        watchdog=None,
     ):
         """name: metric prefix ("<name>.step" in records); window: rolling
         MFU/rate horizon in steps; cost_from: args for
-        profiler.cost_analysis, evaluated lazily on the first step()."""
+        profiler.cost_analysis, evaluated lazily on the first step();
+        watchdog: an optional monitor.watchdog.Watchdog fed one
+        observe_step per step (NaN/spike/collapse detection in-band)."""
         self.name = name
         self.examples_per_step = examples_per_step
         self.tokens_per_step = tokens_per_step
@@ -71,6 +81,7 @@ class StepMonitor:
         self._step = 0
         self._last_t: Optional[float] = None
         self._reg = registry or _registry.default_registry()
+        self.watchdog = watchdog
         self.run_id = next(_RUN_SEQ)
         self.records = []  # in-memory mirror (bounded by window*50)
         self._records_cap = max(1, window) * 50
@@ -183,6 +194,16 @@ class StepMonitor:
         if rolling_mfu is not None:
             self._reg.gauge(f"{self.name}.rolling_mfu").set(rolling_mfu)
 
+        # black box: the flight recorder keeps the last-completed-step
+        # header state every dump leads with
+        if _registry.enabled():
+            _flight.default_recorder().note_step(self._step, loss)
+            _flight.record("step", name=self.name, step=self._step,
+                           t0=now - dt + _EPOCH_OFFSET,
+                           dur=round(dt, 6),
+                           loss=(None if loss is None
+                                 else round(float(loss), 6)))
+
         self.records.append(rec)
         if len(self.records) > self._records_cap:
             del self.records[: len(self.records) - self._records_cap]
@@ -203,6 +224,12 @@ class StepMonitor:
                 warning("StepMonitor: cannot write %s (%s); per-step "
                         "JSONL disabled", self.jsonl_path, e)
                 self.jsonl_path = None
+        # the watchdog goes LAST: with action='raise' the fatal step's
+        # record must already be in records/JSONL when the trip fires —
+        # otherwise the artifact ends one step before the failure
+        if self.watchdog is not None:
+            self.watchdog.observe_step(
+                self._step, None if loss is None else float(loss), dt)
         return rec
 
     def summary(self) -> dict:

@@ -1,0 +1,236 @@
+"""Anomaly watchdog: the framework notices a sick training run by itself.
+
+Reference role: FLAGS_check_nan_inf validates op outputs (operator.cc:943)
+and the master service marks timed-out workers dead
+(go/master/service.go:313) — but nothing in the reference watched the LOSS
+CURVE or the step clock.  This watchdog is fed by StepMonitor (one
+`observe_step` per completed step) and detects:
+
+  * NaN/Inf loss — the run is already dead, say so at the step it died;
+  * loss spike — z-score of the new loss against a rolling window
+    (mean/std over the last `window` finite losses);
+  * throughput collapse — a step taking `collapse_factor`× the rolling
+    median step time (feed starvation, a recompile storm, a sick host);
+  * hang — NO step completed within `hang_factor`× the rolling median,
+    checked from a daemon thread (the in-band checks above can only run
+    when a step completes; a hang by definition never reaches them).
+
+Trip actions (pluggable, FLAGS.watchdog_action default):
+  * "log"   — one warning per trip kind (rate-limited), flight event;
+  * "dump"  — "log" + dump the flight record (trigger "watchdog") so the
+              black box lands on disk while the run is still sick;
+  * "raise" — "dump" + raise WatchdogError in the training thread (hang
+              trips interrupt the main thread instead — for tests/CI).
+
+An `on_trip(trip)` callback overrides the action entirely (serving hosts
+wire pagers there).  Cost when FLAGS.monitor is off: nothing — StepMonitor
+only calls observe_step from its already-gated path, and arm() refuses to
+start the hang thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Callable, List, Optional
+
+from . import flight as _flight
+from . import registry as _registry
+
+
+class WatchdogError(RuntimeError):
+    """A watchdog trip with action='raise'."""
+
+
+class Trip:
+    """One detected anomaly (also the flight-event payload)."""
+
+    __slots__ = ("kind", "step", "detail", "ts")
+
+    def __init__(self, kind: str, step: Optional[int], detail: str):
+        self.kind = kind
+        self.step = step
+        self.detail = detail
+        self.ts = time.time()
+
+    def __repr__(self):
+        return f"Watchdog trip [{self.kind}] at step {self.step}: {self.detail}"
+
+
+class Watchdog:
+    def __init__(
+        self,
+        action: Optional[str] = None,
+        window: int = 50,
+        min_steps: int = 8,
+        z_threshold: float = 8.0,
+        collapse_factor: float = 5.0,
+        hang_factor: float = 10.0,
+        hang_floor_s: float = 5.0,
+        on_trip: Optional[Callable[[Trip], None]] = None,
+    ):
+        """window: rolling horizon (losses and step times); min_steps:
+        suppress spike/collapse/hang until this many steps are observed
+        (compile-time steps would false-trip everything); hang_floor_s:
+        never call a hang before this many wall seconds, whatever the
+        median says (guards tiny-step test loops)."""
+        if action is None:
+            from ..flags import FLAGS
+
+            action = FLAGS.watchdog_action
+        if action not in ("log", "dump", "raise"):
+            raise ValueError(f"watchdog action {action!r} "
+                             "(want log|dump|raise)")
+        self.action = action
+        self.on_trip = on_trip
+        self.min_steps = min_steps
+        self.z_threshold = z_threshold
+        self.collapse_factor = collapse_factor
+        self.hang_factor = hang_factor
+        self.hang_floor_s = hang_floor_s
+        self._losses: "collections.deque[float]" = collections.deque(
+            maxlen=max(4, window))
+        self._dts: "collections.deque[float]" = collections.deque(
+            maxlen=max(4, window))
+        self._steps = 0
+        self._last_step_t: Optional[float] = None
+        self._lock = threading.Lock()
+        self.trips: List[Trip] = []
+        self._warned_kinds: set = set()
+        # in-band trips latch once per kind: a run whose loss is stuck at
+        # NaN must not rewrite the flight dump (and grow self.trips) on
+        # every remaining step; the hang monitor has its own per-episode
+        # latch (_hang_tripped) so recovered-then-hung-again still fires
+        self._fired_kinds: set = set()
+        self._hang_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._hang_tripped = False
+
+    # -- in-band checks (called by StepMonitor per completed step) -------
+    def observe_step(self, step: int, loss: Optional[float],
+                     dt: float) -> Optional[Trip]:
+        """Feed one completed step; returns the Trip if one fired (after
+        the action ran — 'raise' raises instead of returning)."""
+        with self._lock:
+            self._steps += 1
+            self._last_step_t = time.monotonic()
+            self._hang_tripped = False  # progress: re-arm the hang trip
+            prev_losses = list(self._losses)
+            median_dt = self._median(self._dts)
+            self._dts.append(dt)
+            warmed = self._steps > self.min_steps
+
+        trip = None
+        if loss is not None and not math.isfinite(loss):
+            trip = Trip("nan_loss", step,
+                        f"non-finite loss {loss!r} at step {step}")
+        elif loss is not None and warmed and len(prev_losses) >= 4:
+            mean = sum(prev_losses) / len(prev_losses)
+            var = sum((x - mean) ** 2
+                      for x in prev_losses) / len(prev_losses)
+            std = math.sqrt(var)
+            if std > 0:
+                z = (loss - mean) / std
+                if z > self.z_threshold:
+                    trip = Trip(
+                        "loss_spike", step,
+                        f"loss {loss:.6g} is {z:.1f} sigma above the "
+                        f"rolling mean {mean:.6g} (std {std:.3g}, "
+                        f"window {len(prev_losses)})")
+        if (trip is None and warmed and median_dt is not None
+                and median_dt > 0 and dt > self.collapse_factor * median_dt):
+            trip = Trip(
+                "throughput_collapse", step,
+                f"step took {dt:.3f}s vs rolling median {median_dt:.3f}s "
+                f"({dt / median_dt:.1f}x, threshold "
+                f"{self.collapse_factor:g}x)")
+        if loss is not None and math.isfinite(loss):
+            with self._lock:
+                self._losses.append(float(loss))
+        if trip is not None:
+            if trip.kind in self._fired_kinds:
+                return None  # already reported this failure mode
+            self._fired_kinds.add(trip.kind)
+            self._fire(trip)
+        return trip
+
+    # -- hang monitor (daemon thread) ------------------------------------
+    def arm(self, poll_interval_s: float = 1.0) -> bool:
+        """Start the hang monitor.  Refuses (returns False) when
+        FLAGS.monitor is off — the watchdog rides the telemetry gate."""
+        if not _registry.enabled():
+            return False
+        if self._hang_thread is not None and self._hang_thread.is_alive():
+            return True
+        self._stop.clear()
+        self._hang_thread = threading.Thread(
+            target=self._hang_loop, args=(poll_interval_s,),
+            name="paddle-tpu-watchdog", daemon=True)
+        self._hang_thread.start()
+        return True
+
+    def disarm(self) -> None:
+        self._stop.set()
+        t = self._hang_thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._hang_thread = None
+
+    def _hang_loop(self, poll_interval_s: float) -> None:
+        while not self._stop.wait(poll_interval_s):
+            with self._lock:
+                last_t = self._last_step_t
+                median_dt = self._median(self._dts)
+                steps = self._steps
+                tripped = self._hang_tripped
+            step = _flight.default_recorder().last_step
+            if (tripped or last_t is None or steps <= self.min_steps
+                    or median_dt is None):
+                continue
+            idle = time.monotonic() - last_t
+            limit = max(self.hang_factor * median_dt, self.hang_floor_s)
+            if idle > limit:
+                with self._lock:
+                    self._hang_tripped = True  # once per hang episode
+                self._fire(Trip(
+                    "hang", step,
+                    f"no step completed for {idle:.1f}s (limit {limit:.1f}s "
+                    f"= max({self.hang_factor:g} x median "
+                    f"{median_dt:.3f}s, floor {self.hang_floor_s:g}s))"),
+                    from_hang_thread=True)
+
+    # -- trip plumbing ----------------------------------------------------
+    @staticmethod
+    def _median(xs) -> Optional[float]:
+        s = sorted(xs)
+        return s[len(s) // 2] if s else None
+
+    def _fire(self, trip: Trip, from_hang_thread: bool = False) -> None:
+        self.trips.append(trip)
+        _flight.record("watchdog.trip", trip=trip.kind, step=trip.step,
+                       detail=trip.detail)
+        if _registry.enabled():
+            _registry.counter(f"watchdog.trips.{trip.kind}").inc()
+        if self.on_trip is not None:
+            self.on_trip(trip)
+            return
+        from ..log import warning
+
+        if trip.kind not in self._warned_kinds:  # one warn per trip kind
+            self._warned_kinds.add(trip.kind)
+            warning("%s", trip)
+        if self.action in ("dump", "raise"):
+            _flight.dump(trigger="watchdog",
+                         extra={"trip": trip.kind, "trip_step": trip.step,
+                                "trip_detail": trip.detail})
+        if self.action == "raise":
+            if from_hang_thread:
+                # can't raise into the training thread from here; the
+                # conventional kill-for-tests is interrupting main
+                import _thread
+
+                _thread.interrupt_main()
+            else:
+                raise WatchdogError(str(trip))

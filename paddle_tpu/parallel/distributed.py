@@ -109,6 +109,13 @@ def _count_collective(kind: str, x):
     monitor.counter(f"collective.{kind}.ops").inc()
     if nbytes:
         monitor.counter(f"collective.{kind}.bytes").inc(nbytes)
+    # trace-time collective record: after a hang in a collective, the
+    # flight dump shows WHICH collectives the compiled program contains
+    # and their per-shard payloads
+    from ..monitor import flight as _flight
+
+    _flight.record("collective.trace", op=kind, bytes=nbytes,
+                   shape=str(shape), dtype=str(dtype))
 
 
 def all_reduce(x, axis_name="data", op="sum"):

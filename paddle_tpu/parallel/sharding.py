@@ -294,3 +294,21 @@ def transformer_tp_rules(model_axis="model"):
         (r"ffn_out_w_\d+", P(model_axis, None)),
         (r"predict_w", P(None, model_axis)),
     ]
+
+
+def bert_tp_rules(model_axis="model"):
+    """Tensor-parallel PartitionSpecs for the bundled BERT encoder
+    (models/bert.py).  Its attention rides the same multi_head_attention
+    as the transformer (stable attn_*_w names: qkv column-parallel, out
+    row-parallel); the word/sentence embedding tables split on the vocab
+    dim.  The ffn uses auto-named layers.fc weights, so it stays
+    replicated under tp — its optimizer moments shard over the data axis
+    via the plan's zero_stage instead (Megatron attention + ZeRO ffn)."""
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r"(word|sent)_embedding", P(model_axis, None)),
+        (r"attn_qkv_w_\d+", P(None, model_axis)),
+        (r"attn_[qkv]_w_\d+", P(None, model_axis)),
+        (r"attn_out_w_\d+", P(model_axis, None)),
+    ]

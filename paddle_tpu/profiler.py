@@ -95,13 +95,23 @@ def host_events(sorted_key="total"):
     return _events.summary(sorted_key)
 
 
+# clock bridge for the unified timeline: xplane event timestamps are
+# relative to the trace-session start, flight-recorder events are epoch
+# seconds — stamping time.time() at start_trace lets the export put both
+# on one axis (skew = the microseconds start_trace takes to return)
+_trace_start_epoch: Optional[float] = None
+_trace_dir: Optional[str] = None
+
+
 def start_profiler(state="All", trace_dir: Optional[str] = None):
-    global _profiling
+    global _profiling, _trace_start_epoch, _trace_dir
     _profiling = True
     _events.reset()
     if trace_dir:
         import jax
 
+        _trace_dir = trace_dir
+        _trace_start_epoch = time.time()
         jax.profiler.start_trace(trace_dir)
 
 
@@ -186,36 +196,26 @@ def xplane_op_table(trace_dir: str, top_k: int = 30):
     (the reference's profiler table role, device-side).  Returns rows of
     (op_group, total_seconds) sorted descending; op names collapse to
     their fusion-group prefix.  Requires a trace captured with
-    start_profiler(trace_dir=...) around device work."""
-    import glob
+    start_profiler(trace_dir=...) around device work.  Decodes xplane.pb
+    natively (paddle_tpu.xplane) — no TensorFlow proto dependency."""
     from collections import defaultdict
 
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception as e:  # pragma: no cover - env without tf protos
-        raise RuntimeError(
-            "xplane_op_table needs the tensorflow xplane protos "
-            f"(unavailable: {e}); view the trace in TensorBoard instead")
+    from . import xplane as _xp
 
-    files = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    files = _xp.find_xplane_files(trace_dir)
     if not files:
         raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
     agg = defaultdict(float)
     for path in files:
-        space = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            space.ParseFromString(f.read())
+        space = _xp.parse_xspace_file(path)
         for plane in space.planes:
             if "TPU" not in plane.name and "GPU" not in plane.name:
                 continue
-            ev_names = {i: m.name for i, m in plane.event_metadata.items()}
             for line in plane.lines:
                 if "Ops" not in line.name or "Async" in line.name:
                     continue
                 for ev in line.events:
-                    name = ev_names.get(ev.metadata_id, "?")
-                    key = name.split(".")[0]
-                    agg[key] += ev.duration_ps / 1e12
+                    agg[ev.name.split(".")[0]] += ev.duration_ps / 1e12
     rows = sorted(agg.items(), key=lambda kv: -kv[1])[:top_k]
     return rows
 
@@ -230,50 +230,160 @@ def print_op_table(trace_dir: str, top_k: int = 30):
     return rows
 
 
-def export_chrome_trace(trace_dir: str, out_path: str, max_events=50000):
-    """Convert a captured xplane trace to chrome://tracing JSON (the
-    reference's tools/timeline.py role over its protobuf profile).  Each
-    device line becomes a tid; op events carry their XLA names."""
-    import glob
-    import json as _json
+def _xplane_chrome_events(trace_dir: str, max_events: int,
+                          first_pid: int = 100):
+    """Chrome-trace events (ts in trace-relative microseconds) for every
+    xplane plane under `trace_dir`: one pid per plane (per-device tracks),
+    one tid per line."""
+    from . import xplane as _xp
 
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception as e:  # pragma: no cover
-        raise RuntimeError(
-            f"export_chrome_trace needs the xplane protos ({e})")
-
-    files = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    files = _xp.find_xplane_files(trace_dir)
     if not files:
         raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
     events = []
-    pid = 0
+    n_slices = 0
+    pid = first_pid - 1
     for path in files:
-        space = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            space.ParseFromString(f.read())
+        space = _xp.parse_xspace_file(path)
         for plane in space.planes:
+            if not plane.lines:
+                continue
             pid += 1
             events.append({
                 "name": "process_name", "ph": "M", "pid": pid,
-                "args": {"name": plane.name}})
-            ev_names = {i: m.name for i, m in plane.event_metadata.items()}
+                "args": {"name": plane.name,
+                         "source": "xplane",
+                         "device": _xp.is_device_plane(plane.name)}})
             for tid, line in enumerate(plane.lines):
                 events.append({
                     "name": "thread_name", "ph": "M", "pid": pid,
                     "tid": tid, "args": {"name": line.name}})
                 base = line.timestamp_ns
                 for ev in line.events:
-                    if len(events) >= max_events:
+                    if n_slices >= max_events:
                         break
                     events.append({
-                        "name": ev_names.get(ev.metadata_id, "?")[:96],
+                        "name": ev.name[:96],
                         "ph": "X",
                         "pid": pid,
                         "tid": tid,
                         "ts": (base + ev.offset_ps / 1000) / 1000.0,
                         "dur": ev.duration_ps / 1e6,
                     })
+                    n_slices += 1
+    return events
+
+
+def export_chrome_trace(trace_dir: str, out_path: str, max_events=50000):
+    """Convert a captured xplane trace to chrome://tracing JSON (the
+    reference's tools/timeline.py role over its protobuf profile).  Each
+    plane becomes a pid, each line a tid; op events carry their XLA
+    names.  Decoded natively — no TensorFlow proto dependency."""
+    import json as _json
+
+    events = _xplane_chrome_events(trace_dir, max_events)
     with open(out_path, "w") as f:
         _json.dump({"traceEvents": events}, f)
+    return len(events)
+
+
+# ---------------------------------------------------------------------------
+# Unified host+device timeline (tentpole of the flight-recorder PR): ONE
+# chrome-trace file holding the flight recorder's host spans (executor
+# compile/run, feed stalls, steps, collectives) and the XLA xplane device
+# ops, on a shared clock.  The reference needed two tools (timeline.py for
+# CUPTI + the host event table print); here one file answers "was the chip
+# idle while the host stalled?" by inspection.
+# ---------------------------------------------------------------------------
+
+# flight-event kind prefix -> stable tid on the host process (chrome sorts
+# tids numerically; keep executor on top)
+_HOST_TIDS = (
+    ("executor", 0), ("step", 1), ("feed", 2), ("collective", 3),
+)
+
+
+def _host_tid(kind: str):
+    for prefix, tid in _HOST_TIDS:
+        if kind == prefix or kind.startswith(prefix + "."):
+            return tid
+    return len(_HOST_TIDS)  # misc
+
+
+def _flight_chrome_events(flight_events, trace_start_epoch, pid=1):
+    """Flight-recorder events as chrome slices/instants, on the xplane
+    clock (trace-relative microseconds)."""
+    events = [{
+        "name": "process_name", "ph": "M", "pid": pid,
+        "args": {"name": "paddle_tpu host (flight)", "source": "flight"}}]
+    for prefix, tid in _HOST_TIDS + (("misc", len(_HOST_TIDS)),):
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"name": f"host:{prefix}"}})
+    for ev in flight_events:
+        kind = ev.get("kind", "?")
+        tid = _host_tid(kind)
+        args = {k: v for k, v in ev.items()
+                if k not in ("kind", "t0", "dur", "seq", "ts")
+                and isinstance(v, (int, float, str, bool))}
+        if "t0" in ev and "dur" in ev:  # span
+            events.append({
+                "name": kind, "ph": "X", "pid": pid, "tid": tid,
+                "ts": (ev["t0"] - trace_start_epoch) * 1e6,
+                "dur": float(ev["dur"]) * 1e6,
+                "args": args,
+            })
+        else:  # instant (recompile, watchdog trip, signal, ...)
+            events.append({
+                "name": kind, "ph": "i", "s": "p", "pid": pid, "tid": tid,
+                "ts": (ev.get("ts", trace_start_epoch)
+                       - trace_start_epoch) * 1e6,
+                "args": args,
+            })
+    return events
+
+
+def export_unified_chrome_trace(out_path: str,
+                                trace_dir: Optional[str] = None,
+                                flight=None,
+                                trace_start_epoch: Optional[float] = None,
+                                max_events: int = 50000):
+    """Merge host flight spans + xplane device ops into one chrome trace.
+
+    trace_dir defaults to the directory of the last start_profiler
+    (trace_dir=...) call; trace_start_epoch to the time.time() stamped
+    there (the clock bridge).  `flight` defaults to the process flight
+    recorder.  Device planes keep one pid per plane — per-device tracks.
+    The flight header + raw events are embedded under the top-level
+    "flight" key (chrome ignores it; tools/trace_report.py reads it)."""
+    import json as _json
+
+    from .monitor import flight as _flight
+
+    rec = flight if flight is not None else _flight.default_recorder()
+    trace_dir = trace_dir if trace_dir is not None else _trace_dir
+    epoch = (trace_start_epoch if trace_start_epoch is not None
+             else _trace_start_epoch)
+    fl_events = rec.events()
+    if epoch is None:
+        # no trace session: host-only timeline anchored at the first event
+        spans = [e["t0"] for e in fl_events if "t0" in e]
+        epoch = min(spans) if spans else (
+            min((e.get("ts", 0.0) for e in fl_events), default=0.0))
+
+    events = _flight_chrome_events(fl_events, epoch)
+    if trace_dir:
+        events += _xplane_chrome_events(trace_dir, max_events)
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ns",
+        "flight": {
+            "header": rec.header("unified_trace"),
+            "trace_start_epoch": epoch,
+            "events": fl_events,
+        },
+    }
+    from .monitor.registry import _json_safe
+
+    with open(out_path, "w") as f:
+        _json.dump(_json_safe(doc), f)
     return len(events)

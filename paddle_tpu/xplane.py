@@ -1,0 +1,211 @@
+"""Minimal, dependency-free XPlane (.xplane.pb) reader.
+
+jax.profiler writes device traces as XSpace protobufs
+(tensorflow/tsl/profiler/protobuf/xplane.proto).  The stock readers need
+the TensorFlow proto stubs — a multi-GB dependency this framework refuses
+to require just to open its own trace files — so this module decodes the
+wire format directly: the XSpace schema is tiny (planes > lines > events,
+plus an id->name event-metadata map) and protobuf wire encoding is four
+primitives (varint, fixed32/64, length-delimited).
+
+Only the fields the profiler tooling consumes are decoded; unknown fields
+are skipped by wire type, so schema growth upstream stays compatible.
+
+    spaces = [parse_xspace_file(p) for p in find_xplane_files(trace_dir)]
+    for plane in spaces[0].planes:
+        for line in plane.lines:            # one device stream / host thread
+            for ev in line.events:          # name, offset_ps, duration_ps
+                ...
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List
+
+
+# -- protobuf wire primitives -----------------------------------------------
+
+
+def _varint(buf: bytes, i: int):
+    """Returns (value, next_index).  Unsigned; int64 fields that need sign
+    are reinterpreted by the caller."""
+    shift = 0
+    out = 0
+    n = len(buf)
+    while True:
+        if i >= n:
+            # a run killed mid-trace-write leaves a truncated file — the
+            # postmortem input this parser exists for; name the condition
+            raise ValueError("truncated varint (corrupt/truncated "
+                             "xplane file)")
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint overflow (corrupt xplane file)")
+
+
+def _signed(v: int) -> int:
+    """Two's-complement reinterpretation of a 64-bit varint."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    Length-delimited values come back as memoryview-compatible bytes."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:  # varint
+            v, i = _varint(buf, i)
+        elif wt == 1:  # fixed64
+            v, i = buf[i:i + 8], i + 8
+        elif wt == 2:  # length-delimited
+            ln, i = _varint(buf, i)
+            v, i = buf[i:i + ln], i + ln
+            if len(v) != ln:
+                raise ValueError("truncated field (corrupt/truncated "
+                                 "xplane file)")
+        elif wt == 5:  # fixed32
+            v, i = buf[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wt} "
+                             "(corrupt xplane file)")
+        if i > n:
+            raise ValueError("truncated field (corrupt/truncated "
+                             "xplane file)")
+        yield field, wt, v
+
+
+# -- schema (the slice of xplane.proto we read) ------------------------------
+
+
+class XEvent:
+    __slots__ = ("name", "metadata_id", "offset_ps", "duration_ps")
+
+    def __init__(self):
+        self.name = ""
+        self.metadata_id = 0
+        self.offset_ps = 0
+        self.duration_ps = 0
+
+
+class XLine:
+    __slots__ = ("name", "timestamp_ns", "events")
+
+    def __init__(self):
+        self.name = ""
+        self.timestamp_ns = 0
+        self.events: List[XEvent] = []
+
+
+class XPlane:
+    __slots__ = ("name", "lines")
+
+    def __init__(self):
+        self.name = ""
+        self.lines: List[XLine] = []
+
+
+class XSpace:
+    __slots__ = ("planes",)
+
+    def __init__(self):
+        self.planes: List[XPlane] = []
+
+
+def _parse_event(buf: bytes) -> XEvent:
+    ev = XEvent()
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            ev.metadata_id = v
+        elif f == 2 and wt == 0:  # offset_ps (oneof data)
+            ev.offset_ps = _signed(v)
+        elif f == 3 and wt == 0:
+            ev.duration_ps = _signed(v)
+    return ev
+
+
+def _parse_line(buf: bytes) -> XLine:
+    ln = XLine()
+    for f, wt, v in _fields(buf):
+        if f == 2 and wt == 2:
+            ln.name = v.decode("utf-8", "replace")
+        elif f == 3 and wt == 0:
+            ln.timestamp_ns = _signed(v)
+        elif f == 4 and wt == 2:
+            ln.events.append(_parse_event(v))
+        elif f == 11 and wt == 2 and not ln.name:  # display_name fallback
+            ln.name = v.decode("utf-8", "replace")
+    return ln
+
+
+def _parse_event_metadata(buf: bytes):
+    """XEventMetadata: returns (id, name)."""
+    mid, name, display = 0, "", ""
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            mid = _signed(v)
+        elif f == 2 and wt == 2:
+            name = v.decode("utf-8", "replace")
+        elif f == 3 and wt == 2:
+            display = v.decode("utf-8", "replace")
+    return mid, (display or name)
+
+
+def _parse_plane(buf: bytes) -> XPlane:
+    plane = XPlane()
+    meta: Dict[int, str] = {}
+    for f, wt, v in _fields(buf):
+        if f == 2 and wt == 2:
+            plane.name = v.decode("utf-8", "replace")
+        elif f == 3 and wt == 2:
+            plane.lines.append(_parse_line(v))
+        elif f == 4 and wt == 2:
+            # map<int64, XEventMetadata>: entries are {1: key, 2: value}
+            key, val = 0, None
+            for mf, mwt, mv in _fields(v):
+                if mf == 1 and mwt == 0:
+                    key = _signed(mv)
+                elif mf == 2 and mwt == 2:
+                    val = mv
+            if val is not None:
+                mid, name = _parse_event_metadata(val)
+                meta[key or mid] = name
+    for line in plane.lines:
+        for ev in line.events:
+            ev.name = meta.get(ev.metadata_id, f"op#{ev.metadata_id}")
+    return plane
+
+
+def parse_xspace(buf: bytes) -> XSpace:
+    space = XSpace()
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 2:
+            space.planes.append(_parse_plane(v))
+    return space
+
+
+def parse_xspace_file(path: str) -> XSpace:
+    with open(path, "rb") as f:
+        return parse_xspace(f.read())
+
+
+def find_xplane_files(trace_dir: str) -> List[str]:
+    """The .xplane.pb files of a jax.profiler trace directory (tensorboard
+    layout: <dir>/plugins/profile/<run>/<host>.xplane.pb)."""
+    return sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                  recursive=True))
+
+
+def is_device_plane(name: str) -> bool:
+    """Device planes hold per-chip op streams ('/device:TPU:0' etc.);
+    everything else ('/host:CPU', 'Task Environment', ...) is host-side."""
+    return name.startswith("/device:")

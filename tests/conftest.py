@@ -8,6 +8,13 @@ import os
 # the CPU mesh).  Default is deterministic CPU.
 _platform = os.environ.get("PT_TEST_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform
+
+# Executed-op recording for the op-contract gate (test_zz_op_gate.py):
+# every op type the executor trace / imperative dispatcher lowers during
+# the session lands in monitor.flight.lowered_op_types(), and the gate
+# asserts registry.all_ops() ⊆ recorded ∪ CONTRACT_EXEMPT — enforcement
+# by execution, not by grepping test files for op-name substrings.
+os.environ.setdefault("FLAGS_record_lowered_ops", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -26,6 +33,18 @@ jax.config.update("jax_platforms", _platform)
 # Numeric tests compare against float64 numpy references; use full-precision
 # matmuls (the framework default is device-native fast precision).
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """PT_DUMP_LOWERED_OPS=<path>: write the executed-op set observed this
+    session (one op type per line) — the maintenance tool for the
+    op-contract gate's CONTRACT_EXEMPT list."""
+    path = os.environ.get("PT_DUMP_LOWERED_OPS")
+    if path:
+        from paddle_tpu.monitor import flight
+
+        with open(path, "w") as f:
+            f.write("\n".join(sorted(flight.lowered_op_types())) + "\n")
 
 
 @pytest.fixture(autouse=True)

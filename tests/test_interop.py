@@ -1,0 +1,63 @@
+"""DLPack interop (VERDICT missing #4): zero-copy exchange with torch.
+
+The contract under test is not "values survive a round trip" (numpy does
+that) — it is that NO copy happens: producer and consumer see the same
+buffer, asserted by pointer equality on the CPU mesh."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_torch_roundtrip_zero_copy():
+    torch = pytest.importorskip("torch")
+    import jax.numpy as jnp
+
+    # torch -> paddle_tpu: same buffer
+    t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+    x = pt.from_dlpack(t)
+    np.testing.assert_array_equal(np.asarray(x), t.numpy())
+    assert x.unsafe_buffer_pointer() == t.data_ptr()
+
+    # paddle_tpu -> torch: same buffer
+    y = jnp.asarray(np.random.RandomState(0).randn(4, 5).astype("float32"))
+    t2 = torch.from_dlpack(pt.to_dlpack(y))
+    np.testing.assert_array_equal(t2.numpy(), np.asarray(y))
+    assert t2.data_ptr() == y.unsafe_buffer_pointer()
+
+    # full round trip preserves values and dtype
+    t3 = torch.from_dlpack(pt.to_dlpack(pt.from_dlpack(t)))
+    assert t3.dtype == t.dtype
+    np.testing.assert_array_equal(t3.numpy(), t.numpy())
+
+
+def test_scope_var_exports_to_torch():
+    """The practical path: a trained parameter leaves the scope for a
+    torch-side eval harness without a host round-trip."""
+    torch = pytest.importorskip("torch")
+    from paddle_tpu import layers
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=3)  # creates a persistable weight
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(prog, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[y], scope=scope)
+    w_name = [n for n in scope.local_var_names() if "w" in n][0]
+    w = scope.find_var(w_name)
+    tw = torch.from_dlpack(pt.to_dlpack(w))
+    assert tw.shape == tuple(np.asarray(w).shape)
+    np.testing.assert_array_equal(tw.numpy(), np.asarray(w))
+
+
+def test_from_dlpack_accepts_numpy():
+    """numpy arrays speak __dlpack__ too; importing one must work (the
+    cheapest producer in every test harness)."""
+    a = np.arange(6, dtype="float32").reshape(2, 3)
+    x = pt.from_dlpack(a)
+    np.testing.assert_array_equal(np.asarray(x), a)

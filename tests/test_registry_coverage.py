@@ -3,7 +3,12 @@ reachable from the public layers API (VERDICT r3 weak #4: "a capability you
 can't call isn't a capability"). Reachability = the op type appears as a
 string literal in a public-API module (direct wrappers, generated wrappers,
 operator overloads), with a small documented allowlist for ops that are
-emitted only by framework machinery."""
+emitted only by framework machinery.
+
+This is the API-surface half of the registry contract; the TEST-coverage
+half (every op must actually EXECUTE under the suite) is enforced by
+tests/test_zz_op_gate.py over the executed-op set the flight recorder
+collects (FLAGS_record_lowered_ops) — not by substring matching."""
 
 import pathlib
 import re

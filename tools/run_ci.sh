@@ -1,27 +1,45 @@
 #!/usr/bin/env bash
 # CI entry (reference role: paddle/scripts/paddle_build.sh — cmake_gen:58,
 # run_test:408).  Runs the full validation ladder on a plain CPU host:
-#   1. full test suite on the virtual 8-device CPU mesh
-#   2. bench smoke (real chip if present, else CPU)
-#   3. compile-check + multichip dryrun (the driver's graft contract)
+#   1. lint/format gate (ruff or pyflakes when available, else a
+#      compile-all syntax sweep — the gate must exist on a bare image)
+#   2. full test suite on the virtual 8-device CPU mesh
+#   3. bench smoke (real chip if present, else CPU) with telemetry,
+#      flight recorder, and metrics-snapshot artifacts
+#   4. compile-check + multichip dryrun (the driver's graft contract)
 # Usage: tools/run_ci.sh [fast]   — "fast" skips the bench smoke.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/3] test suite (virtual 8-device CPU mesh)"
+echo "== [1/4] lint gate"
+if command -v ruff >/dev/null 2>&1; then
+  ruff check paddle_tpu tools bench.py __graft_entry__.py
+elif python -c 'import pyflakes' >/dev/null 2>&1; then
+  python -m pyflakes paddle_tpu tools bench.py __graft_entry__.py
+else
+  echo "-- no ruff/pyflakes in image; falling back to compileall"
+  python -m compileall -q paddle_tpu tools bench.py __graft_entry__.py
+fi
+
+echo "== [2/4] test suite (virtual 8-device CPU mesh)"
 python -m pytest tests/ -q
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [2/3] bench smoke (telemetry on; snapshot artifact)"
+  echo "== [3/4] bench smoke (telemetry on; snapshot + flight artifacts)"
   mkdir -p ci_artifacts
   rm -f ci_artifacts/bench_steps.jsonl  # StepMonitor appends; keep one run
+  rm -rf ci_artifacts/flight && mkdir -p ci_artifacts/flight
   FLAGS_monitor=1 FLAGS_monitor_jsonl=ci_artifacts/bench_steps.jsonl \
+    FLAGS_flight_dir=ci_artifacts/flight \
     python bench.py --smoke --monitor-snapshot ci_artifacts/metrics.prom
   echo "-- metrics snapshot:"
   head -40 ci_artifacts/metrics.prom || true
+  echo "-- flight record (black box of the smoke run):"
+  ls ci_artifacts/flight/
+  head -3 ci_artifacts/flight/flight-*-atexit.jsonl || true
 fi
 
-echo "== [3/3] entry compile-check + multichip dryrun"
+echo "== [4/4] entry compile-check + multichip dryrun"
 python __graft_entry__.py
 
 echo "CI OK"

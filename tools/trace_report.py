@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Summarize a unified chrome trace (profiler.export_unified_chrome_trace)
+— the text-report half of the timeline tentpole:
+
+  * top device ops by total time (per-device xplane tracks; host XLA
+    lines when the trace has no device plane, e.g. the CPU mesh),
+  * compile vs run vs feed-stall host time (the "where did the wall
+    clock go" breakdown, from the flight spans),
+  * recompile causes (which cache-key component churned, aggregated),
+  * watchdog trips and the last completed step (from the embedded
+    flight header).
+
+Usage: python tools/trace_report.py merged_trace.json [--top 20]
+
+Also accepts a raw jax trace DIRECTORY (the start_profiler trace_dir):
+then only the device-op table is available.  Plain stdlib — the report
+must be runnable on the barest postmortem host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def load_trace(path: str) -> dict:
+    if os.path.isdir(path):
+        # raw jax trace dir: build the xplane-only event list in-process
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), ".."))
+        from paddle_tpu.profiler import _xplane_chrome_events
+
+        return {"traceEvents": _xplane_chrome_events(path, 500000)}
+    with open(path) as f:
+        return json.load(f)
+
+
+def _index_processes(events):
+    """pid -> {"name": ..., "device": bool, "source": ...}."""
+    procs = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            procs[ev["pid"]] = dict(ev.get("args", {}))
+    return procs
+
+
+def top_ops(doc: dict, k: int = 20):
+    """(rows, scope): rows of (op_name, total_s, calls) over device-plane
+    events; falls back to host XLA runtime lines on device-less traces."""
+    events = doc.get("traceEvents", [])
+    procs = _index_processes(events)
+    device_pids = {p for p, a in procs.items() if a.get("device")}
+    xplane_pids = {p for p, a in procs.items()
+                   if a.get("source") == "xplane"}
+    scope = "device"
+    pids = device_pids
+    if not pids:
+        scope, pids = "host-xplane", xplane_pids
+    agg = defaultdict(lambda: [0.0, 0])
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("pid") not in pids:
+            continue
+        dur = float(ev.get("dur", 0.0))
+        if dur <= 0:
+            continue
+        a = agg[ev.get("name", "?")]
+        a[0] += dur / 1e6
+        a[1] += 1
+    rows = sorted(((n, t, c) for n, (t, c) in agg.items()),
+                  key=lambda r: -r[1])[:k]
+    return rows, scope
+
+
+def host_breakdown(doc: dict):
+    """Compile / run / feed-stall / step seconds from the flight spans."""
+    fl = doc.get("flight", {})
+    agg = defaultdict(lambda: [0.0, 0])
+    for ev in fl.get("events", []):
+        if "dur" not in ev:
+            continue
+        kind = ev.get("kind", "?")
+        if kind.startswith("executor.compile"):
+            key = "compile"
+        elif kind.startswith("executor."):
+            key = "run"
+        elif kind.startswith("feed."):
+            key = "feed_stall"
+        elif kind == "step":
+            key = "step"
+        else:
+            key = kind
+        agg[key][0] += float(ev["dur"])
+        agg[key][1] += 1
+    return dict(agg)
+
+
+def recompile_causes(doc: dict):
+    agg = defaultdict(int)
+    for ev in doc.get("flight", {}).get("events", []):
+        if ev.get("kind") == "executor.recompile":
+            for comp in ev.get("changed", []):
+                agg[comp] += 1
+    return dict(agg)
+
+
+def watchdog_trips(doc: dict):
+    return [ev for ev in doc.get("flight", {}).get("events", [])
+            if ev.get("kind") == "watchdog.trip"]
+
+
+def report(doc: dict, k: int = 20) -> str:
+    lines = []
+    hdr = doc.get("flight", {}).get("header", {})
+    if hdr:
+        lines.append(
+            f"run: pid={hdr.get('pid')} backend={hdr.get('jax_backend')} "
+            f"devices={hdr.get('jax_device_count')} "
+            f"last_step={hdr.get('last_step')} "
+            f"last_loss={hdr.get('last_loss')}")
+
+    rows, scope = top_ops(doc, k)
+    lines.append("")
+    lines.append(f"Top ops by total time ({scope} tracks)")
+    lines.append(f"{'op':<56} {'total(s)':>10} {'calls':>8}")
+    for name, total, calls in rows:
+        lines.append(f"{name[:56]:<56} {total:>10.6f} {calls:>8}")
+    if not rows:
+        lines.append("(no xplane events in this trace)")
+
+    bd = host_breakdown(doc)
+    lines.append("")
+    lines.append("Host time breakdown (flight spans)")
+    if bd:
+        lines.append(f"{'category':<16} {'total(s)':>10} {'spans':>8}")
+        order = ("compile", "run", "step", "feed_stall")
+        for key in [o for o in order if o in bd] + sorted(
+                set(bd) - set(order)):
+            t, c = bd[key]
+            lines.append(f"{key:<16} {t:>10.4f} {c:>8}")
+    else:
+        lines.append("(no flight spans — was FLAGS.monitor on?)")
+
+    causes = recompile_causes(doc)
+    lines.append("")
+    if causes:
+        lines.append("Recompile causes (changed cache-key components)")
+        for comp, n in sorted(causes.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {comp:<32} x{n}")
+    else:
+        lines.append("Recompiles: none recorded")
+
+    trips = watchdog_trips(doc)
+    if trips:
+        lines.append("")
+        lines.append("Watchdog trips")
+        for t in trips:
+            lines.append(f"  [{t.get('trip')}] step {t.get('step')}: "
+                         f"{t.get('detail')}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="summarize a unified chrome trace / jax trace dir")
+    p.add_argument("trace", help="merged trace JSON (or a jax trace dir)")
+    p.add_argument("--top", type=int, default=20,
+                   help="rows in the top-op table")
+    args = p.parse_args(argv)
+    print(report(load_trace(args.trace), args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
