@@ -90,6 +90,28 @@ def _step_monitor(name, examples_per_call=None, tokens_per_call=None,
     )
 
 
+def _ckpt_manager(name, exe, prog, scope):
+    """A CheckpointManager under FLAGS.checkpoint_dir/<name> (emergency
+    save armed through the flight recorder), else None.  One bench "step"
+    is one run_steps call."""
+    from paddle_tpu.flags import FLAGS
+
+    if not FLAGS.checkpoint_dir:
+        return None
+    import os
+
+    import paddle_tpu as pt
+    from paddle_tpu.monitor import flight
+
+    mgr = pt.io.CheckpointManager(
+        os.path.join(FLAGS.checkpoint_dir, name), exe,
+        interval_steps=FLAGS.checkpoint_interval, main_program=prog,
+        scope=scope)
+    flight.install()
+    mgr.install_emergency()
+    return mgr
+
+
 _WATCHDOG = None
 
 
@@ -109,7 +131,8 @@ def _bench_watchdog():
     return _WATCHDOG
 
 
-def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None):
+def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None,
+                ckpt=None):
     """Shared warmup + timing loop: returns (seconds, first_loss,
     last_loss).  first_loss is step 0 of the first (warmup) call, so
     last_loss < first_loss certifies the timed program actually LEARNS on
@@ -117,7 +140,11 @@ def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None):
     loss thresholds the same way (tests/book/test_recognize_digits.py).
 
     `mon`: optional StepMonitor (see _step_monitor) — records per-call
-    loss/throughput/MFU telemetry for the timed calls."""
+    loss/throughput/MFU telemetry for the timed calls.
+    `ckpt`: optional CheckpointManager (see _ckpt_manager) — interval +
+    emergency checkpoints; stepped IN the loop (use async_save /
+    FLAGS_checkpoint_async to keep disk writes off the step path, and
+    leave it off for measurement-grade numbers)."""
     from paddle_tpu.flags import FLAGS
 
     # Two stepping modes.  Measurement mode (default): inside the timed
@@ -142,7 +169,9 @@ def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None):
         if mon is not None:
             mon.step(now=time.perf_counter())  # arm at region start
         t0 = time.perf_counter()
-        for _ in range(calls):
+        for i in range(calls):
+            if ckpt is not None:
+                ckpt.step_started(i)
             (losses,) = exe.run_steps(prog, feed=feed, fetch_list=fetch,
                                       scope=scope)
             if live:
@@ -150,6 +179,8 @@ def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None):
                          now=time.perf_counter())
             elif mon is not None:
                 stamps.append((time.perf_counter(), losses))
+            if ckpt is not None:
+                ckpt.on_step(i)
         dt = time.perf_counter() - t0
         if mon is not None:
             for now_i, lv in stamps:
@@ -160,6 +191,8 @@ def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None):
         # would outlive the StepMonitor that opened it
         if mon is not None:
             mon.close()
+        if ckpt is not None:
+            ckpt.close()  # flush + detach the emergency callback
     return dt, first_loss, float(np.asarray(losses).reshape(-1)[-1])
 
 
@@ -344,8 +377,10 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
     toks_per_call = batch_size * seq_len * scan_steps
     mon = _step_monitor("transformer", tokens_per_call=toks_per_call,
                         flops_per_call=flops_tok * toks_per_call)
+    ckpt = _ckpt_manager("transformer", exe, prog, scope)
     dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
-                                            scope, warmup, calls, mon=mon)
+                                            scope, warmup, calls, mon=mon,
+                                            ckpt=ckpt)
     # tokens counted on the decoded (trg) stream, the convention for MT
     tps = batch_size * seq_len * scan_steps * calls / dt
     return tps, flops_tok, first_loss, last_loss
@@ -447,8 +482,10 @@ def bench_bert(batch_size=32, seq_len=128, scan_steps=8, calls=4, warmup=1,
     toks_per_call = batch_size * seq_len * scan_steps
     mon = _step_monitor("bert", tokens_per_call=toks_per_call,
                         flops_per_call=flops_tok * toks_per_call)
+    ckpt = _ckpt_manager("bert", exe, prog, scope)
     dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_loss],
-                                            scope, warmup, calls, mon=mon)
+                                            scope, warmup, calls, mon=mon,
+                                            ckpt=ckpt)
     tps = batch_size * seq_len * scan_steps * calls / dt
     return tps, flops_tok, first_loss, last_loss
 
@@ -478,8 +515,10 @@ def bench_deepfm(batch_size=4096, scan_steps=8, calls=4, warmup=1,
 
     mon = _step_monitor("deepfm",
                         examples_per_call=batch_size * scan_steps)
+    ckpt = _ckpt_manager("deepfm", exe, prog, scope)
     dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
-                                            scope, warmup, calls, mon=mon)
+                                            scope, warmup, calls, mon=mon,
+                                            ckpt=ckpt)
     eps = batch_size * scan_steps * calls / dt
     return eps, first_loss, last_loss
 
@@ -510,8 +549,10 @@ def bench_mnist(batch_size=512, scan_steps=16, calls=2, warmup=1, amp=True):
             x[s, b, 0, k:k + 3, k:k + 3] += 1.0
     feed = {"pixel": x, "label": y}
     mon = _step_monitor("mnist", examples_per_call=batch_size * scan_steps)
+    ckpt = _ckpt_manager("mnist", exe, prog, scope)
     dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
-                                            scope, warmup, calls, mon=mon)
+                                            scope, warmup, calls, mon=mon,
+                                            ckpt=ckpt)
     ips = batch_size * scan_steps * calls / dt
     return ips, first_loss, last_loss
 

@@ -77,6 +77,8 @@ from .flags import FLAGS  # noqa: F401
 from . import log  # noqa: F401
 from . import debugger  # noqa: F401
 from . import passes  # noqa: F401
+from . import utils  # noqa: F401
+from . import testing  # noqa: F401
 from .core import registry  # noqa: F401
 
 __version__ = "0.1.0"

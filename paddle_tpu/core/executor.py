@@ -460,6 +460,12 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,
     ):
+        # fault-injection hook (FLAGS_chaos_kill_at_run): one flag read
+        # when chaos is off, SIGKILL mid-training when armed — the
+        # preemption the checkpoint layer must survive
+        from ..testing import chaos as _chaos
+
+        _chaos.on_executor_run()
         # CompiledProgram / ShardedProgram delegate via their _run hook.
         # Their data-parallel/sharded paths keep private compile caches, so
         # only coarse telemetry (calls, wall time, errors) is recorded

@@ -352,6 +352,20 @@ class AsyncExecutor:
         from .core.executor import CPUPlace, Executor
 
         self.executor = Executor(place or CPUPlace())
+        # shard paths skipped by the last run_from_files (after retries)
+        self.shard_failures: List[str] = []
+
+    def _count_shard_failure(self, path: str, exc: BaseException) -> None:
+        from . import monitor
+        from .log import warning
+        from .monitor import flight as _flight
+
+        self.shard_failures.append(path)
+        warning("data_feed shard %s failed after retries, skipping: %s",
+                path, exc)
+        if monitor.enabled():
+            monitor.counter("data_feed.shard_failures_total").inc()
+        _flight.record("feed.shard_failed", path=path, error=str(exc))
 
     def run_from_files(
         self,
@@ -362,9 +376,26 @@ class AsyncExecutor:
         fetch_list=None,
         scope=None,
         queue_capacity: int = 8,
+        shard_retries: int = 2,
+        on_shard_error: str = "skip",
     ) -> List[List[float]]:
         """Train over every batch in `filelist`; returns the fetch values
-        per batch (floats for scalar fetches)."""
+        per batch (floats for scalar fetches).
+
+        Fault tolerance: a shard file that fails to read/parse is retried
+        with jittered backoff (`shard_retries` extra attempts, duplicate
+        batches suppressed by a yielded-count cursor); a shard that still
+        fails is then SKIPPED and counted
+        (data_feed_shard_failures_total) instead of aborting every other
+        worker — one flaky file costs its own batches, not the job.  Set
+        on_shard_error="raise" to restore fail-fast semantics (the
+        give-up RetryError surfaces on the consumer thread)."""
+        from .testing import chaos
+        from .utils.retry import RetryError, retry_call
+
+        if on_shard_error not in ("skip", "raise"):
+            raise ValueError(f"on_shard_error {on_shard_error!r} "
+                             "(want skip|raise)")
         feed_parser = MultiSlotDataFeed(data_feed_desc)
         q: "queue.Queue" = queue.Queue(maxsize=queue_capacity)
         end = object()
@@ -375,11 +406,36 @@ class AsyncExecutor:
 
         thread_num = max(1, min(thread_num, len(filelist)))
 
+        def read_shard_file(path: str):
+            """One file, retried whole; `yielded` suppresses re-queuing
+            batches an earlier attempt already delivered."""
+            yielded = 0
+
+            def attempt():
+                nonlocal yielded
+                skip = yielded
+                chaos.maybe_io_error("data_feed.read")
+                for i, feed in enumerate(feed_parser.read_file(path)):
+                    if i < skip:
+                        continue
+                    chaos.maybe_feed_stall()
+                    yielded += 1
+                    q.put(feed)
+
+            retry_call(attempt, retries=shard_retries,
+                       base_delay=0.05, max_delay=1.0,
+                       retry_on=(OSError, ValueError),
+                       name="data_feed.shard")
+
         def worker(shard: List[str]):
             try:
                 for path in shard:
-                    for feed in feed_parser.read_file(path):
-                        q.put(feed)
+                    try:
+                        read_shard_file(path)
+                    except RetryError as e:
+                        if on_shard_error == "raise":
+                            raise
+                        self._count_shard_failure(path, e)
             except BaseException as e:
                 # promptly surfaced: the consumer stops at the NEXT batch
                 # instead of silently training through a full pass and
@@ -387,6 +443,8 @@ class AsyncExecutor:
                 q.put(_Err(e))
             finally:
                 q.put(end)
+
+        self.shard_failures: List[str] = []
 
         shards = [list(filelist[i::thread_num]) for i in range(thread_num)]
         threads = [

@@ -25,27 +25,51 @@ def md5file(fname):
     return hash_md5.hexdigest()
 
 
-def download(url, module_name, md5sum, save_name=None):
-    """Download-with-cache (reference common.py:download).  In zero-egress
-    environments, place the file at the cache path manually; a missing file
-    raises with that path in the message."""
+def _urlretrieve(url, tmp):
+    """Seam for tests (flaky fake openers monkeypatch this)."""
+    import urllib.request
+
+    urllib.request.urlretrieve(url, tmp)
+
+
+def download(url, module_name, md5sum, save_name=None, retries=3):
+    """Download-with-cache (reference common.py:download), hardened:
+    transient fetch errors retry with jittered backoff (utils/retry.py),
+    stale partial `.part` files from a killed earlier download are
+    cleaned up, and an md5 mismatch triggers a RE-DOWNLOAD (a corrupt
+    fetch is just another transient fault) instead of raising on the
+    first bad copy.  In zero-egress environments, place the file at the
+    cache path manually; a missing file raises with that path in the
+    message."""
+    from ..testing import chaos
+    from ..utils.retry import RetryError, retry_call
+
     dirname = must_mkdirs(os.path.join(DATA_HOME, module_name))
     filename = os.path.join(dirname, save_name or url.split("/")[-1])
     if os.path.exists(filename) and (not md5sum or md5file(filename) == md5sum):
         return filename
-    try:
-        import urllib.request
+    tmp = filename + ".part"
 
-        tmp = filename + ".part"
-        urllib.request.urlretrieve(url, tmp)
+    def fetch():
+        if os.path.exists(tmp):
+            os.remove(tmp)  # partial leftovers of a killed download
+        chaos.maybe_io_error("dataset.download")
+        _urlretrieve(url, tmp)
+        if md5sum and md5file(tmp) != md5sum:
+            os.remove(tmp)
+            raise OSError(f"md5 mismatch for {url} (corrupt fetch)")
         shutil.move(tmp, filename)
+
+    try:
+        retry_call(fetch, retries=retries, base_delay=0.1, max_delay=5.0,
+                   retry_on=(OSError, ValueError),
+                   name="dataset.download")
     except Exception as e:
+        cause = e.last if isinstance(e, RetryError) else e
         raise RuntimeError(
-            f"cannot download {url} (offline?): {e}. "
+            f"cannot download {url} (offline?): {cause}. "
             f"Place the file manually at {filename}."
         ) from e
-    if md5sum and md5file(filename) != md5sum:
-        raise RuntimeError(f"md5 mismatch for {filename}")
     return filename
 
 

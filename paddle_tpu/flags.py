@@ -172,3 +172,50 @@ FLAGS.define(
     "what a watchdog trip does: 'log' (warn only), 'dump' (warn + write "
     "a flight record to FLAGS.flight_dir), or 'raise' (dump, then raise "
     "WatchdogError / interrupt the main thread — for tests)")
+FLAGS.define(
+    "checkpoint_async", bool, False,
+    "CheckpointManager default save mode: snapshot device->host "
+    "synchronously, then write/fsync/rename on a background thread so "
+    "the step loop never blocks on disk (io.py checkpoint v2)")
+FLAGS.define(
+    "checkpoint_dir", str, "",
+    "bench.py: arm interval checkpointing + emergency-save for every "
+    "workload under this directory (one subdir per workload); empty "
+    "disables")
+FLAGS.define(
+    "checkpoint_interval", int, 50,
+    "bench.py checkpoint interval (in run_steps calls) when "
+    "FLAGS.checkpoint_dir is set")
+FLAGS.define(
+    "chaos", bool, False,
+    "master switch for deterministic fault injection "
+    "(paddle_tpu/testing/chaos.py); off = every chaos hook is a no-op")
+FLAGS.define(
+    "chaos_seed", int, 0,
+    "seed for any randomized chaos schedule (kept 0/deterministic by the "
+    "built-in injections; reserved for custom harnesses)")
+FLAGS.define(
+    "chaos_kill_at_step", int, -1,
+    "SIGKILL the process when a training loop reports this completed "
+    "step (chaos.on_step); -1 disables")
+FLAGS.define(
+    "chaos_kill_at_run", int, -1,
+    "SIGKILL the process on the Nth Executor.run call (1-based, "
+    "chaos.on_executor_run); -1 disables")
+FLAGS.define(
+    "chaos_torn_write", int, -1,
+    "truncate a tensor file of the Nth checkpoint save (0-based) after "
+    "its manifest is computed — a disk-level torn write the integrity "
+    "check must catch; -1 disables")
+FLAGS.define(
+    "chaos_io_errors", int, 0,
+    "the first K chaos-guarded I/O calls (checkpoint rename/open, shard "
+    "open, dataset download) raise a transient OSError; 0 disables")
+FLAGS.define(
+    "chaos_feed_stall_s", float, 0.0,
+    "sleep injected per parsed batch in data-feed workers (feed "
+    "starvation); 0 disables")
+FLAGS.define(
+    "chaos_nan_at_step", int, -1,
+    "training loops report a NaN loss at this step (watchdog fodder); "
+    "-1 disables")

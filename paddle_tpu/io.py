@@ -10,8 +10,13 @@ persistable Scope vars, exactly like the reference (SURVEY.md §5.4)."""
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import queue
+import threading
+import time
+import uuid
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -20,6 +25,11 @@ from .core import framework as fw
 from .core.executor import Scope, global_scope
 
 SAVE_FORMAT_VERSION = 1
+
+# checkpoint v2 (CheckpointManager): integrity-manifested directories
+CKPT_FORMAT_VERSION = 2
+MANIFEST_NAME = "MANIFEST.json"
+CKPT_TENSOR_FILE = "__persist__.npz"
 
 
 # ---------------------------------------------------------------------------
@@ -233,23 +243,127 @@ def load_inference_model(
     return program, list(program.feed_var_names), fetch_vars
 
 
+# ---------------------------------------------------------------------------
+# checkpoint v2: integrity manifests + tear-proof commit + fallback resume
+# ---------------------------------------------------------------------------
+
+
+def _sha256(b: bytes) -> str:
+    return hashlib.sha256(b).hexdigest()
+
+
+def _fsync_path(path: str) -> None:
+    with open(path, "rb+") as f:
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably commit directory entries (the rename itself needs this)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds: best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def verify_checkpoint(dirname: str) -> Optional[str]:
+    """Integrity-check one checkpoint directory against its MANIFEST.json.
+
+    Returns None when the checkpoint is complete and intact, else a short
+    NAMED reason ("missing MANIFEST.json", "tensor w sha256 mismatch", ...)
+    — the string resume() reports when it walks past the checkpoint."""
+    if not os.path.isdir(dirname):
+        return "missing checkpoint directory"
+    mpath = os.path.join(dirname, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return "missing MANIFEST.json (incomplete or pre-v2 checkpoint)"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"unreadable manifest: {type(e).__name__}: {e}"
+    if manifest.get("format") != CKPT_FORMAT_VERSION:
+        return f"unsupported checkpoint format {manifest.get('format')!r}"
+    tensors = manifest.get("tensors")
+    if not isinstance(tensors, dict):
+        return "manifest missing tensor table"
+    by_file: Dict[str, List[str]] = {}
+    for name, spec in tensors.items():
+        by_file.setdefault(spec.get("file", CKPT_TENSOR_FILE),
+                           []).append(name)
+    for fname, names in sorted(by_file.items()):
+        path = os.path.join(dirname, fname)
+        if not os.path.exists(path):
+            return f"missing tensor file {fname}"
+        try:
+            with np.load(path) as data:
+                for name in names:
+                    spec = tensors[name]
+                    if name not in data:
+                        return f"tensor {name} missing from {fname}"
+                    arr = data[name]
+                    if list(arr.shape) != list(spec.get("shape", [])):
+                        return (f"tensor {name} shape mismatch "
+                                f"({list(arr.shape)} != {spec.get('shape')})")
+                    if _sha256(arr.tobytes()) != spec.get("sha256"):
+                        return (f"tensor {name} sha256 mismatch "
+                                "(torn or corrupted write)")
+        except Exception as e:  # torn zip/deflate errors surface lazily
+            return f"unreadable tensor file {fname}: {type(e).__name__}: {e}"
+    return None
+
+
+def read_manifest(dirname: str) -> dict:
+    with open(os.path.join(dirname, MANIFEST_NAME)) as f:
+        return json.load(f)
+
+
 class CheckpointManager:
-    """Interval auto-checkpointing with resume-latest (reference: the Go
+    """Interval auto-checkpointing with verified resume (reference: the Go
     pserver's fault-tolerance design — checkpoint to disk on an interval
-    with integrity checks + load-on-restart, go/pserver/service.go:119-156,
-    174-205; SURVEY §5.3 maps elasticity on TPU to
-    restart-from-checkpoint).
+    with integrity checks + load-on-restart, go/pserver/service.go:119-205;
+    SURVEY §5.3 maps elasticity on TPU to restart-from-checkpoint).
+
+    Checkpoint v2: every checkpoint directory carries a MANIFEST.json
+    (per-tensor sha256 + dtype/shape, framework version, step, save
+    trigger, and the extra training state).  Saves are TEAR-PROOF — the
+    whole checkpoint is written and fsynced under a unique tmp dir, then
+    committed with one rename; a crash at any instant leaves either the
+    previous checkpoint or the new one, never a half checkpoint that
+    resume() would trust.  resume() verifies the manifest and FALLS BACK
+    past corrupt/partial checkpoints (newest verifiable wins, each skip
+    reported with a named reason).  Beyond the persistable vars (params +
+    optimizer accumulators + LR-scheduler counters, all Scope state), the
+    manifest carries host RNG state (python/numpy + the executor's RNG
+    fold-in counter, so dropout masks replay bit-exact across a resume)
+    and any registered state providers — e.g. a reader.StatefulReader's
+    epoch/offset cursor, or a grad-accumulation micro-step counter.
 
         mgr = io.CheckpointManager(dirname, exe, interval_steps=100)
-        start = mgr.resume()              # 0 if no checkpoint yet
+        mgr.register_state("reader", stateful_reader)
+        mgr.install_emergency()           # SIGTERM/watchdog => final save
+        start = mgr.resume()              # 0 if no verifiable checkpoint
         for step in range(start, n):
             ... train ...
             mgr.on_step(step)             # saves every interval
+
+    async_save (or FLAGS.checkpoint_async): save() snapshots device->host
+    synchronously, then writes/fsyncs/renames on a background thread so
+    the step loop never blocks on disk; wait() flushes, and write errors
+    surface on the next save()/wait().
     """
 
+    EMERGENCY_PREFIX = "emergency:"
+
     def __init__(self, dirname, executor, interval_steps=100,
-                 main_program=None, scope=None, keep_last=2):
-        import json
+                 main_program=None, scope=None, keep_last=2,
+                 async_save=None, capture_host_rng=True):
+        from .flags import FLAGS
 
         self.dirname = dirname
         self.executor = executor
@@ -257,53 +371,331 @@ class CheckpointManager:
         self.program = main_program or fw.default_main_program()
         self.scope = scope
         self.keep_last = keep_last
-        self._json = json
+        self.async_save = (FLAGS.checkpoint_async if async_save is None
+                           else bool(async_save))
+        self.capture_host_rng = capture_host_rng
+        self._providers: Dict[str, object] = {}
+        # RLock: a SIGTERM emergency save runs on the main thread and may
+        # interrupt a sync save already holding the lock — a plain Lock
+        # would deadlock the dying process (same hazard flight.py's
+        # recorder documents)
+        self._lock = threading.RLock()
+        self._queue: Optional["queue.Queue"] = None
+        self._writer: Optional[threading.Thread] = None
+        self._write_err: Optional[BaseException] = None
+        self._last_seen_step: Optional[int] = None
+        self._inflight_step: Optional[int] = None
+        self._last_saved_step: Optional[int] = None
+        self._emergency_done: set = set()
+        self._active_tmps: set = set()  # in-flight commit dirs (_gc skips)
+        # resume() introspection: [(step, reason)] for checkpoints skipped
+        self.skipped: List[tuple] = []
         os.makedirs(dirname, exist_ok=True)
 
+    # -- paths -----------------------------------------------------------
     def _ckpt_dir(self, step):
         return os.path.join(self.dirname, f"ckpt-{step}")
 
     def _latest_path(self):
         return os.path.join(self.dirname, "LATEST")
 
-    def save(self, step):
-        """Write a checkpoint for `step` (persistables incl. optimizer
-        accumulators) and atomically advance the LATEST pointer."""
-        d = self._ckpt_dir(step)
-        tmp = d + ".tmp"
-        os.makedirs(tmp, exist_ok=True)
-        save_persistables(self.executor, tmp, self.program,
-                          scope=self.scope)
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            self._json.dump({"step": int(step)}, f)
-        if os.path.exists(d):
-            import shutil
+    def _scope(self) -> Scope:
+        return self.scope or global_scope()
 
-            shutil.rmtree(d)
-        os.replace(tmp, d)
-        # atomic pointer: readers never see a half-written checkpoint
-        with open(self._latest_path() + ".tmp", "w") as f:
+    # -- extra training state --------------------------------------------
+    def register_state(self, name: str, provider) -> None:
+        """Attach extra resumable state: `provider` implements
+        `state_dict() -> json-able dict` and `load_state_dict(d)` (e.g.
+        reader.StatefulReader, a grad-accumulation counter object)."""
+        if not (hasattr(provider, "state_dict")
+                and hasattr(provider, "load_state_dict")):
+            raise TypeError(
+                f"state provider {name!r} needs state_dict/load_state_dict")
+        self._providers[name] = provider
+
+    def _rng_state(self) -> dict:
+        st = {"executor_run_counter":
+              int(getattr(self.executor, "_run_counter", 0))}
+        if self.capture_host_rng:
+            import random as _random
+
+            pr = _random.getstate()
+            st["python_random"] = [pr[0], list(pr[1]), pr[2]]
+            ns = np.random.get_state()
+            st["numpy_random"] = [ns[0], np.asarray(ns[1]).tolist(),
+                                  int(ns[2]), int(ns[3]), float(ns[4])]
+        return st
+
+    def _restore_rng(self, st: dict) -> None:
+        if "executor_run_counter" in st:
+            self.executor._run_counter = int(st["executor_run_counter"])
+        pr = st.get("python_random")
+        if pr:
+            import random as _random
+
+            _random.setstate((pr[0], tuple(pr[1]), pr[2]))
+        ns = st.get("numpy_random")
+        if ns:
+            np.random.set_state((ns[0], np.asarray(ns[1], dtype=np.uint32),
+                                 int(ns[2]), int(ns[3]), float(ns[4])))
+
+    def _gather_extra(self) -> dict:
+        return {
+            "rng": self._rng_state(),
+            "providers": {n: p.state_dict()
+                          for n, p in self._providers.items()},
+        }
+
+    def _restore_extra(self, extra: dict) -> None:
+        self._restore_rng(extra.get("rng", {}))
+        states = extra.get("providers", {})
+        for n, p in self._providers.items():
+            if n in states:
+                p.load_state_dict(states[n])
+
+    # -- save ------------------------------------------------------------
+    def _collect_arrays(self) -> Dict[str, tuple]:
+        """Device->host snapshot of every persistable var: {name: (host
+        np array COPY, wire dtype)}.  The copy decouples async writes from
+        subsequent training steps mutating the scope."""
+        scope = self._scope()
+        arrays: Dict[str, tuple] = {}
+        for v in self.program.list_vars():
+            if not _is_persistable(v):
+                continue
+            val = scope.find_var(v.name)
+            if val is None:
+                continue
+            arr = np.asarray(val)
+            if str(arr.dtype) == "bfloat16":
+                arrays[v.name] = (arr.astype(np.float32), "bfloat16")
+            else:
+                arrays[v.name] = (np.array(arr, copy=True), str(arr.dtype))
+        return arrays
+
+    def save(self, step, trigger: str = "interval") -> None:
+        """Checkpoint `step`.  Sync mode blocks until the checkpoint is
+        durably committed; async mode (async_save) returns after the
+        device->host snapshot and commits on the writer thread."""
+        self._raise_pending_write_error()
+        job = (int(step), self._collect_arrays(), self._gather_extra(),
+               trigger)
+        if self.async_save:
+            self._ensure_writer()
+            try:
+                self._queue.put_nowait(job)
+            except queue.Full:
+                # disk slower than the save interval: drop the OLDEST
+                # pending snapshot (each job holds a full host param copy —
+                # an unbounded queue would grow without bound), keep newest
+                try:
+                    dropped = self._queue.get_nowait()
+                    self._queue.task_done()
+                    from .monitor import flight as _flight
+
+                    _flight.record("checkpoint.dropped", step=dropped[0],
+                                   reason="writer backlog")
+                except queue.Empty:
+                    pass
+                self._queue.put(job)
+        else:
+            self._write_checkpoint(*job)
+
+    def wait(self, raise_errors: bool = True) -> None:
+        """Block until every queued async save is on disk."""
+        if self._queue is not None:
+            self._queue.join()
+        if raise_errors:
+            self._raise_pending_write_error()
+
+    def close(self) -> None:
+        """Flush async saves, stop the writer, and detach the emergency
+        callback (a closed manager must not pin its scope alive through
+        the flight recorder, nor snapshot a stale workload on SIGTERM)."""
+        from .monitor import flight as _flight
+
+        _flight.remove_emergency(self._on_emergency)
+        self.wait(raise_errors=False)
+        if self._queue is not None:
+            self._queue.put(None)
+            self._writer.join(timeout=10.0)
+            self._queue = None
+            self._writer = None
+
+    def _raise_pending_write_error(self):
+        err, self._write_err = self._write_err, None
+        if err is not None:
+            raise RuntimeError(
+                f"async checkpoint write failed: {err}") from err
+
+    def _ensure_writer(self):
+        if self._writer is not None and self._writer.is_alive():
+            return
+        self._queue = queue.Queue(maxsize=2)
+        self._writer = threading.Thread(
+            target=self._writer_loop, name="paddle-tpu-ckpt-writer",
+            daemon=True)
+        self._writer.start()
+
+    def _writer_loop(self):
+        while True:
+            job = self._queue.get()
+            try:
+                if job is None:
+                    return
+                self._write_checkpoint(*job)
+            except BaseException as e:
+                self._write_err = e
+                from .log import warning
+                from .monitor import flight as _flight
+
+                warning("async checkpoint write failed: %s", e)
+                _flight.record("checkpoint.write_error", error=str(e))
+            finally:
+                self._queue.task_done()
+
+    def _write_checkpoint(self, step, arrays, extra, trigger):
+        """The tear-proof commit: write + fsync EVERYTHING under a unique
+        tmp dir (manifest last), then one rename.  No rmtree-then-replace
+        window: a crash at any instant leaves the directory either absent
+        or complete, and resume() verifies before trusting it."""
+        import shutil
+
+        from .monitor import counter as _counter, enabled as _mon
+        from .monitor import flight as _flight
+        from .testing import chaos
+        from .utils.retry import retry_call
+
+        d = self._ckpt_dir(step)
+        tmp = os.path.join(
+            self.dirname,
+            f".tmp-ckpt-{step}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        with self._lock:
+            self._active_tmps.add(tmp)
+        os.makedirs(tmp)
+        try:
+            tensor_path = os.path.join(tmp, CKPT_TENSOR_FILE)
+
+            def _write_tensors():
+                chaos.maybe_io_error("checkpoint.write")
+                np.savez(tensor_path,
+                         **{k: a for k, (a, _) in arrays.items()})
+                _fsync_path(tensor_path)
+
+            retry_call(_write_tensors, retries=3, base_delay=0.02,
+                       name="checkpoint.write", seed=0)
+            manifest = {
+                "format": CKPT_FORMAT_VERSION,
+                "framework_save_format": SAVE_FORMAT_VERSION,
+                "step": int(step),
+                "trigger": trigger,
+                "created_unix": round(time.time(), 3),
+                "tensors": {
+                    k: {"sha256": _sha256(a.tobytes()), "dtype": dt,
+                        "shape": list(a.shape), "file": CKPT_TENSOR_FILE}
+                    for k, (a, dt) in arrays.items()
+                },
+                "extra_state": extra,
+            }
+            mpath = os.path.join(tmp, MANIFEST_NAME)
+
+            def _write_manifest():
+                chaos.maybe_io_error("checkpoint.manifest")
+                with open(mpath, "w") as f:
+                    json.dump(manifest, f, indent=1)
+                    f.flush()
+                    os.fsync(f.fileno())
+
+            retry_call(_write_manifest, retries=3, base_delay=0.02,
+                       name="checkpoint.manifest", seed=0)
+            chaos.maybe_tear(tensor_path)  # disk-level torn-write injection
+            _fsync_dir(tmp)
+
+            def _commit():
+                chaos.maybe_io_error("checkpoint.rename")
+                if os.path.exists(d):
+                    # re-save of an existing step: move the old dir aside
+                    # (atomic), rename in (atomic), then drop the old copy.
+                    # A crash between the renames leaves no ckpt at this
+                    # step — resume() falls back to an older verifiable one.
+                    aside = f"{d}.old-{uuid.uuid4().hex[:8]}"
+                    os.rename(d, aside)
+                    os.rename(tmp, d)
+                    shutil.rmtree(aside, ignore_errors=True)
+                else:
+                    os.rename(tmp, d)
+
+            retry_call(_commit, retries=3, base_delay=0.02,
+                       name="checkpoint.commit", seed=0)
+            _fsync_dir(self.dirname)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        finally:
+            with self._lock:
+                self._active_tmps.discard(tmp)
+        # LATEST is a HINT (resume() verifies + scans); still atomic.
+        # Unique tmp name: the async writer and an emergency save can
+        # update the pointer concurrently
+        ltmp = f"{self._latest_path()}.tmp-{uuid.uuid4().hex[:8]}"
+        with open(ltmp, "w") as f:
             f.write(str(int(step)))
-        os.replace(self._latest_path() + ".tmp", self._latest_path())
+        os.replace(ltmp, self._latest_path())
+        with self._lock:
+            if self._last_saved_step is None or step >= self._last_saved_step:
+                self._last_saved_step = int(step)
         self._gc()
+        if _mon():
+            _counter("checkpoint.saves").inc()
+        _flight.record("checkpoint.saved", step=int(step), trigger=trigger,
+                       dir=d)
 
     def _gc(self):
         import re
         import shutil
 
+        names = os.listdir(self.dirname)
         steps = sorted(
             int(m.group(1))
-            for m in (re.fullmatch(r"ckpt-(\d+)", n)
-                      for n in os.listdir(self.dirname))
+            for m in (re.fullmatch(r"ckpt-(\d+)", n) for n in names)
             if m
         )
         for s in steps[:-self.keep_last]:
             shutil.rmtree(self._ckpt_dir(s), ignore_errors=True)
+        # debris from interrupted commits: our tmp dirs + aside copies —
+        # but NEVER a commit another of our threads has in flight (an
+        # emergency save can overlap a slow interval/async save)
+        pid = f"-{os.getpid()}-"
+        with self._lock:
+            active = set(self._active_tmps)
+        for n in names:
+            full = os.path.join(self.dirname, n)
+            if full in active:
+                continue
+            if ((n.startswith(".tmp-ckpt-") and pid in n)
+                    or re.fullmatch(r"ckpt-\d+\.old-[0-9a-f]+", n)):
+                shutil.rmtree(full, ignore_errors=True)
+
+    def step_started(self, step):
+        """Optional two-phase marking: call IMMEDIATELY before the step's
+        executor run.  A preemption signal delivered during the run is
+        handled by Python only after the run returns — i.e. after the
+        param update — so an emergency save in that window must be
+        labelled with THIS step, not the last completed one; without the
+        marker it would be off by one and a resume would replay a step
+        against the wrong data-cursor position."""
+        self._inflight_step = int(step)
 
     def on_step(self, step):
+        from .testing import chaos
+
+        self._inflight_step = None
+        self._last_seen_step = int(step)
+        chaos.on_step(step)
         if (step + 1) % self.interval == 0:
             self.save(step)
 
+    # -- resume ----------------------------------------------------------
     def latest_step(self):
         try:
             with open(self._latest_path()) as f:
@@ -311,12 +703,110 @@ class CheckpointManager:
         except (FileNotFoundError, ValueError):
             return None
 
+    def steps_on_disk(self) -> List[int]:
+        import re
+
+        return sorted(
+            int(m.group(1))
+            for m in (re.fullmatch(r"ckpt-(\d+)", n)
+                      for n in os.listdir(self.dirname))
+            if m
+        )
+
+    def verify(self, step) -> Optional[str]:
+        return verify_checkpoint(self._ckpt_dir(step))
+
     def resume(self):
-        """Load the latest checkpoint into the scope; returns the next
-        step index to run (0 when starting fresh)."""
-        step = self.latest_step()
-        if step is None:
-            return 0
-        load_persistables(self.executor, self._ckpt_dir(step),
-                          self.program, scope=self.scope)
-        return step + 1
+        """Load the NEWEST VERIFIABLE checkpoint into the scope; returns
+        the next step index to run (0 when starting fresh).  Corrupt or
+        partial checkpoints are skipped with a named reason (warned,
+        recorded in self.skipped, counted as
+        checkpoint_corrupt_skipped_total when FLAGS.monitor is on)."""
+        from .log import warning
+        from .monitor import counter as _counter, enabled as _mon
+        from .monitor import flight as _flight
+
+        self.skipped = []
+        for step in reversed(self.steps_on_disk()):
+            d = self._ckpt_dir(step)
+            reason = verify_checkpoint(d)
+            if reason is None:
+                self._load(d)
+                with self._lock:
+                    self._last_saved_step = step
+                self._last_seen_step = step
+                if _mon():
+                    _counter("checkpoint.resumes").inc()
+                _flight.record("checkpoint.resumed", step=step, dir=d,
+                               skipped=len(self.skipped))
+                return step + 1
+            self.skipped.append((step, reason))
+            warning("checkpoint %s rejected: %s — falling back", d, reason)
+            if _mon():
+                _counter("checkpoint.corrupt_skipped_total").inc()
+            _flight.record("checkpoint.skipped", step=step, reason=reason)
+        return 0
+
+    def _load(self, dirname):
+        import jax.numpy as jnp
+
+        manifest = read_manifest(dirname)
+        scope = self._scope()
+        by_file: Dict[str, List[str]] = {}
+        for name, spec in manifest["tensors"].items():
+            by_file.setdefault(spec.get("file", CKPT_TENSOR_FILE),
+                               []).append(name)
+        for fname, names in sorted(by_file.items()):
+            with np.load(os.path.join(dirname, fname)) as data:
+                for name in names:
+                    val = jnp.asarray(data[name])
+                    if manifest["tensors"][name].get("dtype") == "bfloat16":
+                        val = val.astype(jnp.bfloat16)
+                    scope.set_var(name, val)
+        self._restore_extra(manifest.get("extra_state", {}))
+
+    # -- emergency save (preemption / watchdog) ---------------------------
+    def install_emergency(self) -> "CheckpointManager":
+        """Arm best-effort final checkpoints through the flight recorder's
+        signal path: SIGTERM (preemption), a watchdog trip with
+        action=dump, or a crash triggers one synchronous save whose
+        manifest records the trigger ("emergency:sigterm", ...).  Call
+        monitor.flight.install() to arm the signal handlers themselves."""
+        from .monitor import flight as _flight
+
+        _flight.on_emergency(self._on_emergency)
+        return self
+
+    def _on_emergency(self, trigger: str) -> None:
+        """Runs inside the dying path: must never raise, saves at most
+        once per trigger kind."""
+        try:
+            if trigger in self._emergency_done:
+                return
+            self._emergency_done.add(trigger)
+            # SIGTERM delivered during the executor run is handled only
+            # after the run returns: params already carry the in-flight
+            # step's update, so that step is the correct label
+            # (step_started).  A CRASH means the in-flight run raised —
+            # the update never landed — so the last COMPLETED step is the
+            # only label consistent with the params.
+            step = self._inflight_step if trigger == "sigterm" else None
+            if step is None:
+                step = self._last_seen_step
+            if step is None:
+                step = self._last_saved_step
+            if step is None:
+                return
+            try:
+                self.wait(raise_errors=False)  # flush queued async saves
+            except Exception:
+                pass
+            self._write_checkpoint(
+                int(step), self._collect_arrays(), self._gather_extra(),
+                trigger=self.EMERGENCY_PREFIX + trigger)
+            from .monitor import counter as _counter, enabled as _mon
+
+            if _mon():
+                _counter("checkpoint.emergency_saves").inc()
+        except Exception:
+            pass

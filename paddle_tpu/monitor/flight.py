@@ -138,7 +138,14 @@ class FlightRecorder:
         """Write header + every ring event as JSONL.  `path` defaults to
         FLAGS.flight_dir/flight-<pid>-<trigger>.jsonl; returns the path
         written, or None when no destination is configured.  Never raises
-        (a crash dump must not mask the crash)."""
+        (a crash dump must not mask the crash).
+
+        Dying-run triggers (EMERGENCY_TRIGGERS) first run the registered
+        emergency callbacks — e.g. io.CheckpointManager's best-effort
+        final save — BEFORE the record is written, so the events those
+        callbacks emit land in the dump."""
+        if trigger in EMERGENCY_TRIGGERS:
+            _run_emergency(trigger)
         try:
             if path is None:
                 from ..flags import FLAGS
@@ -183,6 +190,42 @@ def note_step(step: int, loss: Optional[float] = None) -> None:
 def dump(path: Optional[str] = None, trigger: str = "manual",
          extra: Optional[dict] = None) -> Optional[str]:
     return _default.dump(path, trigger, extra)
+
+
+# ---------------------------------------------------------------------------
+# Emergency callbacks (preemption-safe saves ride the dump signal path)
+# ---------------------------------------------------------------------------
+
+# dump() triggers that mean "this run is dying" (vs. probes/normal exit):
+# only these fire the emergency callbacks.
+EMERGENCY_TRIGGERS = ("sigterm", "watchdog", "crash")
+
+_emergency_cbs: List = []
+
+
+def on_emergency(cb) -> None:
+    """Register `cb(trigger)` to run when a dying-run dump fires (SIGTERM,
+    watchdog trip, crash) — io.CheckpointManager.install_emergency() hangs
+    its best-effort final save here.  Idempotent per callback object."""
+    if cb not in _emergency_cbs:
+        _emergency_cbs.append(cb)
+
+
+def remove_emergency(cb) -> None:
+    try:
+        _emergency_cbs.remove(cb)
+    except ValueError:
+        pass
+
+
+def _run_emergency(trigger: str) -> None:
+    """Best-effort, exception-proof: the dying path must reach the dump
+    whatever a callback does."""
+    for cb in list(_emergency_cbs):
+        try:
+            cb(trigger)
+        except Exception:
+            pass
 
 
 # ---------------------------------------------------------------------------

@@ -9,3 +9,4 @@ from .decorator import (  # noqa: F401
     xmap_readers,
     cache,
 )
+from .decorator import StatefulReader  # noqa: F401

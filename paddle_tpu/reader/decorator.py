@@ -282,3 +282,48 @@ def double_buffer(batch_reader, capacity=2):
             yield item
 
     return reader
+
+
+class StatefulReader:
+    """A reader creator with a RESUMABLE epoch/offset cursor (checkpoint v2
+    state provider — io.CheckpointManager.register_state).
+
+    Wraps any reader creator; each __call__ yields one epoch while the
+    cursor tracks (epoch, items yielded this epoch).  After
+    load_state_dict, the NEXT epoch iterated fast-forwards past `offset`
+    items, so a resumed run consumes exactly the samples the killed run
+    never saw — required for bit-exact kill/resume (the underlying reader
+    must be deterministic for a given epoch, as shuffle(seeded) readers
+    are).
+
+        sreader = StatefulReader(my_creator)
+        mgr.register_state("reader", sreader)
+        for feed in sreader():       # one epoch, cursor maintained
+            ...
+    """
+
+    def __init__(self, reader_creator: ReaderCreator):
+        self.creator = reader_creator
+        self.epoch = 0
+        self.offset = 0
+        self._pending_skip = 0
+
+    def __call__(self):
+        skip, self._pending_skip = self._pending_skip, 0
+        n = 0
+        for item in self.creator():
+            n += 1
+            if n <= skip:
+                continue
+            self.offset = n
+            yield item
+        self.epoch += 1
+        self.offset = 0
+
+    def state_dict(self) -> dict:
+        return {"epoch": int(self.epoch), "offset": int(self.offset)}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.epoch = int(d["epoch"])
+        self.offset = int(d["offset"])
+        self._pending_skip = self.offset

@@ -1,0 +1,8 @@
+"""Test-support subpackage: deterministic fault injection (chaos.py).
+
+Production modules import `paddle_tpu.testing.chaos` and call its hooks at
+their fault points; every hook is a no-op unless FLAGS_chaos is on, so the
+subpackage is safe (and free) to import from the runtime itself.
+"""
+
+from . import chaos  # noqa: F401

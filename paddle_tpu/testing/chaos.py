@@ -1,0 +1,182 @@
+"""Deterministic fault injection for fault-tolerance tests (chaos harness).
+
+Reference role: the Go pserver/master tests prove recovery by killing the
+process under test and asserting the restart path
+(go/pserver/client/client_test.go kills pserver instances mid-train); the
+reference Python suite had no equivalent.  This module is the single place
+the repo injects faults, so the injection schedule is deterministic and the
+production hooks are auditable:
+
+  * process kill at step N / executor-run N   (preemption, `kill -9`)
+  * torn checkpoint write                      (truncate a tensor file of
+    the Nth save after its manifest is computed — a disk-level tear)
+  * transient OSError on open/rename           (first K guarded I/O calls
+    raise; retry loops ride past them)
+  * feed stall                                 (sleep per parsed batch)
+  * NaN loss at step N                         (training loops substitute)
+
+Gating: every hook first checks FLAGS_chaos (the master switch); when it is
+off — the default — hooks return immediately without touching any state, so
+production call sites pay one flag read.  All schedules count
+process-globally and deterministically (no wall clock, no unseeded RNG):
+the same flags reproduce the same faults.  `kill()` uses SIGKILL — no
+cleanup, no atexit — because real preemption doesn't run your handlers
+either.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..flags import FLAGS
+
+
+def enabled() -> bool:
+    """The master switch (FLAGS_chaos)."""
+    return FLAGS.chaos
+
+
+class _State:
+    """Process-wide injection bookkeeping, reset()-able for tests."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.io_errors_left = None  # lazily seeded from FLAGS.chaos_io_errors
+        self.run_count = 0
+        self.save_count = 0
+        self.injected = {}  # kind -> count (introspection for tests)
+
+
+_state = _State()
+
+
+def reset() -> None:
+    """Forget all injection counters (test isolation)."""
+    global _state
+    _state = _State()
+
+
+def injected_counts() -> dict:
+    with _state.lock:
+        return dict(_state.injected)
+
+
+def _count(kind: str) -> None:
+    with _state.lock:
+        _state.injected[kind] = _state.injected.get(kind, 0) + 1
+    try:
+        from ..monitor import counter, enabled as _mon
+
+        if _mon():
+            counter(f"chaos.injected.{kind}").inc()
+    except Exception:
+        pass
+
+
+def kill(reason: str) -> None:
+    """Die NOW, the way preemption kills you: SIGKILL, no cleanup.  A
+    best-effort line on stderr names the injection for test logs."""
+    import signal
+    import sys
+
+    try:
+        print(f"[chaos] killing process: {reason}", file=sys.stderr,
+              flush=True)
+    except Exception:
+        pass
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+# -- hooks (each: one flag read when chaos is off) --------------------------
+
+
+def on_step(step: int) -> None:
+    """Training loops report each completed step; dies at
+    FLAGS.chaos_kill_at_step."""
+    if not enabled():
+        return
+    if FLAGS.chaos_kill_at_step >= 0 and step == FLAGS.chaos_kill_at_step:
+        _count("kill_at_step")
+        kill(f"kill_at_step {step}")
+
+
+def on_executor_run() -> None:
+    """The executor reports each run() call; dies at the
+    FLAGS.chaos_kill_at_run-th call (1-based)."""
+    if not enabled():
+        return
+    if FLAGS.chaos_kill_at_run < 0:
+        return
+    with _state.lock:
+        _state.run_count += 1
+        n = _state.run_count
+    if n == FLAGS.chaos_kill_at_run:
+        _count("kill_at_run")
+        kill(f"kill_at_run {n}")
+
+
+def maybe_io_error(site: str) -> None:
+    """Guarded I/O points (checkpoint rename/open, shard open, download)
+    call this; the first FLAGS.chaos_io_errors calls raise a transient
+    OSError — the budget is process-global, so a retry loop rides past
+    them deterministically."""
+    if not enabled():
+        return
+    with _state.lock:
+        if _state.io_errors_left is None:
+            _state.io_errors_left = int(FLAGS.chaos_io_errors)
+        if _state.io_errors_left <= 0:
+            return
+        _state.io_errors_left -= 1
+        k = _state.io_errors_left
+    _count("io_error")
+    raise OSError(f"chaos[{site}]: injected transient I/O error "
+                  f"({k} more to come)")
+
+
+def maybe_tear(path: str) -> None:
+    """Checkpoint writers call this once per save, after the manifest is
+    computed and before the commit rename; the FLAGS.chaos_torn_write-th
+    save (0-based) gets `path` truncated to half its length — the
+    disk-level torn write the manifest verification must catch."""
+    if not enabled():
+        return
+    if FLAGS.chaos_torn_write < 0:
+        return
+    with _state.lock:
+        n = _state.save_count
+        _state.save_count += 1
+    if n != FLAGS.chaos_torn_write:
+        return
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+        _count("torn_write")
+    except OSError:
+        pass  # nothing to tear: the save itself already failed
+
+
+def maybe_feed_stall() -> None:
+    """Data-feed workers call this per parsed batch; sleeps
+    FLAGS.chaos_feed_stall_s (feed-starvation simulation)."""
+    if not enabled():
+        return
+    s = FLAGS.chaos_feed_stall_s
+    if s > 0:
+        _count("feed_stall")
+        import time
+
+        time.sleep(s)
+
+
+def nan_loss(step: int, loss):
+    """Training loops pass each step's loss through; at
+    FLAGS.chaos_nan_at_step the loss comes back NaN (watchdog fodder)."""
+    if not enabled():
+        return loss
+    if FLAGS.chaos_nan_at_step >= 0 and step == FLAGS.chaos_nan_at_step:
+        _count("nan_loss")
+        return float("nan")
+    return loss

@@ -1,0 +1,3 @@
+"""Small host-side utilities shared across subsystems (no jax imports)."""
+
+from .retry import RetryError, backoff_delays, retry_call  # noqa: F401

@@ -1,0 +1,111 @@
+"""Shared retry-with-backoff (reference role: the Go pserver/master clients
+retry RPCs with backoff on lost connections, go/master/client.go RetryBuffer
+idiom; the reference Python had no shared utility, so every call site —
+dataset downloads, checkpoint writes — either raised on the first transient
+error or hand-rolled a loop).
+
+One policy, three production call sites: checkpoint writes (io.py
+CheckpointManager), AsyncExecutor shard workers (data_feed.py), and dataset
+downloads (dataset/common.py).  Jittered exponential backoff with a delay
+cap and a typed give-up exception; deterministic when seeded (the chaos
+tests pin `seed` so injected-fault schedules replay exactly).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+
+class RetryError(RuntimeError):
+    """Give-up: every attempt failed.  Carries the last exception
+    (`.last`, also the __cause__) and the attempt count (`.attempts`)."""
+
+    def __init__(self, msg: str, last: BaseException, attempts: int):
+        super().__init__(msg)
+        self.last = last
+        self.attempts = attempts
+
+
+def backoff_delays(
+    retries: int,
+    base_delay: float = 0.05,
+    factor: float = 2.0,
+    max_delay: float = 2.0,
+    jitter: float = 0.25,
+    seed: Optional[int] = None,
+) -> Iterator[float]:
+    """Yield `retries` sleep durations: capped exponential with
+    multiplicative jitter in [1-jitter, 1+jitter].  `seed` pins the jitter
+    sequence (tests / deterministic chaos replay)."""
+    rng = random.Random(seed) if seed is not None else random
+    for i in range(retries):
+        d = min(max_delay, base_delay * (factor ** i))
+        if jitter:
+            d *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
+        yield max(0.0, d)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    retries: int = 3,
+    base_delay: float = 0.05,
+    factor: float = 2.0,
+    max_delay: float = 2.0,
+    jitter: float = 0.25,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    seed: Optional[int] = None,
+    name: str = "",
+    on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
+    sleep: Optional[Callable[[float], None]] = None,
+    **kwargs,
+):
+    """Call `fn(*args, **kwargs)`; on a `retry_on` exception, back off and
+    retry up to `retries` more times, then raise RetryError (cause = the
+    last exception).  Exceptions NOT in `retry_on` propagate immediately —
+    a programming error must not be retried into silence.
+
+    `on_retry(exc, attempt, delay)` observes each scheduled retry (the
+    call sites log / bump monitor counters there); `name` labels the
+    default telemetry.  Total attempts = retries + 1."""
+    if sleep is None:
+        sleep = time.sleep  # resolved per call: tests patch time.sleep
+    delays = backoff_delays(retries, base_delay, factor, max_delay,
+                            jitter, seed)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            try:
+                delay = next(delays)
+            except StopIteration:
+                raise RetryError(
+                    f"{name or getattr(fn, '__name__', 'call')}: giving up "
+                    f"after {attempt} attempts: {type(e).__name__}: {e}",
+                    e, attempt) from e
+            if on_retry is not None:
+                on_retry(e, attempt, delay)
+            else:
+                _note_retry(name, e, attempt)
+            if delay > 0:
+                sleep(delay)
+
+
+def _note_retry(name: str, exc: BaseException, attempt: int) -> None:
+    """Default retry telemetry: a monitor counter + flight event per
+    scheduled retry (both no-ops while FLAGS.monitor is off)."""
+    try:
+        from ..monitor import counter, enabled
+        from ..monitor import flight
+
+        if enabled():
+            counter(f"retry.{name or 'anonymous'}").inc()
+            flight.record("retry", site=name or "anonymous",
+                          attempt=attempt,
+                          error=f"{type(exc).__name__}: {exc}")
+    except Exception:
+        pass  # telemetry must never break the retried operation

@@ -35,6 +35,13 @@ jax.config.update("jax_platforms", _platform)
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests excluded from the tier-1 quick gate "
+        "(-m 'not slow'); tools/run_ci.sh runs the suite unfiltered")
+
+
 def pytest_sessionfinish(session, exitstatus):
     """PT_DUMP_LOWERED_OPS=<path>: write the executed-op set observed this
     session (one op type per line) — the maintenance tool for the

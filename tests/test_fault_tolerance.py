@@ -1,0 +1,493 @@
+"""Fault-tolerant training (checkpoint v2 + chaos harness).
+
+Proves the recovery story end to end: integrity-manifested tear-proof
+checkpoints, fallback past corrupt/partial ones with a NAMED reason,
+preemption-safe emergency saves through the flight-recorder signal path,
+async (non-blocking) saves, and a killed training subprocess resuming
+BIT-EXACT to the uninterrupted run — the Go pserver checkpoint/recover
+capability (go/pserver/service.go:119-205) this layer reproduces.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.monitor import flight
+from paddle_tpu.testing import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS_TRAIN = os.path.join(REPO, "tools", "chaos_train.py")
+
+CHAOS_FLAG_NAMES = [
+    "chaos", "chaos_kill_at_step", "chaos_kill_at_run", "chaos_torn_write",
+    "chaos_io_errors", "chaos_feed_stall_s", "chaos_nan_at_step",
+]
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolation():
+    """Chaos flags, injection counters, emergency callbacks, and the
+    monitor gate must not leak between tests."""
+    yield
+    for n in CHAOS_FLAG_NAMES + ["monitor", "checkpoint_async"]:
+        FLAGS.reset(n)
+    chaos.reset()
+    flight._emergency_cbs.clear()
+
+
+def _build_model():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1,
+                     param_attr=pt.param_attr.ParamAttr(name="ft_w"))
+    loss = layers.mean(layers.square(pred - y))
+    pt.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                   momentum=0.9).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    return exe, loss
+
+
+def _batch(step):
+    r = np.random.RandomState(step)
+    xv = r.randn(8, 4).astype("float32")
+    return {"x": xv, "y": xv.sum(1, keepdims=True).astype("float32")}
+
+
+def _train_and_checkpoint(mgr, exe, loss, steps):
+    for step in range(steps):
+        exe.run(feed=_batch(step), fetch_list=[loss])
+        mgr.on_step(step)
+
+
+def _corrupt_tensor_payload(ckpt_dir):
+    """Rewrite the tensor file as a VALID npz with perturbed values: the
+    zip parses fine, so only the manifest sha256 can catch it."""
+    path = os.path.join(ckpt_dir, pt.io.CKPT_TENSOR_FILE)
+    data = dict(np.load(path))
+    first = sorted(data)[0]
+    data[first] = data[first] + 1.0
+    np.savez(path, **data)
+    return first
+
+
+# ---------------------------------------------------------------------------
+# manifest + verification + fallback
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_written_and_verifies(tmp_path):
+    exe, loss = _build_model()
+    mgr = pt.io.CheckpointManager(str(tmp_path), exe, interval_steps=3)
+    _train_and_checkpoint(mgr, exe, loss, 4)
+    d = os.path.join(str(tmp_path), "ckpt-2")
+    man = pt.io.read_manifest(d)
+    assert man["format"] == pt.io.CKPT_FORMAT_VERSION
+    assert man["step"] == 2
+    assert man["trigger"] == "interval"
+    # per-tensor integrity entries: sha256 + dtype + shape
+    assert "ft_w" in man["tensors"]
+    spec = man["tensors"]["ft_w"]
+    assert len(spec["sha256"]) == 64
+    assert spec["dtype"] == "float32" and spec["shape"] == [4, 1]
+    # optimizer accumulators ride along (persistable scope state)
+    assert any(n.endswith("_velocity_0") for n in man["tensors"])
+    # RNG counters are in the manifest (bit-exact dropout replay)
+    assert "executor_run_counter" in man["extra_state"]["rng"]
+    assert pt.io.verify_checkpoint(d) is None
+
+
+def test_corrupt_tensor_named_and_fallback(tmp_path):
+    FLAGS.monitor = True
+    import paddle_tpu.monitor as monitor
+
+    exe, loss = _build_model()
+    mgr = pt.io.CheckpointManager(str(tmp_path), exe, interval_steps=3)
+    _train_and_checkpoint(mgr, exe, loss, 7)  # ckpt-2 and ckpt-5
+    name = _corrupt_tensor_payload(os.path.join(str(tmp_path), "ckpt-5"))
+    reason = mgr.verify(5)
+    assert reason is not None and name in reason and "sha256" in reason
+    before = monitor.counter("checkpoint.corrupt_skipped_total").value
+    assert mgr.resume() == 3  # fell back past the corrupt ckpt-5
+    assert mgr.skipped == [(5, reason)]
+    assert monitor.counter(
+        "checkpoint.corrupt_skipped_total").value == before + 1
+
+
+def test_corrupt_manifest_fallback(tmp_path):
+    exe, loss = _build_model()
+    mgr = pt.io.CheckpointManager(str(tmp_path), exe, interval_steps=3)
+    _train_and_checkpoint(mgr, exe, loss, 7)
+    mpath = os.path.join(str(tmp_path), "ckpt-5", pt.io.MANIFEST_NAME)
+    with open(mpath, "w") as f:
+        f.write('{"format": 2, "tensors": {"trunc')  # torn manifest write
+    assert mgr.resume() == 3
+    assert mgr.skipped and "manifest" in mgr.skipped[0][1]
+
+
+def test_missing_manifest_is_a_named_reason(tmp_path):
+    exe, loss = _build_model()
+    mgr = pt.io.CheckpointManager(str(tmp_path), exe, interval_steps=3)
+    _train_and_checkpoint(mgr, exe, loss, 4)
+    os.remove(os.path.join(str(tmp_path), "ckpt-2", pt.io.MANIFEST_NAME))
+    assert mgr.resume() == 0
+    assert "MANIFEST.json" in mgr.skipped[0][1]
+
+
+def test_save_crash_window_regression(tmp_path):
+    """The v1 rmtree-then-replace window could destroy the ONLY checkpoint
+    at a step; v2's rename-only commit must leave the previous checkpoint
+    loadable when a save dies at any I/O point (simulated via chaos
+    transient-error injection exhausting the retry budget)."""
+    exe, loss = _build_model()
+    mgr = pt.io.CheckpointManager(str(tmp_path), exe, interval_steps=3)
+    _train_and_checkpoint(mgr, exe, loss, 4)  # ckpt-2 on disk
+    assert mgr.steps_on_disk() == [2]
+
+    from paddle_tpu.utils.retry import RetryError
+
+    FLAGS.chaos = True
+    FLAGS.chaos_io_errors = 50  # > every retry budget: the save must fail
+    with pytest.raises(RetryError):
+        mgr.save(5)
+    FLAGS.reset("chaos")
+    chaos.reset()
+    # the failed save left no debris resume would trust, and the previous
+    # checkpoint survived intact
+    assert mgr.steps_on_disk() == [2]
+    assert pt.io.verify_checkpoint(os.path.join(str(tmp_path),
+                                                "ckpt-2")) is None
+    assert mgr.resume() == 3
+
+
+def test_chaos_torn_write_detected(tmp_path):
+    """A disk-level torn write (file truncated AFTER the manifest hashed
+    it) must be caught by verification and walked past."""
+    exe, loss = _build_model()
+    FLAGS.chaos = True
+    FLAGS.chaos_torn_write = 1  # tear the SECOND save (0-based)
+    mgr = pt.io.CheckpointManager(str(tmp_path), exe, interval_steps=3)
+    _train_and_checkpoint(mgr, exe, loss, 7)  # saves at 2 (ok) and 5 (torn)
+    assert chaos.injected_counts().get("torn_write") == 1
+    reason = mgr.verify(5)
+    assert reason is not None  # truncation: unreadable or sha mismatch
+    assert mgr.verify(2) is None
+    assert mgr.resume() == 3
+    assert mgr.skipped[0][0] == 5
+
+
+# ---------------------------------------------------------------------------
+# extended state: RNG + StatefulReader cursor
+# ---------------------------------------------------------------------------
+
+
+def test_stateful_reader_cursor_roundtrip():
+    from paddle_tpu.reader import StatefulReader
+
+    r = StatefulReader(lambda: iter(range(5)))
+    it = r()
+    assert [next(it) for _ in range(3)] == [0, 1, 2]
+    st = r.state_dict()
+    assert st == {"epoch": 0, "offset": 3}
+
+    # a fresh incarnation resumes exactly where the old one died
+    r2 = StatefulReader(lambda: iter(range(5)))
+    r2.load_state_dict(st)
+    assert list(r2()) == [3, 4]
+    assert r2.state_dict() == {"epoch": 1, "offset": 0}
+    assert list(r2()) == [0, 1, 2, 3, 4]  # next epoch is complete again
+
+
+def test_rng_state_roundtrips_through_checkpoint(tmp_path):
+    exe, loss = _build_model()
+    mgr = pt.io.CheckpointManager(str(tmp_path), exe, interval_steps=1)
+    np.random.seed(1234)
+    np.random.rand(3)  # advance
+    exe.run(feed=_batch(0), fetch_list=[loss])
+    mgr.on_step(0)  # saves (host RNG + executor counter in manifest)
+    expect_np = np.random.rand(4)  # the stream the resumed run must see
+    expect_counter = exe._run_counter
+
+    np.random.seed(999)  # trash host RNG; executor counter drifts too
+    exe.run(feed=_batch(1), fetch_list=[loss])
+    assert mgr.resume() == 1
+    assert exe._run_counter == expect_counter
+    np.testing.assert_array_equal(np.random.rand(4), expect_np)
+
+
+# ---------------------------------------------------------------------------
+# async save
+# ---------------------------------------------------------------------------
+
+
+def test_async_save_does_not_block_step_loop(tmp_path, monkeypatch):
+    """With a deliberately slow disk (fsync sleeps), async save() must
+    return in a fraction of the write time — the step loop never blocks —
+    while the checkpoint still lands complete and verifiable."""
+    WRITE_DELAY = 0.4
+    real_fsync = pt.io._fsync_path
+    monkeypatch.setattr(
+        pt.io, "_fsync_path",
+        lambda p: (time.sleep(WRITE_DELAY), real_fsync(p)))
+    exe, loss = _build_model()
+
+    sync_mgr = pt.io.CheckpointManager(
+        str(tmp_path / "sync"), exe, interval_steps=1, async_save=False)
+    t0 = time.perf_counter()
+    sync_mgr.save(0)
+    sync_elapsed = time.perf_counter() - t0
+    assert sync_elapsed >= WRITE_DELAY  # the slow disk is real
+
+    mgr = pt.io.CheckpointManager(
+        str(tmp_path / "async"), exe, interval_steps=1, async_save=True)
+    exe.run(feed=_batch(0), fetch_list=[loss])  # compile outside the clock
+    t0 = time.perf_counter()
+    exe.run(feed=_batch(1), fetch_list=[loss])
+    mgr.on_step(0)  # enqueues the write
+    step_elapsed = time.perf_counter() - t0
+    assert step_elapsed < WRITE_DELAY / 2, (
+        f"async save blocked the step loop for {step_elapsed:.3f}s")
+    mgr.wait()
+    assert mgr.verify(0) is None
+    man = pt.io.read_manifest(str(tmp_path / "async" / "ckpt-0"))
+    assert man["step"] == 0
+    mgr.close()
+
+
+def test_async_save_backlog_drops_oldest_not_newest(tmp_path, monkeypatch):
+    """A disk slower than the save interval must not grow memory without
+    bound: the bounded writer queue drops the OLDEST pending snapshot and
+    the newest state always lands."""
+    import threading
+
+    gate = threading.Event()
+    real_fsync = pt.io._fsync_path
+    monkeypatch.setattr(pt.io, "_fsync_path",
+                        lambda p: (gate.wait(10), real_fsync(p)))
+    exe, loss = _build_model()
+    mgr = pt.io.CheckpointManager(
+        str(tmp_path), exe, interval_steps=1, async_save=True, keep_last=2)
+    for s in range(5):
+        mgr.save(s)  # writer blocked: backlog forces drops
+    gate.set()
+    mgr.wait()
+    assert mgr.steps_on_disk() == [3, 4]  # newest survived, keep_last holds
+    assert mgr.verify(4) is None
+    mgr.close()
+
+
+def test_async_save_surfaces_write_errors(tmp_path):
+    exe, loss = _build_model()
+    mgr = pt.io.CheckpointManager(
+        str(tmp_path), exe, interval_steps=1, async_save=True)
+    FLAGS.chaos = True
+    FLAGS.chaos_io_errors = 50
+    mgr.save(0)
+    with pytest.raises(RuntimeError, match="async checkpoint write"):
+        mgr.wait()
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos hooks are no-ops when off
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_hooks_noop_when_flags_off():
+    assert not chaos.enabled()
+    chaos.on_step(0)            # would SIGKILL if armed
+    chaos.on_executor_run()
+    chaos.maybe_io_error("test")  # would raise if armed
+    chaos.maybe_feed_stall()
+    chaos.maybe_tear("/nonexistent/never-touched")
+    assert chaos.nan_loss(0, 1.5) == 1.5
+    assert chaos.injected_counts() == {}
+
+
+def test_chaos_nan_injection():
+    FLAGS.chaos = True
+    FLAGS.chaos_nan_at_step = 3
+    import math
+
+    assert chaos.nan_loss(2, 1.0) == 1.0
+    assert math.isnan(chaos.nan_loss(3, 1.0))
+    assert chaos.injected_counts().get("nan_loss") == 1
+
+
+def test_chaos_io_error_budget_is_deterministic():
+    FLAGS.chaos = True
+    FLAGS.chaos_io_errors = 2
+    with pytest.raises(OSError, match="chaos"):
+        chaos.maybe_io_error("site_a")
+    with pytest.raises(OSError, match="chaos"):
+        chaos.maybe_io_error("site_b")
+    chaos.maybe_io_error("site_c")  # budget spent: clean from here on
+    assert chaos.injected_counts().get("io_error") == 2
+
+
+# ---------------------------------------------------------------------------
+# emergency save (watchdog in-process; SIGTERM + kill -9 in subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_dump_triggers_emergency_save(tmp_path):
+    """watchdog_action=dump rides the flight-recorder dump path, which
+    fires the emergency checkpoint with the trigger in the manifest."""
+    from paddle_tpu.monitor import Watchdog
+
+    exe, loss = _build_model()
+    mgr = pt.io.CheckpointManager(str(tmp_path), exe, interval_steps=1000)
+    mgr.install_emergency()
+    exe.run(feed=_batch(0), fetch_list=[loss])
+    mgr.on_step(0)  # interval never fires; just marks the step
+    assert mgr.steps_on_disk() == []
+
+    wd = Watchdog(action="dump", min_steps=0)
+    wd.observe_step(0, float("nan"), dt=0.01)
+    assert [t.kind for t in wd.trips] == ["nan_loss"]
+    assert mgr.steps_on_disk() == [0]
+    man = pt.io.read_manifest(os.path.join(str(tmp_path), "ckpt-0"))
+    assert man["trigger"] == "emergency:watchdog"
+    assert pt.io.verify_checkpoint(os.path.join(str(tmp_path),
+                                                "ckpt-0")) is None
+
+
+def _tool_env(extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("FLAGS_monitor", None)
+    env.pop("XLA_FLAGS", None)  # no 8-device mesh: faster jax startup
+    if extra:
+        env.update(extra)
+    return env
+
+
+def test_emergency_save_labels_inflight_step(tmp_path):
+    """A preemption signal delivered during the executor run is handled
+    AFTER the run returns — params already carry that step's update, so
+    the emergency checkpoint must be labelled with the in-flight step
+    (step_started), not the last completed one."""
+    exe, loss = _build_model()
+    mgr = pt.io.CheckpointManager(str(tmp_path), exe, interval_steps=1000)
+    mgr.install_emergency()
+    exe.run(feed=_batch(0), fetch_list=[loss])
+    mgr.on_step(0)
+    # step 1 "in flight": the update has landed, on_step(1) hasn't run yet
+    mgr.step_started(1)
+    exe.run(feed=_batch(1), fetch_list=[loss])
+    flight.dump(trigger="sigterm")  # what the real handler invokes
+    man = pt.io.read_manifest(os.path.join(str(tmp_path), "ckpt-1"))
+    assert man["step"] == 1
+    assert man["trigger"] == "emergency:sigterm"
+    # completing the step clears the marker: a later trigger labels 1 too
+    mgr.on_step(1)
+    assert mgr._inflight_step is None
+
+
+def _run_tool(args, env_extra=None, timeout=180):
+    return subprocess.run(
+        [sys.executable, CHAOS_TRAIN] + args,
+        capture_output=True, text=True, env=_tool_env(env_extra),
+        timeout=timeout)
+
+
+BASE_ARGS = ["--steps", "12", "--interval", "3"]
+
+
+@pytest.fixture(scope="module")
+def killed_run(tmp_path_factory):
+    """One uninterrupted run + one SIGKILLed-at-step-7 run (checkpoints at
+    2 and 5), shared by the resume tests.  Subprocess startup is the
+    expensive part, and the two runs are independent — run them
+    concurrently."""
+    root = tmp_path_factory.mktemp("chaos")
+    a_out = str(root / "a.npz")
+    pa = subprocess.Popen(
+        [sys.executable, CHAOS_TRAIN, "--ckpt-dir", str(root / "a")]
+        + BASE_ARGS + ["--out", a_out],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=_tool_env())
+    pb = subprocess.Popen(
+        [sys.executable, CHAOS_TRAIN, "--ckpt-dir", str(root / "b")]
+        + BASE_ARGS,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=_tool_env({"FLAGS_chaos": "1",
+                       "FLAGS_chaos_kill_at_step": "7"}))
+    out_a, err_a = pa.communicate(timeout=180)
+    pb.communicate(timeout=180)
+    assert pa.returncode == 0, err_a
+    rec_a = json.loads(out_a.strip().splitlines()[-1])
+    assert rec_a["start"] == 0 and rec_a["steps_run"] == 12
+    assert pb.returncode == -signal.SIGKILL, pb.returncode
+
+    import shutil
+
+    shutil.copytree(str(root / "b"), str(root / "c"))  # for the corrupt leg
+    return {"root": root, "a_out": a_out, "rec_a": rec_a}
+
+
+def test_kill_resume_bit_exact(killed_run):
+    """THE acceptance test: a training subprocess SIGKILLed at a
+    chaos-chosen step, resumed from the latest verifiable checkpoint,
+    reaches the SAME final parameters as an uninterrupted run."""
+    root = killed_run["root"]
+    b_out = str(root / "b.npz")
+    r = _run_tool(["--ckpt-dir", str(root / "b")] + BASE_ARGS
+                  + ["--out", b_out])
+    assert r.returncode == 0, r.stderr
+    rec_b = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec_b["start"] == 6  # resumed from ckpt-5 (killed at step 7)
+
+    a, b = np.load(killed_run["a_out"]), np.load(b_out)
+    for k in a.files:
+        np.testing.assert_array_equal(
+            a[k], b[k], err_msg=f"param {k} not bit-exact after resume")
+    assert killed_run["rec_a"]["final_loss"] == rec_b["final_loss"]
+
+
+def test_kill_resume_past_corrupted_latest(killed_run):
+    """Kill, then corrupt the newest checkpoint: resume must DETECT it,
+    report the named reason, fall back to the previous checkpoint, and
+    still finish."""
+    c_dir = str(killed_run["root"] / "c")
+    _corrupt_tensor_payload(os.path.join(c_dir, "ckpt-5"))
+    r = _run_tool(["--ckpt-dir", c_dir] + BASE_ARGS)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["start"] == 3  # fell back to ckpt-2
+    assert rec["skipped"] and rec["skipped"][0][0] == 5
+    assert "sha256" in rec["skipped"][0][1]
+
+
+def test_emergency_save_on_sigterm(tmp_path):
+    """Preemption (SIGTERM) mid-run leaves a best-effort final checkpoint
+    whose manifest names the trigger — interval saves alone would have
+    left NOTHING here (interval >> steps)."""
+    proc = subprocess.Popen(
+        [sys.executable, CHAOS_TRAIN,
+         "--ckpt-dir", str(tmp_path / "e"),
+         "--steps", "50", "--interval", "1000",
+         "--sleep-at-step", "5", "--sleep-s", "60"],
+        stdout=subprocess.PIPE, text=True, env=_tool_env())
+    try:
+        line = proc.stdout.readline()  # blocks until the tool is mid-run
+        assert json.loads(line) == {"sleeping_at": 5}
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == -signal.SIGTERM or rc == 143  # conventional exit preserved
+    d = str(tmp_path / "e" / "ckpt-4")  # last completed step before sleep
+    assert pt.io.verify_checkpoint(d) is None
+    man = pt.io.read_manifest(d)
+    assert man["trigger"] == "emergency:sigterm"
+    assert man["step"] == 4

@@ -1,0 +1,255 @@
+"""Shared retry/backoff utility (utils/retry.py) and its production call
+sites: dataset downloads (re-download on corrupt fetch) and AsyncExecutor
+shard workers (retry-then-skip-and-count instead of aborting the job)."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.testing import chaos
+from paddle_tpu.utils.retry import RetryError, backoff_delays, retry_call
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolation():
+    yield
+    for n in ("chaos", "chaos_io_errors", "chaos_feed_stall_s", "monitor"):
+        FLAGS.reset(n)
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# retry_call
+# ---------------------------------------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    assert retry_call(flaky, retries=3, sleep=slept.append) == "ok"
+    assert len(calls) == 3
+    assert len(slept) == 2  # one backoff per failed attempt
+
+
+def test_retry_gives_up_with_typed_exception():
+    def always():
+        raise OSError("still down")
+
+    with pytest.raises(RetryError) as ei:
+        retry_call(always, retries=2, sleep=lambda s: None, name="unit")
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, OSError)
+    assert "unit" in str(ei.value) and "still down" in str(ei.value)
+
+
+def test_retry_does_not_swallow_unexpected_exceptions():
+    def bug():
+        raise KeyError("programming error")
+
+    with pytest.raises(KeyError):
+        retry_call(bug, retries=5, sleep=lambda s: None)
+
+
+def test_backoff_is_exponential_capped_and_deterministic_when_seeded():
+    a = list(backoff_delays(6, base_delay=0.1, factor=2.0, max_delay=1.0,
+                            jitter=0.25, seed=7))
+    b = list(backoff_delays(6, base_delay=0.1, factor=2.0, max_delay=1.0,
+                            jitter=0.25, seed=7))
+    assert a == b  # seeded => replayable schedule
+    raw = [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+    for d, r in zip(a, raw):
+        assert r * 0.75 <= d <= r * 1.25  # jitter stays within +-25%
+    assert max(a) <= 1.25  # cap holds even with jitter
+
+
+# ---------------------------------------------------------------------------
+# dataset download hardening
+# ---------------------------------------------------------------------------
+
+
+def _patch_data_home(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "home"))
+    return common
+
+
+def test_download_retries_flaky_opener(tmp_path, monkeypatch):
+    common = _patch_data_home(tmp_path, monkeypatch)
+    payload = b"dataset-bytes"
+    md5 = hashlib.md5(payload).hexdigest()
+    attempts = []
+
+    def flaky(url, tmp):
+        attempts.append(url)
+        if len(attempts) < 3:
+            raise OSError("connection reset")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+
+    monkeypatch.setattr(common, "_urlretrieve", flaky)
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    path = common.download("http://x/f.bin", "unit", md5)
+    assert open(path, "rb").read() == payload
+    assert len(attempts) == 3
+
+
+def test_download_redownloads_on_md5_mismatch(tmp_path, monkeypatch):
+    """A corrupt fetch is a transient fault: re-download, don't raise."""
+    common = _patch_data_home(tmp_path, monkeypatch)
+    good = b"good-bytes"
+    md5 = hashlib.md5(good).hexdigest()
+    served = [b"corrupt!", b"corrupt!", good]
+
+    def server(url, tmp):
+        with open(tmp, "wb") as f:
+            f.write(served.pop(0))
+
+    monkeypatch.setattr(common, "_urlretrieve", server)
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    path = common.download("http://x/f.bin", "unit", md5)
+    assert open(path, "rb").read() == good
+    assert not os.path.exists(path + ".part")  # no partials left behind
+
+
+def test_download_cleans_stale_partial_and_gives_up_with_path(
+        tmp_path, monkeypatch):
+    common = _patch_data_home(tmp_path, monkeypatch)
+    os.makedirs(os.path.join(common.DATA_HOME, "unit"), exist_ok=True)
+    stale = os.path.join(common.DATA_HOME, "unit", "f.bin.part")
+    open(stale, "wb").write(b"half-a-download")
+
+    def down(url, tmp):
+        raise OSError("offline")
+
+    monkeypatch.setattr(common, "_urlretrieve", down)
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    with pytest.raises(RuntimeError, match="f.bin"):
+        common.download("http://x/f.bin", "unit", "0" * 32, retries=1)
+    assert not os.path.exists(stale)  # stale partial was cleaned up
+
+
+# ---------------------------------------------------------------------------
+# AsyncExecutor shard fault tolerance
+# ---------------------------------------------------------------------------
+
+
+def _desc(batch_size=4):
+    desc = pt.DataFeedDesc(batch_size=batch_size)
+    desc.add_slot("dense", type="float", is_dense=True, dim=2)
+    desc.add_slot("label", type="float", is_dense=True, dim=1)
+    return desc
+
+
+def _write_shard(path, n_lines, start=0):
+    with open(path, "w") as f:
+        for i in range(start, start + n_lines):
+            f.write(f"2 {i % 7} {(i + 1) % 5} 1 {i % 2}\n")
+
+
+def _tiny_net():
+    dense = layers.data(name="dense", shape=[2], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="float32")
+    pred = layers.fc(dense, size=1)
+    loss = layers.mean(layers.square(pred - label))
+    pt.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    return exe, loss
+
+
+def test_shard_failure_skipped_and_counted(tmp_path):
+    """One malformed shard must cost its own batches, not the job: the
+    other shards train, the failure is counted
+    (data_feed_shard_failures_total) and named."""
+    FLAGS.monitor = True
+    import paddle_tpu.monitor as monitor
+
+    good1, bad, good2 = (str(tmp_path / n)
+                         for n in ("g1.txt", "bad.txt", "g2.txt"))
+    _write_shard(good1, 8)
+    _write_shard(good2, 8, start=8)
+    with open(bad, "w") as f:
+        f.write("2 1.0 2.0 1 0.0\nthis line is hopeless\n")
+
+    exe, loss = _tiny_net()
+    aexe = pt.AsyncExecutor(pt.CPUPlace())
+    aexe.executor = exe
+    before = monitor.counter("data_feed.shard_failures_total").value
+    res = aexe.run_from_files(
+        pt.default_main_program(), _desc(), [good1, bad, good2],
+        thread_num=2, fetch_list=[loss], shard_retries=1)
+    assert len(res) >= 4  # 16 good lines / batch 4 = 4 full batches
+    assert aexe.shard_failures == [bad]
+    assert monitor.counter(
+        "data_feed.shard_failures_total").value == before + 1
+
+
+def test_shard_failure_raises_when_asked(tmp_path):
+    bad = str(tmp_path / "bad.txt")
+    with open(bad, "w") as f:
+        f.write("not a multislot line\n")
+    exe, loss = _tiny_net()
+    aexe = pt.AsyncExecutor(pt.CPUPlace())
+    aexe.executor = exe
+    with pytest.raises(RetryError):
+        aexe.run_from_files(
+            pt.default_main_program(), _desc(), [bad], thread_num=1,
+            fetch_list=[loss], shard_retries=0, on_shard_error="raise")
+
+
+def test_shard_transient_fault_retried_to_success(tmp_path):
+    """Chaos-injected transient I/O faults on the read path: the worker
+    retries with backoff and delivers EVERY batch exactly once."""
+    f1 = str(tmp_path / "s1.txt")
+    _write_shard(f1, 12)
+    exe, loss = _tiny_net()
+    aexe = pt.AsyncExecutor(pt.CPUPlace())
+    aexe.executor = exe
+    FLAGS.chaos = True
+    FLAGS.chaos_io_errors = 2  # first two read attempts die
+    res = aexe.run_from_files(
+        pt.default_main_program(), _desc(), [f1], thread_num=1,
+        fetch_list=[loss], shard_retries=3)
+    assert len(res) == 3  # 12 lines / batch 4, no duplicates, none lost
+    assert aexe.shard_failures == []
+    assert chaos.injected_counts().get("io_error") == 2
+
+
+def test_mid_file_retry_does_not_duplicate_batches(tmp_path, monkeypatch):
+    """A fault striking MID-file (some batches already queued) must not
+    re-deliver them on retry — the yielded-count cursor skips them."""
+    f1 = str(tmp_path / "s1.txt")
+    _write_shard(f1, 12)  # 3 batches of 4
+    exe, loss = _tiny_net()
+    aexe = pt.AsyncExecutor(pt.CPUPlace())
+    aexe.executor = exe
+
+    real_read = pt.MultiSlotDataFeed.read_file
+    state = {"fail_once": True}
+
+    def flaky_read(self, path):
+        it = real_read(self, path)
+        yield next(it)  # first batch parses fine...
+        if state.pop("fail_once", None):
+            raise OSError("disk hiccup mid-file")
+        for feed in it:
+            yield feed
+
+    monkeypatch.setattr(pt.MultiSlotDataFeed, "read_file", flaky_read)
+    res = aexe.run_from_files(
+        pt.default_main_program(), _desc(), [f1], thread_num=1,
+        fetch_list=[loss], shard_retries=2)
+    assert len(res) == 3  # exactly once each, despite the mid-file retry

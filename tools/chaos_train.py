@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Deterministic chaos-testable training loop — the subprocess target for
+tests/test_fault_tolerance.py and the run_ci.sh chaos smoke gate.
+
+A tiny fc+dropout regression trains over a FIXED dataset through a
+reader.StatefulReader (epoch/offset cursor checkpointed), with checkpoint
+v2 interval saves, emergency saves armed through the flight recorder, and
+every chaos hook live.  Every source of randomness is pinned (data from a
+fixed seed, dropout from the checkpointed executor RNG counter), so:
+
+    run A: uninterrupted N steps           -> params_A
+    run B: SIGKILLed at step K (chaos), then resumed to N -> params_B
+    assert params_A == params_B (bit-exact)
+
+Prints one JSON line {"start": resume_step, "steps_run": n, "final_loss":
+..., "ckpt_dir": ...} on success; --out saves the final params as .npz.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable from anywhere (tests invoke it by absolute path)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_dataset(n_batches, batch_size, dim, seed):
+    """The whole (tiny) dataset up front, deterministically: batch k is a
+    pure function of (seed, k), never of which process generates it."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim, 1).astype("float32")
+    batches = []
+    for _ in range(n_batches):
+        x = rng.randn(batch_size, dim).astype("float32")
+        y = (x @ w + 0.1 * rng.randn(batch_size, 1)).astype("float32")
+        batches.append({"x": x, "y": y})
+    return batches
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--interval", type=int, default=4)
+    p.add_argument("--batches-per-epoch", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--dim", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--dropout", type=float, default=0.2)
+    p.add_argument("--async-save", action="store_true")
+    p.add_argument("--out", default=None,
+                   help="write final params to this .npz path")
+    p.add_argument("--sleep-at-step", type=int, default=-1,
+                   help="pause --sleep-s before this step (lets a parent "
+                        "deliver SIGTERM mid-run)")
+    p.add_argument("--sleep-s", type=float, default=10.0)
+    args = p.parse_args()
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.monitor import flight
+    from paddle_tpu.reader import StatefulReader
+    from paddle_tpu.testing import chaos
+
+    x = layers.data(name="x", shape=[args.dim], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu",
+                  param_attr=pt.param_attr.ParamAttr(name="ct_w1"))
+    if args.dropout > 0:
+        # exercises the executor RNG counter: masks must REPLAY across a
+        # resume for bit-exact recovery (the counter rides the manifest)
+        h = layers.dropout(h, dropout_prob=args.dropout)
+    pred = layers.fc(h, size=1,
+                     param_attr=pt.param_attr.ParamAttr(name="ct_w2"))
+    loss = layers.mean(layers.square(pred - y))
+    pt.optimizer.MomentumOptimizer(
+        learning_rate=args.lr, momentum=0.9).minimize(loss)
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+
+    batches = build_dataset(args.batches_per_epoch, args.batch_size,
+                            args.dim, args.seed)
+    sreader = StatefulReader(lambda: iter(batches))
+
+    mgr = pt.io.CheckpointManager(
+        args.ckpt_dir, exe, interval_steps=args.interval,
+        async_save=args.async_save, keep_last=3)
+    mgr.register_state("reader", sreader)
+    flight.install()          # SIGTERM/crash hooks
+    mgr.install_emergency()   # ... trigger a final checkpoint
+
+    start = mgr.resume()
+
+    def batch_stream():
+        while True:
+            for feed in sreader():
+                yield feed
+
+    stream = batch_stream()
+    final_loss = None
+    n_run = 0
+    for step in range(start, args.steps):
+        if step == args.sleep_at_step:
+            print(json.dumps({"sleeping_at": step}), flush=True)
+            time.sleep(args.sleep_s)
+        feed = next(stream)
+        mgr.step_started(step)  # emergency saves mid-run label THIS step
+        (lv,) = exe.run(feed=feed, fetch_list=[loss])
+        final_loss = chaos.nan_loss(step, float(np.asarray(lv)))
+        flight.note_step(step, final_loss)
+        mgr.on_step(step)  # interval save + chaos kill-at-step hook
+        n_run += 1
+    mgr.wait()
+    mgr.close()
+
+    if args.out:
+        scope = pt.global_scope()
+        params = {n: np.asarray(scope.find_var(n))
+                  for n in ("ct_w1", "ct_w2")}
+        np.savez(args.out, **params)
+    print(json.dumps({
+        "start": start,
+        "steps_run": n_run,
+        "final_loss": final_loss,
+        "ckpt_dir": args.ckpt_dir,
+        "skipped": mgr.skipped,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,7 +11,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/4] lint gate"
+echo "== [1/5] lint gate"
 if command -v ruff >/dev/null 2>&1; then
   ruff check paddle_tpu tools bench.py __graft_entry__.py
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -21,11 +21,11 @@ else
   python -m compileall -q paddle_tpu tools bench.py __graft_entry__.py
 fi
 
-echo "== [2/4] test suite (virtual 8-device CPU mesh)"
+echo "== [2/5] test suite (virtual 8-device CPU mesh)"
 python -m pytest tests/ -q
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [3/4] bench smoke (telemetry on; snapshot + flight artifacts)"
+  echo "== [3/5] bench smoke (telemetry on; snapshot + flight artifacts)"
   mkdir -p ci_artifacts
   rm -f ci_artifacts/bench_steps.jsonl  # StepMonitor appends; keep one run
   rm -rf ci_artifacts/flight && mkdir -p ci_artifacts/flight
@@ -39,7 +39,42 @@ if [[ "${1:-}" != "fast" ]]; then
   head -3 ci_artifacts/flight/flight-*-atexit.jsonl || true
 fi
 
-echo "== [4/4] entry compile-check + multichip dryrun"
+if [[ "${1:-}" != "fast" ]]; then
+  echo "== [4/5] chaos smoke: kill-and-resume fault-tolerance gate"
+  # A training subprocess is SIGKILLed mid-run by the chaos harness, then
+  # resumed from the latest verifiable checkpoint; the gate passes when the
+  # resumed run reports a non-zero start step and finishes.  Artifacts: the
+  # recovered run's checkpoint MANIFEST.json + flight record.
+  rm -rf ci_artifacts/chaos && mkdir -p ci_artifacts/chaos/flight
+  set +e
+  JAX_PLATFORMS=cpu FLAGS_chaos=1 FLAGS_chaos_kill_at_step=6 \
+    FLAGS_flight_dir=ci_artifacts/chaos/flight \
+    python tools/chaos_train.py --ckpt-dir ci_artifacts/chaos/ckpt \
+      --steps 10 --interval 3 > ci_artifacts/chaos/killed_run.json
+  rc=$?
+  set -e
+  if [[ $rc -ne 137 ]]; then
+    echo "chaos gate: expected the run to be SIGKILLed (rc 137), got rc=$rc"
+    exit 1
+  fi
+  JAX_PLATFORMS=cpu FLAGS_flight_dir=ci_artifacts/chaos/flight \
+    python tools/chaos_train.py --ckpt-dir ci_artifacts/chaos/ckpt \
+      --steps 10 --interval 3 | tee ci_artifacts/chaos/resumed_run.json
+  python - <<'PY'
+import glob, json
+rec = json.loads(open("ci_artifacts/chaos/resumed_run.json").read().strip().splitlines()[-1])
+assert rec["start"] > 0, f"resume did not pick up a checkpoint: {rec}"
+man = max(glob.glob("ci_artifacts/chaos/ckpt/ckpt-*/MANIFEST.json"),
+          key=lambda p: int(p.split("ckpt-")[-1].split("/")[0]))
+m = json.load(open(man))
+print(f"chaos gate OK: resumed at step {rec['start']}, "
+      f"latest manifest step {m['step']} trigger {m['trigger']!r}")
+PY
+  echo "-- recovered manifest artifact:"
+  ls ci_artifacts/chaos/ckpt
+fi
+
+echo "== [5/5] entry compile-check + multichip dryrun"
 python __graft_entry__.py
 
 echo "CI OK"
