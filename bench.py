@@ -58,6 +58,11 @@ def run_guarded(name, fn, *args, retries=2):
         try:
             fn(*args)
             return True
+        except Warning:
+            # only reachable under an explicit -W error::UserWarning run
+            # (the CI warnings gate): a warning-turned-exception must FAIL
+            # the bench, not be swallowed as a workload hiccup
+            raise
         except Exception as e:  # noqa: BLE001 — bench must survive anything
             transient = _is_transient(e)
             print(f"[bench] {name} attempt {attempt + 1} failed "
@@ -132,12 +137,19 @@ def _bench_watchdog():
 
 
 def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None,
-                ckpt=None):
+                ckpt=None, repeats=1):
     """Shared warmup + timing loop: returns (seconds, first_loss,
     last_loss).  first_loss is step 0 of the first (warmup) call, so
     last_loss < first_loss certifies the timed program actually LEARNS on
     its (fixed, memorizable) batches — the reference's book tests assert
     loss thresholds the same way (tests/book/test_recognize_digits.py).
+
+    `repeats` repeats the `calls`-sized timed region that many times
+    against the SAME compiled program (warmup runs once, before the
+    first timed region).  The first return value is ALWAYS the list of
+    per-repeat seconds (length `repeats`) — the repeated-run protocol
+    PERF.md's tunnel-variance section demands before believing any
+    single number.
 
     `mon`: optional StepMonitor (see _step_monitor) — records per-call
     loss/throughput/MFU telemetry for the timed calls.
@@ -165,23 +177,26 @@ def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None,
         if i == 0:
             first_loss = float(np.asarray(losses).reshape(-1)[0])
     try:
+        dts = []
         stamps = []
         if mon is not None:
             mon.step(now=time.perf_counter())  # arm at region start
-        t0 = time.perf_counter()
-        for i in range(calls):
-            if ckpt is not None:
-                ckpt.step_started(i)
-            (losses,) = exe.run_steps(prog, feed=feed, fetch_list=fetch,
-                                      scope=scope)
-            if live:
-                mon.step(loss=float(np.asarray(losses).reshape(-1)[-1]),
-                         now=time.perf_counter())
-            elif mon is not None:
-                stamps.append((time.perf_counter(), losses))
-            if ckpt is not None:
-                ckpt.on_step(i)
-        dt = time.perf_counter() - t0
+        for rep in range(max(repeats, 1)):
+            t0 = time.perf_counter()
+            for i in range(calls):
+                step_no = rep * calls + i
+                if ckpt is not None:
+                    ckpt.step_started(step_no)
+                (losses,) = exe.run_steps(prog, feed=feed, fetch_list=fetch,
+                                          scope=scope)
+                if live:
+                    mon.step(loss=float(np.asarray(losses).reshape(-1)[-1]),
+                             now=time.perf_counter())
+                elif mon is not None:
+                    stamps.append((time.perf_counter(), losses))
+                if ckpt is not None:
+                    ckpt.on_step(step_no)
+            dts.append(time.perf_counter() - t0)
         if mon is not None:
             for now_i, lv in stamps:
                 mon.step(loss=float(np.asarray(lv).reshape(-1)[-1]),
@@ -193,7 +208,7 @@ def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None,
             mon.close()
         if ckpt is not None:
             ckpt.close()  # flush + detach the emergency callback
-    return dt, first_loss, float(np.asarray(losses).reshape(-1)[-1])
+    return dts, first_loss, float(np.asarray(losses).reshape(-1)[-1])
 
 
 def emit_metric(metric, value, unit, vs_baseline, mfu, loss, config,
@@ -214,6 +229,22 @@ def emit_metric(metric, value, unit, vs_baseline, mfu, loss, config,
         rec["learned"] = bool(loss < loss_first)
     print(json.dumps(rec), flush=True)
     return rec
+
+
+def _repeats(args):
+    """--runs N, defaulting to the PERF.md protocol: 3 timed repeats in a
+    full bench, 1 in smoke."""
+    return args.runs or (1 if args.smoke else 3)
+
+
+def _mean_spread(runs):
+    """(mean, spread, runs_list) of per-run throughputs.  The spread rides
+    into the JSON record so +-4-6% tunnel variance (PERF.md) can't
+    masquerade as a code-change regression or win."""
+    runs = [float(r) for r in (runs if isinstance(runs, list) else [runs])]
+    mean = float(np.mean(runs))
+    spread = float(np.max(runs) - np.min(runs)) if len(runs) > 1 else 0.0
+    return mean, spread, runs
 
 
 REFERENCE_RESNET50_IMGS_PER_SEC = 84.08
@@ -339,7 +370,8 @@ def bench_resnet50(batch_size=256, scan_steps=16, calls=2, warmup=1,
 
 
 def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
-                      warmup=1, amp=True, tiny=False, use_flash=True):
+                      warmup=1, amp=True, tiny=False, use_flash=True,
+                      repeats=1):
     import paddle_tpu as pt
     from paddle_tpu.models import transformer as T
 
@@ -380,10 +412,10 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
     ckpt = _ckpt_manager("transformer", exe, prog, scope)
     dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
                                             scope, warmup, calls, mon=mon,
-                                            ckpt=ckpt)
+                                            ckpt=ckpt, repeats=repeats)
     # tokens counted on the decoded (trg) stream, the convention for MT
-    tps = batch_size * seq_len * scan_steps * calls / dt
-    return tps, flops_tok, first_loss, last_loss
+    toks = batch_size * seq_len * scan_steps * calls
+    return [toks / d for d in dt], flops_tok, first_loss, last_loss
 
 
 def bench_ringattn(seq_len=8192, n_head=8, d_head=64, iters=8, warmup=2):
@@ -451,7 +483,7 @@ def bert_train_flops_per_token(n_layer, d_model, d_ff, seq_len, vocab):
 
 
 def bench_bert(batch_size=32, seq_len=128, scan_steps=8, calls=4, warmup=1,
-               amp=True, tiny=False, use_flash=True):
+               amp=True, tiny=False, use_flash=True, repeats=1):
     """BERT-base MLM pretraining step (BASELINE.md workload 4: the
     layer_norm/gelu/fused-attention path)."""
     import paddle_tpu as pt
@@ -485,9 +517,9 @@ def bench_bert(batch_size=32, seq_len=128, scan_steps=8, calls=4, warmup=1,
     ckpt = _ckpt_manager("bert", exe, prog, scope)
     dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_loss],
                                             scope, warmup, calls, mon=mon,
-                                            ckpt=ckpt)
-    tps = batch_size * seq_len * scan_steps * calls / dt
-    return tps, flops_tok, first_loss, last_loss
+                                            ckpt=ckpt, repeats=repeats)
+    toks = batch_size * seq_len * scan_steps * calls
+    return [toks / d for d in dt], flops_tok, first_loss, last_loss
 
 
 def bench_deepfm(batch_size=4096, scan_steps=8, calls=4, warmup=1,
@@ -516,10 +548,10 @@ def bench_deepfm(batch_size=4096, scan_steps=8, calls=4, warmup=1,
     mon = _step_monitor("deepfm",
                         examples_per_call=batch_size * scan_steps)
     ckpt = _ckpt_manager("deepfm", exe, prog, scope)
-    dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
-                                            scope, warmup, calls, mon=mon,
-                                            ckpt=ckpt)
-    eps = batch_size * scan_steps * calls / dt
+    dts, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
+                                             scope, warmup, calls, mon=mon,
+                                             ckpt=ckpt)
+    eps = batch_size * scan_steps * calls / dts[0]
     return eps, first_loss, last_loss
 
 
@@ -550,10 +582,10 @@ def bench_mnist(batch_size=512, scan_steps=16, calls=2, warmup=1, amp=True):
     feed = {"pixel": x, "label": y}
     mon = _step_monitor("mnist", examples_per_call=batch_size * scan_steps)
     ckpt = _ckpt_manager("mnist", exe, prog, scope)
-    dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
-                                            scope, warmup, calls, mon=mon,
-                                            ckpt=ckpt)
-    ips = batch_size * scan_steps * calls / dt
+    dts, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
+                                             scope, warmup, calls, mon=mon,
+                                             ckpt=ckpt)
+    ips = batch_size * scan_steps * calls / dts[0]
     return ips, first_loss, last_loss
 
 
@@ -562,18 +594,22 @@ def run_bert(args, peak):
     # regresses under scan memory pressure) — PERF.md r04
     bs = args.batch_size or (4 if args.smoke else 128)
     seq = 64 if args.smoke else 128
-    tps, flops_tok, loss0, loss = bench_bert(
+    repeats = _repeats(args)
+    tps_runs, flops_tok, loss0, loss = bench_bert(
         batch_size=bs, seq_len=seq,
         scan_steps=args.scan_steps or (2 if args.smoke else 16),
         calls=args.calls or (1 if args.smoke else 2),
-        amp=args.amp, tiny=args.smoke)
+        amp=args.amp, tiny=args.smoke, repeats=repeats)
+    tps, spread, runs = _mean_spread(tps_runs)
     mfu = (tps * flops_tok / peak) if peak else None
     # no committed reference BERT number: vs_baseline is the ratio to the
     # BASELINE.json north star (50% MFU on this chip)
     emit_metric("bert_base_train_tokens_per_sec_per_chip", tps, "tokens/sec",
                 mfu / 0.50 if mfu is not None else None, mfu, loss,
                 {"bf16": args.amp, "batch": bs, "seq_len": seq,
-                 "tiny": args.smoke}, loss_first=loss0)
+                 "tiny": args.smoke,
+                 "runs": [round(r, 1) for r in runs],
+                 "spread": round(spread, 1)}, loss_first=loss0)
 
 
 def run_deepfm(args, peak):
@@ -581,7 +617,7 @@ def run_deepfm(args, peak):
     hash_dim = 10001 if args.smoke else 1000001
     # r04 recorded 49.8k (BENCH_r04) vs 39.4k (PERF.md) from single runs —
     # repeat and report mean+-spread so the number is trustworthy
-    repeats = 1 if args.smoke else 3
+    repeats = _repeats(args)
     runs = []
     loss0 = loss = None
     for _ in range(repeats):
@@ -591,8 +627,7 @@ def run_deepfm(args, peak):
             calls=args.calls or (1 if args.smoke else 2),
             hash_dim=hash_dim)
         runs.append(eps_i)
-    eps = float(np.mean(runs))
-    spread = float(np.max(runs) - np.min(runs)) if len(runs) > 1 else 0.0
+    eps, spread, runs = _mean_spread(runs)
     # gather-bound workload: MFU is meaningless; report the analytic HBM
     # traffic of the sparse path (embedding gathers fwd + row-sparse
     # scatter bwd + lazy-adam moment updates on touched rows) vs the v5e
@@ -656,11 +691,13 @@ def run_resnet50(args, peak):
 def run_transformer(args, peak):
         bs = args.batch_size or (2 if args.smoke else 64)
         seq = 64 if args.smoke else 256
-        tps, flops_tok, loss0, loss = bench_transformer(
+        repeats = _repeats(args)
+        tps_runs, flops_tok, loss0, loss = bench_transformer(
             batch_size=bs, seq_len=seq,
             scan_steps=args.scan_steps or (2 if args.smoke else 32),
             calls=args.calls or (1 if args.smoke else 2),
-            amp=args.amp, tiny=args.smoke)
+            amp=args.amp, tiny=args.smoke, repeats=repeats)
+        tps, spread, runs = _mean_spread(tps_runs)
         # flops_tok matches the model actually run (tiny config in smoke)
         mfu = (tps * flops_tok / peak) if peak else None
         # no committed reference transformer number exists: vs_baseline is
@@ -669,7 +706,9 @@ def run_transformer(args, peak):
                     "tokens/sec", mfu / 0.50 if mfu is not None else None,
                     mfu, loss,
                     {"bf16": args.amp, "batch": bs, "seq_len": seq,
-                     "tiny": args.smoke}, loss_first=loss0)
+                     "tiny": args.smoke,
+                     "runs": [round(r, 1) for r in runs],
+                     "spread": round(spread, 1)}, loss_first=loss0)
 
 
 def main():
@@ -683,6 +722,11 @@ def main():
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--scan-steps", type=int, default=None)
     p.add_argument("--calls", type=int, default=None)
+    p.add_argument("--runs", type=int, default=None,
+                   help="repeat the timed region N times and report "
+                        "mean + runs[] + spread (transformer/bert/deepfm; "
+                        "default 3 full, 1 smoke) — PERF.md tunnel-"
+                        "variance protocol")
     p.add_argument("--data-format", default="NHWC",
                    choices=["NHWC", "NCHW"],
                    help="resnet50 conv layout (NHWC is ~18%% faster on "

@@ -144,6 +144,7 @@ def _cast_value(v, dtype):
 # (an fp32 bias would otherwise drag every post-matmul activation back to
 # fp32, forfeiting the bf16 memory/fusion win on matmul-heavy chains).
 GRAY_FOLLOW_OPS = frozenset({
+    "dropout_add",  # dropout + residual add: follow the activation dtype
     "elementwise_add",
     "elementwise_sub",
     "elementwise_mul",

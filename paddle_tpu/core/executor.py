@@ -284,6 +284,7 @@ def _all_finite_flag(outs):
 _RANDOM_OPS = frozenset(
     {
         "dropout",
+        "dropout_add",  # fused epilogue: mask seed derives from the step key
         "uniform_random",
         "gaussian_random",
         "truncated_gaussian_random",

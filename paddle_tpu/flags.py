@@ -129,6 +129,21 @@ FLAGS.define(
     "(kernels/hash_rng.py) instead of jax.random.bernoulli; the hash "
     "fuses into consumers so no random-bits tensor exists in HBM")
 FLAGS.define(
+    "tpu_prng_dropout", bool, True,
+    "in-kernel dropout masks (flash attention weights-dropout, fused "
+    "dropout-add epilogue) draw bits from the TPU hardware PRNG "
+    "(pltpu.prng_seed/prng_random_bits, re-seeded per tile) instead of "
+    "the lowbias32 hash chain; compiled-TPU only — interpret mode and "
+    "the XLA fallbacks always use the hash (kernels/attention.py, "
+    "kernels/dropout_epilogue.py)")
+FLAGS.define(
+    "fused_dropout_add", bool, True,
+    "the bundled transformer/BERT models lower their dropout+residual "
+    "pairs through the fused dropout-add epilogue kernel "
+    "(kernels/dropout_epilogue.py): one Pallas kernel, mask regenerated "
+    "from scalar seeds in the backward, no mask or random-bits tensor in "
+    "HBM; off = the separate graph-level hash dropout + add ops")
+FLAGS.define(
     "vlog", int, 0,
     "verbose logging level, like glog's VLOG(n) (reference init.cc "
     "InitGLOG); see paddle_tpu.log")

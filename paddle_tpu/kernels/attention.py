@@ -81,6 +81,30 @@ def _reference_bthd(q, k, v, bias, scale, causal, dropout_rate=0.0,
     return out.transpose(0, 2, 1, 3)
 
 
+def _keep_tile_prng(seed_ref, shape, pid0, q_blk, k_blk, rate):
+    """Hardware-PRNG keep-mask for one attention-weights tile (TPU Pallas
+    only).  The per-core PRNG is re-seeded per (stream seed, grid row,
+    q-block index, k-block index) — the counter-based-RNG idiom of Salmon
+    et al. "Parallel Random Numbers: As Easy as 1, 2, 3" — so the fwd
+    kernel and both bwd kernels regenerate bit-identical tiles no matter
+    which grid order walks them, and the mask never exists outside
+    registers.  This replaces the lowbias32 hash regeneration whose
+    O(T²·H) integer vector ops, paid in THREE kernels, made in-kernel
+    weights-dropout a net loss at seq 256 (PERF.md r05: −2.5 MFU pts);
+    prng_random_bits is a native per-lane generator with no per-element
+    mix chain.  Requires fwd and bwd to agree on block sizes (they do:
+    _plan picks them once per flash_attention call)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.pallas import tpu as pltpu
+
+    from . import hash_rng
+
+    pltpu.prng_seed(seed_ref[0], pid0, q_blk, k_blk)
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    return bits >= np.uint32(hash_rng.keep_threshold(rate))
+
+
 def _keep_tile(seed, shape, head_base, tq, tk, q_lo, k_lo, rate):
     """In-kernel dropout keep-mask for an attention-weights tile.
 
@@ -151,7 +175,7 @@ def _read_bias(bias_ref, q_lo, block_q, k_lo, block_k, bias_q1):
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
                 scale, block_q, block_k, causal, seq_q, seq_k,
-                causal_offset, bias_q1, drop_rate, inv_keep):
+                causal_offset, bias_q1, drop_rate, inv_keep, hw_prng=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -194,9 +218,13 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         if drop_rate:
             # weights-dropout: l (the softmax normalizer) accumulates the
             # UNdropped p; only the value-accumulator sees the mask
-            keep = _keep_tile(seed_ref[0], (block_q, block_k),
-                              pid0, seq_q, seq_k,
-                              qi * block_q, j * block_k, drop_rate)
+            if hw_prng:
+                keep = _keep_tile_prng(seed_ref, (block_q, block_k),
+                                       pid0, qi, j, drop_rate)
+            else:
+                keep = _keep_tile(seed_ref[0], (block_q, block_k),
+                                  pid0, seq_q, seq_k,
+                                  qi * block_q, j * block_k, drop_rate)
             p = jnp.where(keep, p, 0.0)
         acc_new = acc * alpha[:, None] + p @ v
         return m_new, l_new, acc_new
@@ -218,7 +246,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
 
 def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, *, scale, block_q, block_k, causal,
-                   seq_q, seq_k, causal_offset, bias_q1, drop_rate, inv_keep):
+                   seq_q, seq_k, causal_offset, bias_q1, drop_rate, inv_keep,
+                   hw_prng=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -256,9 +285,13 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
             p = jnp.where(q_pos + causal_offset >= k_pos, p, 0.0)
         dp = do @ v.T  # [block_q, block_k]
         if drop_rate:
-            keep = _keep_tile(seed_ref[0], (block_q, block_k),
-                              pid0, seq_q, seq_k,
-                              qi * block_q, j * block_k, drop_rate)
+            if hw_prng:
+                keep = _keep_tile_prng(seed_ref, (block_q, block_k),
+                                       pid0, qi, j, drop_rate)
+            else:
+                keep = _keep_tile(seed_ref[0], (block_q, block_k),
+                                  pid0, seq_q, seq_k,
+                                  qi * block_q, j * block_k, drop_rate)
             dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = p * (dp - delta[:, None]) * scale
         return acc + ds @ k
@@ -270,7 +303,7 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
 def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, *, scale, block_q, block_k,
                     causal, seq_q, seq_k, causal_offset, bias_q1, drop_rate,
-                    inv_keep):
+                    inv_keep, hw_prng=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -312,9 +345,13 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
             p = jnp.where(q_pos + causal_offset >= k_pos, p, 0.0)
         dp = do @ v.T
         if drop_rate:
-            keep = _keep_tile(seed_ref[0], (block_q, block_k),
-                              pid0, seq_q, seq_k,
-                              i * block_q, ki * block_k, drop_rate)
+            if hw_prng:
+                keep = _keep_tile_prng(seed_ref, (block_q, block_k),
+                                       pid0, i, ki, drop_rate)
+            else:
+                keep = _keep_tile(seed_ref[0], (block_q, block_k),
+                                  pid0, seq_q, seq_k,
+                                  i * block_q, ki * block_k, drop_rate)
             dv = dv + jnp.where(keep, p * inv_keep, 0.0).T @ do
             dp = jnp.where(keep, dp * inv_keep, 0.0)
         else:
@@ -498,7 +535,7 @@ def _bias_tile_f32(bias_ref, n_head, bias_h, bias_q1, block_q, q_lo,
 def _fwd_kernel_bthd(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
                      lse_ref, *, scale, n_head, block_q, block_k, causal,
                      seq_q, seq_k, causal_offset, bias_q1, bias_h,
-                     drop_rate, inv_keep):
+                     drop_rate, inv_keep, hw_prng=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -542,9 +579,13 @@ def _fwd_kernel_bthd(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
         l_new = l * alpha + p.sum(axis=2)
         if drop_rate:
             # weights-dropout: the normalizer l sees UNdropped p
-            keep = _keep_tile(seed_ref[0], (h, block_q, block_k),
-                              pid0h, seq_q, seq_k,
-                              qi * block_q, j * block_k, drop_rate)
+            if hw_prng:
+                keep = _keep_tile_prng(seed_ref, (h, block_q, block_k),
+                                       pid0h, qi, j, drop_rate)
+            else:
+                keep = _keep_tile(seed_ref[0], (h, block_q, block_k),
+                                  pid0h, seq_q, seq_k,
+                                  qi * block_q, j * block_k, drop_rate)
             p = jnp.where(keep, p, 0.0)
         acc_new = acc * alpha[:, :, None] + _bdot(p, v, (2,), (1,))
         return m_new, l_new, acc_new
@@ -562,7 +603,8 @@ def _fwd_kernel_bthd(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
 def _bwd_dq_kernel_bthd(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
                         lse_ref, delta_ref, dq_ref, *, scale, n_head,
                         block_q, block_k, causal, seq_q, seq_k,
-                        causal_offset, bias_q1, bias_h, drop_rate, inv_keep):
+                        causal_offset, bias_q1, bias_h, drop_rate, inv_keep,
+                        hw_prng=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -603,9 +645,13 @@ def _bwd_dq_kernel_bthd(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
             p = jnp.where(q_pos + causal_offset >= k_pos, p, 0.0)
         dp = _bdot(do, v, (2,), (2,))  # [h, q, k]
         if drop_rate:
-            keep = _keep_tile(seed_ref[0], (h, block_q, block_k),
-                              pid0h, seq_q, seq_k,
-                              qi * block_q, j * block_k, drop_rate)
+            if hw_prng:
+                keep = _keep_tile_prng(seed_ref, (h, block_q, block_k),
+                                       pid0h, qi, j, drop_rate)
+            else:
+                keep = _keep_tile(seed_ref[0], (h, block_q, block_k),
+                                  pid0h, seq_q, seq_k,
+                                  qi * block_q, j * block_k, drop_rate)
             dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = p * (dp - delta[:, :, None]) * scale
         return acc + _bdot(ds, k, (2,), (1,))
@@ -618,7 +664,7 @@ def _bwd_dkv_kernel_bthd(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
                          lse_ref, delta_ref, dk_ref, dv_ref, *, scale,
                          n_head, block_q, block_k, causal, seq_q, seq_k,
                          causal_offset, bias_q1, bias_h, drop_rate,
-                         inv_keep):
+                         inv_keep, hw_prng=False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -662,9 +708,13 @@ def _bwd_dkv_kernel_bthd(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
             p = jnp.where(q_pos + causal_offset >= k_pos, p, 0.0)
         dp = _bdot(do, v, (2,), (2,))        # [h, q, k]
         if drop_rate:
-            keep = _keep_tile(seed_ref[0], (h, block_q, block_k),
-                              pid0h, seq_q, seq_k,
-                              i * block_q, ki * block_k, drop_rate)
+            if hw_prng:
+                keep = _keep_tile_prng(seed_ref, (h, block_q, block_k),
+                                       pid0h, i, ki, drop_rate)
+            else:
+                keep = _keep_tile(seed_ref[0], (h, block_q, block_k),
+                                  pid0h, seq_q, seq_k,
+                                  i * block_q, ki * block_k, drop_rate)
             dv = dv + _bdot(jnp.where(keep, p * inv_keep, 0.0), do,
                             (1,), (1,))      # [h, k, d]
             dp = jnp.where(keep, dp * inv_keep, 0.0)
@@ -716,6 +766,22 @@ def _drop_params(dropout_rate):
     return float(dropout_rate), 1.0 / (1.0 - dropout_rate)
 
 
+def _use_hw_prng(drop_rate, interpret):
+    """Whether the kernels should draw dropout bits from the TPU hardware
+    PRNG (pltpu.prng_seed / prng_random_bits) instead of the lowbias32
+    hash.  Compiled-TPU only: jax 0.4.37 has no interpret/CPU lowering for
+    prng_seed, so interpret mode and the XLA fallback keep the hash —
+    each implementation still regenerates ITS mask identically in fwd and
+    bwd (the parity contract is per-implementation, not cross-backend)."""
+    if not drop_rate or interpret:
+        return False
+    import jax
+
+    from ..flags import FLAGS
+
+    return jax.default_backend() == "tpu" and FLAGS.tpu_prng_dropout
+
+
 def _seed_spec():
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -724,7 +790,8 @@ def _seed_spec():
 
 
 def _flash_forward(q, k, v, bias, seed, scale, causal, block_q, block_k,
-                   interpret, fmt="bhtd", dropout_rate=0.0):
+                   interpret, fmt="bhtd", dropout_rate=0.0,
+                   allow_hw_prng=True):
     """Returns (out, lse) via the Pallas kernel.  Caller has checked
     feasibility with _plan.  `out` is in the input format; lse is
     [b, h, tq] f32.  `seed`: (1,) uint32 — the dropout stream seed
@@ -737,6 +804,7 @@ def _flash_forward(q, k, v, bias, seed, scale, causal, block_q, block_k,
     tk = _dims(k, fmt)[2]
     bh = b * h
     drop_rate, inv_keep = _drop_params(dropout_rate)
+    hw_prng = allow_hw_prng and _use_hw_prng(drop_rate, interpret)
     q_spec, kv_spec = _qkv_specs(fmt, h, "block", "full", block_q, block_k,
                                  tq, tk, d)
     if fmt == "bthd":
@@ -752,7 +820,7 @@ def _flash_forward(q, k, v, bias, seed, scale, causal, block_q, block_k,
             _fwd_kernel_bthd, scale=scale, n_head=h, block_q=block_q,
             block_k=block_k, causal=causal, seq_q=tq, seq_k=tk,
             causal_offset=tk - tq, bias_q1=bias_q1, bias_h=bias_h,
-            drop_rate=drop_rate, inv_keep=inv_keep,
+            drop_rate=drop_rate, inv_keep=inv_keep, hw_prng=hw_prng,
         )
         if bias is None:
             def kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref):
@@ -791,6 +859,7 @@ def _flash_forward(q, k, v, bias, seed, scale, causal, block_q, block_k,
         _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
         causal=causal, seq_q=tq, seq_k=tk, causal_offset=tk - tq,
         bias_q1=bias_q1, drop_rate=drop_rate, inv_keep=inv_keep,
+        hw_prng=hw_prng,
     )
     if bias is None:
         def kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref):
@@ -817,7 +886,8 @@ def _flash_forward(q, k, v, bias, seed, scale, causal, block_q, block_k,
 
 
 def _flash_backward(q, k, v, bias, seed, o, lse, g, scale, causal, block_q,
-                    block_k, interpret, fmt="bhtd", dropout_rate=0.0):
+                    block_k, interpret, fmt="bhtd", dropout_rate=0.0,
+                    allow_hw_prng=True):
     """Returns (dq, dk, dv) via the two backward kernels, in the input
     format.  `lse` is [b, h, tq] f32; q/k/v/o/g are in `fmt`."""
     import jax
@@ -829,6 +899,7 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale, causal, block_q,
     bh = b * h
     causal_offset = tk - tq
     drop_rate, inv_keep = _drop_params(dropout_rate)
+    hw_prng = allow_hw_prng and _use_hw_prng(drop_rate, interpret)
 
     if fmt == "bthd":
         # delta[i] = rowsum(dO * O) -> [b, tq, h] -> [b, h, tq] (tiny f32)
@@ -853,7 +924,7 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale, causal, block_q,
             _bwd_dq_kernel_bthd, scale=scale, n_head=h, block_q=block_q,
             block_k=block_k, causal=causal, seq_q=tq, seq_k=tk,
             causal_offset=causal_offset, bias_q1=bias_q1, bias_h=bias_h,
-            drop_rate=drop_rate, inv_keep=inv_keep,
+            drop_rate=drop_rate, inv_keep=inv_keep, hw_prng=hw_prng,
         )
         if bias is None:
             def dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -886,7 +957,7 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale, causal, block_q,
             _bwd_dkv_kernel_bthd, scale=scale, n_head=h, block_q=block_q,
             block_k=block_k, causal=causal, seq_q=tq, seq_k=tk,
             causal_offset=causal_offset, bias_q1=bias_q1, bias_h=bias_h,
-            drop_rate=drop_rate, inv_keep=inv_keep,
+            drop_rate=drop_rate, inv_keep=inv_keep, hw_prng=hw_prng,
         )
         if bias is None:
             def dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -943,6 +1014,7 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale, causal, block_q,
         _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
         causal=causal, seq_q=tq, seq_k=tk, causal_offset=causal_offset,
         bias_q1=bias_q1, drop_rate=drop_rate, inv_keep=inv_keep,
+        hw_prng=hw_prng,
     )
     if bias is None:
         def dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -979,6 +1051,7 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale, causal, block_q,
         _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
         causal=causal, seq_q=tq, seq_k=tk, causal_offset=causal_offset,
         bias_q1=bias_q1, drop_rate=drop_rate, inv_keep=inv_keep,
+        hw_prng=hw_prng,
     )
     if bias is None:
         def dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -1043,7 +1116,8 @@ def _dbias_xla(q, k, bias, lse, g, v, o, scale, causal, dropout_rate=0.0,
 
 def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
                     block_q=512, block_k=512, interpret=None, fmt="bhtd",
-                    dropout_rate=0.0, dropout_seed=None):
+                    dropout_rate=0.0, dropout_seed=None,
+                    trainable_bias=True):
     """q,k,v: [B, H, T, D] (fmt="bhtd", default) or [B, T, H, D]
     (fmt="bthd"); bias: broadcastable [B, H, Tq, Tk] or None.  Returns the
     context in the same format as q.
@@ -1065,7 +1139,20 @@ def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
 
     Fully differentiable with Pallas kernels on BOTH passes: forward saves
     only (out, logsumexp); backward recomputes probability blocks in-kernel
-    (FlashAttention-2), so neither pass materializes the [Tq, Tk] matrix."""
+    (FlashAttention-2), so neither pass materializes the [Tq, Tk] matrix.
+
+    trainable_bias (default True — the SAFE setting): the bias cotangent
+    is computed by an XLA recompute (_dbias_xla) that regenerates the
+    dropout mask with the HASH generator, so with dropout + a bias whose
+    gradient is consumed the kernels must use the hash mask too, or
+    dbias would be masked differently than the forward actually was.
+    With trainable_bias=True and dropout on, the TPU hardware-PRNG fast
+    path is therefore disabled for this call.  Pass
+    trainable_bias=False ONLY when the bias is a stop-gradient mask
+    (padding/causal biases — then XLA dead-code-eliminates the dbias
+    expression and its mask mismatch is unobservable); the
+    fused_attention op lowering derives this automatically from the
+    bias var's stop_gradient flag."""
     import numpy as np
 
     import jax
@@ -1143,22 +1230,30 @@ def flash_attention(q, k, v, bias=None, scale=1.0, causal=False,
         # the (cheap, [.., .., 1]-thin) broadcast up front
         bias = jnp.broadcast_to(bias, (bb, hb, tqb, _tk))
 
+    # dropout + consumed bias gradient: the dbias recompute hashes its
+    # mask, so the kernels must hash too (see trainable_bias docstring).
+    # Only this bias-carrying branch is gated — the bias=None branch above
+    # returned already, with the hardware-PRNG path fully enabled.
+    allow_hw = not (dropout_rate and trainable_bias)
+
     @jax.custom_vjp
     def _attn(q, k, v, bias, seed):
         out, _ = _flash_forward(q, k, v, bias, seed, scale, causal, bq, bk,
-                                interp, fmt, dropout_rate)
+                                interp, fmt, dropout_rate,
+                                allow_hw_prng=allow_hw)
         return out
 
     def _fwd(q, k, v, bias, seed):
         out, lse = _flash_forward(q, k, v, bias, seed, scale, causal, bq,
-                                  bk, interp, fmt, dropout_rate)
+                                  bk, interp, fmt, dropout_rate,
+                                  allow_hw_prng=allow_hw)
         return out, (q, k, v, bias, seed, out, lse)
 
     def _bwd(res, g):
         q, k, v, bias, seed, out, lse = res
         dq, dk, dv = _flash_backward(q, k, v, bias, seed, out, lse, g,
                                      scale, causal, bq, bk, interp, fmt,
-                                     dropout_rate)
+                                     dropout_rate, allow_hw_prng=allow_hw)
         if fmt == "bthd":
             # _dbias_xla is written for bhtd; the transpose is an XLA view
             # feeding an einsum (fused), and trainable biases are rare —

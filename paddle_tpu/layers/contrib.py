@@ -16,12 +16,15 @@ def fused_attention(q, k, v, bias=None, scale=1.0, causal=False,
     With dropout_rate > 0 and weights_dropout=True (default), dropout
     applies to the attention WEIGHTS inside the kernels (the reference's
     dropout-on-softmax semantics, transformer_model.py:44) via a
-    deterministic per-step hash mask that never exists in HBM — see
-    kernels/hash_rng.py.  The in-kernel mask costs O(T²·H) hash work
-    regenerated in all three kernels, so it wins at short sequences
-    (BERT-128: +1 MFU pt) and loses at long ones (seq 256: −2.5 pts);
-    weights_dropout=False instead applies hash dropout to the attention
-    OUTPUT (O(T·D) work, flash-style semantics)."""
+    deterministic per-step mask that never exists in HBM: on compiled
+    TPU the bits come from the hardware PRNG re-seeded per tile
+    (kernels/attention.py _keep_tile_prng, FLAGS_tpu_prng_dropout —
+    this removed the O(T²·H) hash-regeneration cost that used to make
+    long sequences a net loss, so weights-dropout is now the default at
+    every length); interpret/XLA fallbacks use the counter-based hash
+    (kernels/hash_rng.py).  weights_dropout=False instead applies hash
+    dropout to the attention OUTPUT (O(T·D) work, flash-style
+    semantics)."""
     from ..core import framework as fw
 
     helper = LayerHelper("fused_attention", name=name)

@@ -389,6 +389,31 @@ def dropout(
     return out
 
 
+def dropout_add(x, residual, dropout_prob, is_test=False, name=None):
+    """Fused `dropout(x) + residual` (upscale_in_train semantics) — the
+    dropout-add epilogue of every transformer/BERT residual connection,
+    lowered as ONE op so the Pallas kernel (kernels/dropout_epilogue.py)
+    can regenerate the keep-mask from scalar seeds in fwd AND bwd: no
+    mask tensor in HBM, no fwd->bwd residual beyond the seed.  With
+    dropout_prob == 0 or in test mode it lowers to a plain add."""
+    helper = LayerHelper("dropout_add", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "dropout_add",
+        inputs={"X": [x], "Residual": [residual]},
+        outputs={"Out": [out]},
+        attrs={
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            # static per-op stream id (same scheme as dropout): forward
+            # and backward re-derive the same seed from fold_in(step_key,
+            # rng_id), so the mask is regenerated, never stored
+            "rng_id": fw.unique_rng_id(),
+        },
+    )
+    return out
+
+
 def softmax(input, use_cudnn=False, name=None, axis=-1):
     helper = LayerHelper("softmax", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)

@@ -12,6 +12,21 @@ from ..initializer import NormalInitializer, ConstantInitializer
 from ..param_attr import ParamAttr
 
 
+def _dropout_residual(sub, x, dropout_rate):
+    """dropout(sub) + x: ONE fused dropout-add op (the epilogue kernel of
+    kernels/dropout_epilogue.py — mask regenerated in-kernel, fwd and bwd)
+    under FLAGS.fused_dropout_add; the reference's separate dropout +
+    elementwise_add ops otherwise.  rate 0 is a plain add either way."""
+    from ..flags import FLAGS
+
+    if dropout_rate and FLAGS.fused_dropout_add:
+        return layers.dropout_add(sub, x, dropout_rate)
+    if dropout_rate:
+        sub = layers.dropout(sub, dropout_prob=dropout_rate,
+                             dropout_implementation="upscale_in_train")
+    return layers.elementwise_add(x, sub)
+
+
 def bert_encoder_layer(x, attn_bias, n_head, d_model, d_ff, dropout_rate,
                        use_flash=False, name="layer"):
     from .transformer import multi_head_attention
@@ -20,17 +35,11 @@ def bert_encoder_layer(x, attn_bias, n_head, d_model, d_ff, dropout_rate,
         x, None, None, attn_bias, d_model // n_head, d_model // n_head,
         d_model, n_head, dropout_rate, use_flash=use_flash,
     )
-    if dropout_rate:
-        attn = layers.dropout(attn, dropout_prob=dropout_rate,
-                              dropout_implementation="upscale_in_train")
-    x = layers.layer_norm(layers.elementwise_add(x, attn),
+    x = layers.layer_norm(_dropout_residual(attn, x, dropout_rate),
                           begin_norm_axis=len(x.shape) - 1)
     ff = layers.fc(input=x, size=d_ff, act="gelu", num_flatten_dims=2)
     ff = layers.fc(input=ff, size=d_model, num_flatten_dims=2)
-    if dropout_rate:
-        ff = layers.dropout(ff, dropout_prob=dropout_rate,
-                            dropout_implementation="upscale_in_train")
-    return layers.layer_norm(layers.elementwise_add(x, ff),
+    return layers.layer_norm(_dropout_residual(ff, x, dropout_rate),
                              begin_norm_axis=len(x.shape) - 1)
 
 
@@ -78,6 +87,10 @@ def bert_encoder(
 
     b, t, _ = src_ids.shape if src_ids.shape else (None, None, None)
     bias4 = layers.reshape(neg, [-1, 1, 1, neg.shape[-1]])
+    # padding mask, not a parameter: marks the fused-attention bias as
+    # stop-gradient so the TPU hardware-PRNG dropout fast path stays on
+    # (a trainable bias forces hash masks — see ops/fused_ops.py)
+    bias4.stop_gradient = True
 
     for i in range(n_layer):
         x = bert_encoder_layer(x, bias4, n_head, d_model, d_ff, dropout_rate,

@@ -81,14 +81,15 @@ def multi_head_attention(
             b, t, _ = x.shape
             return layers.reshape(x, [b, t, n_head, d])
 
-        # weights_dropout (in-kernel, reference semantics) costs O(T²·H)
-        # hash work across three kernels: measured win at T<=128
-        # (BERT +1 MFU pt), measured loss at T=256 (−2.5 pts) — pick by
-        # sequence length; the long-seq path uses output-site hash dropout
+        # weights_dropout (in-kernel, reference semantics) is on at every
+        # sequence length: the kernels draw mask bits from the TPU
+        # hardware PRNG (kernels/attention.py _keep_tile_prng), which
+        # removed the O(T²·H) hash-regeneration cost that made seq-256 a
+        # −2.5 MFU-pt loss in r05 and forced a per-length selection hack
         ctx = fused_attention(
             to_bthd(q, d_key), to_bthd(k, d_key), to_bthd(v, d_value),
             attn_bias, scale=d_key**-0.5, dropout_rate=dropout_rate,
-            fmt="bthd", weights_dropout=queries.shape[1] <= 128,
+            fmt="bthd",
         )
         b, t, h, d = ctx.shape
         ctx = layers.reshape(ctx, [b, t, h * d])
@@ -139,7 +140,21 @@ def positionwise_feed_forward(x, d_inner_hid, d_hid):
 
 def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):
     """reference transformer_model.py pre_post_process_layer: a=add, n=norm,
-    d=dropout."""
+    d=dropout.
+
+    A leading "da" (dropout then residual-add — the post-process pattern
+    of every encoder/decoder sub-layer) lowers as ONE fused dropout-add
+    op (layers.dropout_add -> kernels/dropout_epilogue.py) under
+    FLAGS.fused_dropout_add: the keep-mask is generated in-kernel and
+    regenerated in the backward, so it never exists in HBM.  With the
+    flag off, or without a residual, the reference's separate
+    dropout + elementwise_add ops are emitted unchanged."""
+    from ..flags import FLAGS
+
+    if (dropout_rate and prev_out is not None
+            and process_cmd.startswith("da") and FLAGS.fused_dropout_add):
+        out = layers.dropout_add(out, prev_out, dropout_rate)
+        process_cmd = process_cmd[2:]
     for cmd in process_cmd:
         if cmd == "a":
             out = layers.elementwise_add(out, prev_out) if prev_out is not None else out

@@ -29,6 +29,15 @@ def _jnp():
     return jnp
 
 
+def _i64():
+    """int64 clamped through jax's canonical dtype — int32 explicitly when
+    x64 is off, instead of truncate-and-warn per trace (the one shared
+    clamp: ops/tensor_ops.py _canon_i64)."""
+    from .tensor_ops import _canon_i64
+
+    return _canon_i64()
+
+
 def _expand_aspect_ratios(ratios, flip):
     out = [1.0]
     for ar in ratios:
@@ -207,19 +216,19 @@ def lower_bipartite_match(ctx, ins):
             best = s[r, c]
             do = best > -1e9
             col_row = jnp.where(
-                do & (jnp.arange(n) == c), r.astype(jnp.int64), col_row)
+                do & (jnp.arange(n) == c), r.astype(_i64()), col_row)
             col_dist = jnp.where(do & (jnp.arange(n) == c), best, col_dist)
             s = jnp.where(do & (jnp.arange(m)[:, None] == r), -1e10, s)
             s = jnp.where(do & (jnp.arange(n)[None, :] == c), -1e10, s)
             return s, col_row, col_dist
 
-        col_row = jnp.full((n,), -1, jnp.int64)
+        col_row = jnp.full((n,), -1, _i64())
         col_dist = jnp.zeros((n,), jnp.float32)
         _, col_row, col_dist = jax.lax.fori_loop(
             0, min(m, n), body, (s0, col_row, col_dist))
 
         if match_type == "per_prediction":
-            best_row = jnp.argmax(s0, axis=0).astype(jnp.int64)
+            best_row = jnp.argmax(s0, axis=0).astype(_i64())
             best_val = jnp.max(s0, axis=0)
             extra = (col_row < 0) & (best_val > thresh)
             col_row = jnp.where(extra, best_row, col_row)
@@ -294,7 +303,7 @@ def lower_multiclass_nms(ctx, ins):
             vals[:, None],
             sel_boxes,
         ], axis=1)
-        return out, valid.sum().astype(jnp.int64)
+        return out, valid.sum().astype(_i64())
 
     outs, counts = jax.vmap(one_image)(bboxes, scores)
     return {"Out": [outs], "NmsRoisNum": [counts]}

@@ -33,6 +33,20 @@ def lower_fused_attention(ctx, ins):
         if base is None:
             base = ctx.executor_ctx._base_key  # eager session
         seed = hash_rng.seed_from_key(base, ctx.attr("rng_id", 1))
+    # stop-gradient biases (padding/causal masks — the usual case) allow
+    # the TPU hardware-PRNG dropout fast path: their dbias recompute is
+    # dead-code-eliminated, so its hash-mask mismatch is unobservable.
+    # A genuinely trainable bias forces the hash mask everywhere so the
+    # bias cotangent sees the same mask the kernels applied.
+    trainable_bias = False
+    if bias is not None:
+        try:
+            bname = ctx.op.inputs.get("Bias", [None])[0]
+            bvar = (ctx.block._find_var_recursive(bname)
+                    if bname else None)
+            trainable_bias = bvar is None or not bvar.stop_gradient
+        except Exception:
+            trainable_bias = True  # unknown provenance: stay correct
     out = flash_attention(
         q, k, v, bias,
         scale=ctx.attr("scale", 1.0),
@@ -42,6 +56,7 @@ def lower_fused_attention(ctx, ins):
         fmt=ctx.attr("fmt", "bhtd"),
         dropout_rate=rate,
         dropout_seed=seed,
+        trainable_bias=trainable_bias,
     )
     return {"Out": [out]}
 

@@ -355,11 +355,16 @@ def lower_data_norm(ctx, ins):
 
 @register("fill", no_grad=True, infer_shape=_fill_infer)
 def lower_fill(ctx, ins):
+    from .tensor_ops import _requested_dtype
+
     jnp = _jnp()
     shape = ctx.attr("shape")
     value = np.asarray(ctx.attr("value"), dtype="float32")
-    dtype = ctx.attr("dtype", "float32")
-    return {"Out": [jnp.asarray(value.reshape(shape)).astype(dtype)]}
+    # clamp through jax's canonical dtype (as fill_constant/cast do): an
+    # int64 request with x64 off becomes int32 EXPLICITLY instead of
+    # truncate-and-warn on every trace
+    target = _requested_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": [jnp.asarray(value.reshape(shape)).astype(target)]}
 
 
 @register("fill_constant_batch_size_like", no_grad=True,
@@ -367,15 +372,19 @@ def lower_fill(ctx, ins):
 def lower_fill_constant_batch_size_like(ctx, ins):
     """reference fill_constant_batch_size_like_op.cc: like fill_constant but
     one dim copies the batch size of Input."""
+    from .tensor_ops import _requested_dtype
+
     jnp = _jnp()
     x = ins["Input"][0]
     shape = list(ctx.attr("shape"))
     in_idx = ctx.attr("input_dim_idx", 0)
     out_idx = ctx.attr("output_dim_idx", 0)
     shape[out_idx] = x.shape[in_idx]
-    dtype = ctx.attr("dtype", "float32")
+    # clamped dtype: no int64-truncation UserWarning per trace (PR 1 did
+    # the same for fill_constant/cast/index outputs, tensor_ops.py)
+    target = _requested_dtype(ctx.attr("dtype", "float32"))
     val = ctx.attr("value", 0.0)
-    return {"Out": [jnp.full(tuple(shape), val, dtype)]}
+    return {"Out": [jnp.full(tuple(shape), val, dtype=target)]}
 
 
 @register("crop", infer_shape=_crop_infer)

@@ -696,6 +696,49 @@ def _dropout_keep_mask(ctx, jax, shape, p):
     return jax.random.bernoulli(key, 1.0 - p, shape)
 
 
+@register("dropout_add", infer_shape=_out_infer)
+def lower_dropout_add(ctx, ins):
+    """Fused dropout(X) + Residual epilogue (kernels/dropout_epilogue.py):
+    one Pallas kernel whose keep-mask is regenerated in-kernel from scalar
+    seeds (TPU hardware PRNG; lowbias32 hash in interpret/XLA fallbacks) —
+    no mask, random-bits tensor, or fwd->bwd residual beyond the seed ever
+    exists in HBM.  upscale_in_train semantics (the only mode the bundled
+    models use); is_test or rate 0 lowers to a plain add, so dropout-off
+    programs are bit-identical to an elementwise_add.
+
+    The backward rides the kernel's custom VJP through the generic
+    vjp-of-forward grad path: the re-trace derives the SAME seed from the
+    static rng_id attr, so the regenerated mask is bit-exact."""
+    x = ins["X"][0]
+    res = ins["Residual"][0]
+    p = ctx.attr("dropout_prob", 0.5)
+    is_test = ctx.attr("is_test", False) or ctx.is_test
+    if is_test or not p:
+        return {"Out": [x + res.astype(x.dtype)]}
+    import jax
+
+    from ..flags import FLAGS
+    from ..kernels import dropout_epilogue, hash_rng
+
+    jnp = _jnp()
+    rng_id = ctx.attr("rng_id", 0)
+    base = getattr(ctx.executor_ctx, "base_key", None)
+    if base is None:
+        base = ctx.executor_ctx._base_key  # eager session
+    if not FLAGS.hash_dropout:
+        # honor the framework-wide generator switch (same contract as
+        # _dropout_keep_mask): with hash_dropout off the mask comes from
+        # jax.random.bernoulli — deterministic per (step key, rng_id), so
+        # the generic-vjp re-trace still regenerates it in the backward
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(base, rng_id or 1), 1.0 - p, x.shape)
+        scaled = jnp.where(keep, x * jnp.asarray(1.0 / (1.0 - p), x.dtype),
+                           jnp.zeros((), x.dtype))
+        return {"Out": [scaled + res.astype(x.dtype)]}
+    seed = hash_rng.seed_from_key(base, rng_id or 1)
+    return {"Out": [dropout_epilogue.dropout_add(x, res, p, seed)]}
+
+
 @register("dropout_grad", no_grad=True)
 def lower_dropout_grad(ctx, ins):
     import jax

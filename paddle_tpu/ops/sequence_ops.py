@@ -201,6 +201,8 @@ def lower_edit_distance(ctx, ins):
     import jax
     import jax.numpy as jnp
 
+    from .tensor_ops import _canon_i64
+
     hyp = ins["Hyps"][0].astype("int32")
     ref = ins["Refs"][0].astype("int32")
     if hyp.ndim == 3:
@@ -244,7 +246,9 @@ def lower_edit_distance(ctx, ins):
         dist = dist / jnp.maximum(ref_len.astype(dist.dtype), 1.0)
     return {
         "Out": [dist.reshape(-1, 1)],
-        "SequenceNum": [jnp.asarray([b], jnp.int64)],
+        # canonical int (int32 when x64 is off): an explicit jnp.int64
+        # would truncate-and-warn on every trace
+        "SequenceNum": [jnp.asarray([b], _canon_i64())],
     }
 
 

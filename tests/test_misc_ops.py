@@ -271,6 +271,32 @@ class TestFillOps(OpTest):
                    "input_dim_idx": 0, "output_dim_idx": 0},
         )
 
+    def test_int64_requests_do_not_warn(self):
+        """int64 fill requests with x64 off must clamp through jax's
+        canonical dtype (-> int32) EXPLICITLY — not truncate-and-warn on
+        every trace (the bench-visible UserWarning; ISSUE 4 satellite)."""
+        import warnings
+
+        from paddle_tpu import layers
+
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            x = layers.data(name="x", shape=[3], dtype="float32")
+            f = layers.tensor.fill_constant_batch_size_like(
+                x, [-1, 4], "int64", 7)
+            out = layers.reduce_sum(layers.cast(f, "float32"))
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            (val,) = exe.run(prog, feed={"x": np.zeros((2, 3), "float32")},
+                             fetch_list=[out], scope=scope)
+            trunc = [str(m.message) for m in w
+                     if "truncated" in str(m.message)]
+        assert not trunc, trunc
+        assert float(np.asarray(val)) == 2 * 4 * 7
+
 
 class TestCrop(OpTest):
     op_type = "crop"

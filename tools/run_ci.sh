@@ -29,9 +29,19 @@ if [[ "${1:-}" != "fast" ]]; then
   mkdir -p ci_artifacts
   rm -f ci_artifacts/bench_steps.jsonl  # StepMonitor appends; keep one run
   rm -rf ci_artifacts/flight && mkdir -p ci_artifacts/flight
+  # Warnings gate: any Python UserWarning raised during the smoke (e.g.
+  # jnp's int64-truncation warning that once fired per trace) FAILS the
+  # step.  Allowlist a known-benign warning by appending another filter
+  # AFTER the error one (later -W filters take precedence):
+  #   -W "ignore:exact message prefix:UserWarning"
+  # The JSON metric lines land in ci_artifacts/bench_smoke.json — the
+  # per-workload record (runs[]/spread fields) used for A/B comparisons.
   FLAGS_monitor=1 FLAGS_monitor_jsonl=ci_artifacts/bench_steps.jsonl \
     FLAGS_flight_dir=ci_artifacts/flight \
-    python bench.py --smoke --monitor-snapshot ci_artifacts/metrics.prom
+    python -W error::UserWarning bench.py --smoke \
+      --monitor-snapshot ci_artifacts/metrics.prom \
+    | tee ci_artifacts/bench_smoke.json
+  echo "-- A/B bench record artifact: ci_artifacts/bench_smoke.json ($(grep -c '' ci_artifacts/bench_smoke.json) records, streamed above)"
   echo "-- metrics snapshot:"
   head -40 ci_artifacts/metrics.prom || true
   echo "-- flight record (black box of the smoke run):"
