@@ -474,6 +474,138 @@ def run_ringattn(args, peak):
                  "reference_ms": round(t_ref * 1e3, 2)})
 
 
+# The five distinct ResNet-50 bottleneck conv+BN shapes (stage 1-4 members;
+# one 3x3 so both fused routes — dot+stats epilogue and conv+stats-kernel —
+# are measured).  (label, batch, hw, c_in, c_out, ksize, stride, residual);
+# residual=True also folds the block's add+relu epilogue, the conv3 site.
+CONVBN_SHAPES = [
+    ("s1_1x1_256_64_hw56", 16, 56, 256, 64, 1, 1, False),
+    ("s1_1x1_64_256_hw56", 16, 56, 64, 256, 1, 1, True),
+    ("s2_3x3_128_128_hw28", 16, 28, 128, 128, 3, 1, False),
+    ("s3_1x1_1024_256_hw14", 16, 14, 1024, 256, 1, 1, False),
+    ("s4_1x1_512_2048_hw7", 16, 7, 512, 2048, 1, 1, True),
+]
+CONVBN_SHAPES_SMOKE = [
+    ("smoke_1x1_128_128_hw8", 2, 8, 128, 128, 1, 1, True),
+    ("smoke_3x3_64_64_hw8", 2, 8, 64, 64, 3, 1, False),
+]
+
+
+def bench_convbn_shape(n, hw, cin, cout, ksize, stride, residual,
+                       iters=20, repeats=3, warmup=1):
+    """One conv+BN(+residual+relu) fwd+bwd A/B at a fixed shape: the XLA
+    reference composition vs the fused kernels (kernels/conv_bn.py).
+
+    In-loop protocol (PERF.md tunnel rules: per-CALL RPC latency makes
+    micro-benchmarks useless below ~1 s of device work): `iters` chained
+    fwd+bwd steps run INSIDE one jit via lax.scan — each step feeds its
+    gradients back into the carried operands, so nothing is DCE'd and one
+    host sync covers the whole loop.  Returns (fused_ms, ref_ms) lists of
+    per-repeat ms/iter."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels import conv_bn as CB
+
+    rng = np.random.RandomState(0)
+    pad = ksize // 2
+    ohw = (hw + 2 * pad - ksize) // stride + 1
+    dt = jnp.bfloat16
+    x = jnp.asarray(rng.randn(n, hw, hw, cin).astype("float32")).astype(dt)
+    w = jnp.asarray(
+        (rng.randn(cout, cin, ksize, ksize)
+         * np.sqrt(2.0 / (cin * ksize * ksize))).astype("float32")).astype(dt)
+    gamma = jnp.asarray(rng.rand(cout).astype("float32") + 0.5)
+    beta = jnp.asarray(rng.randn(cout).astype("float32"))
+    res = (jnp.asarray(rng.randn(n, ohw, ohw, cout).astype("float32"))
+           .astype(dt) if residual else None)
+    eps = 1e-5
+
+    def fused_loss(x, w, gamma, beta):
+        y, s1, s2 = CB.conv_bn_stats(x, w, (stride, stride), (pad, pad))
+        m = y.size // y.shape[-1]
+        mean = s1 / m
+        var = s2 / m - jnp.square(mean)
+        out = CB.bn_apply(y, gamma, beta, mean, var, residual=res,
+                          eps=eps, act="relu")
+        return jnp.sum(out.astype(jnp.float32)) * 1e-6
+
+    def ref_loss(x, w, gamma, beta):
+        y = jax.lax.conv_general_dilated(
+            x, w, (stride, stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=("NHWC", "OIHW", "NHWC"))
+        ys = y.astype(jnp.float32)
+        mean = ys.mean((0, 1, 2))
+        var = (ys * ys).mean((0, 1, 2)) - jnp.square(mean)
+        wv = gamma * jax.lax.rsqrt(var + eps)
+        bv = beta - mean * wv
+        out = y * wv.astype(y.dtype) + bv.astype(y.dtype)
+        if res is not None:
+            out = out + res
+        return jnp.sum(jax.nn.relu(out).astype(jnp.float32)) * 1e-6
+
+    def make_timed(loss):
+        g = jax.grad(loss, (0, 1, 2, 3))
+
+        @jax.jit
+        def run(x, w, gamma, beta):
+            def body(carry, _):
+                x, w, gamma, beta = carry
+                dx, dw, dg, db = g(x, w, gamma, beta)
+                # feed the grads back so the chain is sequential on device
+                return (x + dx * jnp.asarray(1e-3, x.dtype),
+                        w + dw * jnp.asarray(1e-3, w.dtype),
+                        gamma + dg * 1e-3, beta + db * 1e-3), None
+            (x, w, gamma, beta), _ = jax.lax.scan(
+                body, (x, w, gamma, beta), None, length=iters)
+            return x, gamma
+
+        def timed():
+            xs = []
+            for _ in range(max(warmup, 1)):
+                out = run(x, w, gamma, beta)
+            np.asarray(out[1])  # host readback sync (PERF.md tunnel note)
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                out = run(x, w, gamma, beta)
+                np.asarray(out[1])
+                xs.append((time.perf_counter() - t0) * 1e3 / iters)
+            return xs
+
+        return timed
+
+    fused_ms = make_timed(fused_loss)()
+    ref_ms = make_timed(ref_loss)()
+    return fused_ms, ref_ms
+
+
+def run_convbn(args, peak):
+    """--model convbn: per-shape fused-vs-XLA A/B records (BENCH_r07.json
+    `convbn_*` slots).  vs_baseline = XLA-composition time / fused time —
+    > 1.0 means the fused kernels win that shape; the per-lever protocol
+    in PERF.md round 7 reads these before trusting the end-to-end number."""
+    shapes = CONVBN_SHAPES_SMOKE if args.smoke else CONVBN_SHAPES
+    iters = 2 if args.smoke else 20
+    repeats = args.runs or (1 if args.smoke else 3)
+    for (label, n, hw, cin, cout, k, stride, residual) in shapes:
+        fused_ms, ref_ms = bench_convbn_shape(
+            n, hw, cin, cout, k, stride, residual, iters=iters,
+            repeats=repeats)
+        fmean, fspread, fruns = _mean_spread(fused_ms)
+        rmean, rspread, rruns = _mean_spread(ref_ms)
+        emit_metric(
+            f"convbn_fused_step_ms_{label}", fmean, "ms/iter",
+            rmean / fmean if fmean else None, None, 0.0,
+            {"batch": n, "hw": hw, "c_in": cin, "c_out": cout,
+             "ksize": k, "stride": stride, "residual": residual,
+             "iters": iters, "bf16": True,
+             "runs": [round(r, 3) for r in fruns],
+             "spread": round(fspread, 3),
+             "ref_ms": round(rmean, 3),
+             "ref_runs": [round(r, 3) for r in rruns],
+             "ref_spread": round(rspread, 3)})
+
+
 def bert_train_flops_per_token(n_layer, d_model, d_ff, seq_len, vocab):
     """Analytic matmul FLOPs per token, encoder-only + MLM head (2 FLOPs
     per MAC, train = 3x fwd)."""
@@ -715,7 +847,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="all",
                    choices=["all", "resnet50", "transformer", "bert",
-                            "deepfm", "mnist", "ringattn"])
+                            "deepfm", "mnist", "ringattn", "convbn"])
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for a fast correctness pass")
     p.add_argument("--no-amp", dest="amp", action="store_false")
@@ -724,7 +856,8 @@ def main():
     p.add_argument("--calls", type=int, default=None)
     p.add_argument("--runs", type=int, default=None,
                    help="repeat the timed region N times and report "
-                        "mean + runs[] + spread (transformer/bert/deepfm; "
+                        "mean + runs[] + spread (transformer/bert/deepfm/"
+                        "convbn; "
                         "default 3 full, 1 smoke) — PERF.md tunnel-"
                         "variance protocol")
     p.add_argument("--data-format", default="NHWC",
@@ -766,6 +899,11 @@ def main():
         ran.append(run_guarded("mnist", run_mnist, args, peak))
     if args.model in ("all", "deepfm"):
         ran.append(run_guarded("deepfm", run_deepfm, args, peak))
+    if args.model == "convbn":
+        # per-lever A/B microbench (PERF.md r07); not part of "all" so the
+        # full-bench content and the resnet50-last line stay unchanged —
+        # the driver runs it explicitly: python bench.py --model convbn
+        ran.append(run_guarded("convbn", run_convbn, args, peak))
     if args.model in ("all", "ringattn"):
         ran.append(run_guarded("ringattn", run_ringattn, args, peak))
     if args.model in ("all", "bert"):

@@ -139,6 +139,17 @@ def _cast_value(v, dtype):
     return v
 
 
+# Slot-wise policies: ops that mix MXU compute with fp32 master state in
+# ONE op.  conv2d_bn's conv operands and residual stream run bf16 exactly
+# as the unfused conv2d + elementwise_add would, but Scale/Bias/Mean/
+# Variance are the BN's fp32 running-stat state — a plain WHITE listing
+# would downcast the stateful MeanOut/VarianceOut writebacks, BLACK would
+# forfeit the MXU (the in-op statistics already accumulate in fp32, same
+# as the batch_norm lowering).
+SLOT_WHITE_OPS = {
+    "conv2d_bn": frozenset({"Input", "Filter", "Residual"}),
+}
+
 # Multi-input elementwise ops follow their activations: if any float input is
 # already bf16, cast the rest down instead of promoting the bf16 side to fp32
 # (an fp32 bias would otherwise drag every post-matmul activation back to
@@ -160,6 +171,13 @@ def apply_cast_policy(op_type: str, ins: dict) -> dict:
     import jax.numpy as jnp
 
     base = op_type[:-5] if op_type.endswith("_grad") else op_type
+    slots = SLOT_WHITE_OPS.get(base)
+    if slots is not None:
+        return {
+            slot: ([_cast_value(v, jnp.bfloat16) for v in vals]
+                   if slot in slots else list(vals))
+            for slot, vals in ins.items()
+        }
     if base in WHITE_OPS:
         target = jnp.bfloat16
     elif base in BLACK_OPS:

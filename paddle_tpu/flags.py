@@ -137,6 +137,17 @@ FLAGS.define(
     "the XLA fallbacks always use the hash (kernels/attention.py, "
     "kernels/dropout_epilogue.py)")
 FLAGS.define(
+    "fused_bn", bool, True,
+    "NHWC training batch-norm runs the fused Pallas BN path "
+    "(kernels/conv_bn.py): models emit one conv2d_bn op per "
+    "conv->bn[->add->relu] chain (1x1 convs as a dot with a BN-stats "
+    "epilogue; other convs keep XLA's conv with a one-pass stats kernel), "
+    "and standalone NHWC batch_norm uses the one-pass stats + fused "
+    "apply kernels with a backward that folds the dgamma/dbeta channel "
+    "reductions into the dx pass; off = the reference conv2d + "
+    "batch_norm composition with XLA's separate stat reductions "
+    "(flag-off graphs are op-for-op identical to the pre-fusion ones)")
+FLAGS.define(
     "fused_dropout_add", bool, True,
     "the bundled transformer/BERT models lower their dropout+residual "
     "pairs through the fused dropout-add epilogue kernel "

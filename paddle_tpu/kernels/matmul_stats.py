@@ -1,133 +1,18 @@
-"""Fused matmul + per-column statistics — the Pallas kernel behind the
-conv1x1+BN-statistics fusion (PERF.md: ResNet's wall is the BN-stats tier,
-a separate roofline-bound HBM pass over every conv output; reference
-analogue: the cuDNN fused BN ops the reference reaches through
-batch_norm_op.cu).
+"""DEPRECATED alias — folded into kernels/conv_bn.py (PR r07).
 
-y = x @ w written as usual, and the per-column sum / sum-of-squares of y
-accumulate in VMEM as the M-grid walks — the conv output is never re-read
-from HBM to compute batch-norm statistics.  A 1x1 stride-1 NHWC conv IS
-this matmul with M = N*H*W (x reshaped for free), which covers the
-expand-projections that produce ~2/3 of ResNet's activation volume.
+This module was the r05 "Pallas matmul + per-column statistics" experiment
+(measured negative result: XLA's plain dot beat it by 35-50% at the ResNet
+1x1 K=64/128 shapes, and lowering 1x1 convs as dots collapsed end-to-end
+throughput 2521 -> 1412 img/s on layout duals — PERF.md round-5).  Its
+measured cost model and the fused-stats idea now live in conv_bn.py, whose
+dot_col_stats kernel keeps the filter in ONE [C_out, C_in] orientation for
+forward and backward (the fix for the r05 collapse) and whose
+conv_bn_stats/bn_apply pair is the shipping fused-BN path.
 
-Backward (custom vjp): the stats outputs are linear/quadratic in y, so
-their cotangents fold into an effective dY:
-    dY_eff = dY + dSum[None, :] + 2 * y * dSqSum[None, :]
-then dx = dY_eff @ w^T, dw = x^T @ dY_eff (XLA matmuls; y is already
-retained as the BN input residual so the fold costs one fused pass).
+`matmul_col_stats` is re-exported for the r05 record and existing callers;
+new code should use conv_bn.dot_col_stats / conv_bn.conv_bn_stats.
 """
 
 from __future__ import annotations
 
-import functools
-
-
-def _mm_stats_kernel(x_ref, w_ref, y_ref, stats_ref, *, block_m, n_k):
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-
-    mi = pl.program_id(1)
-
-    x = x_ref[...]
-    w = w_ref[...]
-    acc = jax.lax.dot_general(
-        x, w, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    y_ref[...] = acc.astype(y_ref.dtype)
-    # stats of the STORED dtype (bf16-rounded y is what BN's backward
-    # recompute sees)
-    ys = y_ref[...].astype(jnp.float32)
-    s1 = jnp.sum(ys, axis=0)
-    s2 = jnp.sum(ys * ys, axis=0)
-    tile = jnp.concatenate(
-        [jnp.broadcast_to(s1[None, :], (4, s1.shape[0])),
-         jnp.broadcast_to(s2[None, :], (4, s2.shape[0]))], axis=0) / 4.0
-
-    @pl.when(mi == 0)
-    def _init():
-        stats_ref[...] = tile
-
-    @pl.when(mi != 0)
-    def _acc():
-        stats_ref[...] += tile
-
-
-def matmul_col_stats(x, w, block_m=512, block_n=512, interpret=None):
-    """(y, sum, sqsum) with y = x @ w (x [M, K], w [K, N]); sum/sqsum are
-    f32 [N] column statistics of y.  Falls back to plain XLA when shapes
-    don't fit the kernel plan.  Differentiable: the custom vjp folds the
-    stats cotangents into an effective dY (see module docstring)."""
-    import functools as ft
-
-    import jax
-
-    @ft.partial(jax.custom_vjp)
-    def _mm(x, w):
-        return _matmul_col_stats_fwd_impl(x, w, block_m, block_n,
-                                          interpret)
-
-    def _fwd(x, w):
-        y, s1, s2 = _matmul_col_stats_fwd_impl(x, w, block_m, block_n,
-                                               interpret)
-        return (y, s1, s2), (x, w, y)
-
-    def _bwd(res, gs):
-        import jax.numpy as jnp
-
-        x, w, y = res
-        gy, gsum, gsq = gs
-        gy_eff = (gy.astype(jnp.float32) + gsum[None, :]
-                  + 2.0 * y.astype(jnp.float32) * gsq[None, :])
-        gy_eff = gy_eff.astype(x.dtype)
-        dx = jnp.dot(gy_eff, w.T,
-                     preferred_element_type=jnp.float32).astype(x.dtype)
-        dw = jnp.dot(x.T, gy_eff,
-                     preferred_element_type=jnp.float32).astype(w.dtype)
-        return dx, dw
-
-    _mm.defvjp(_fwd, _bwd)
-    return _mm(x, w)
-
-
-def _matmul_col_stats_fwd_impl(x, w, block_m, block_n, interpret):
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-
-    m, k = x.shape
-    k2, n = w.shape
-    assert k == k2, (x.shape, w.shape)
-    on_tpu = jax.default_backend() == "tpu"
-    if interpret is None:
-        interpret = not on_tpu
-    block_m = min(block_m, m)
-    block_n = min(block_n, n)
-    ok = (m % block_m == 0 and n % block_n == 0
-          and block_m % 8 == 0 and block_n % 128 == 0)
-    if not ok or (not on_tpu and not interpret):
-        y = jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
-        ys = y.astype(jnp.float32)
-        return y, ys.sum(0), (ys * ys).sum(0)
-
-    grid = (n // block_n, m // block_m)  # m fastest: stats accumulate
-    kern = functools.partial(_mm_stats_kernel, block_m=block_m,
-                             n_k=k)
-    y, stats = pl.pallas_call(
-        kern,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((block_m, k), lambda ni, mi: (mi, 0)),
-            pl.BlockSpec((k, block_n), lambda ni, mi: (0, ni)),
-        ],
-        out_specs=[
-            pl.BlockSpec((block_m, block_n), lambda ni, mi: (mi, ni)),
-            pl.BlockSpec((8, block_n), lambda ni, mi: (0, ni)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((m, n), x.dtype),
-            jax.ShapeDtypeStruct((8, n), jnp.float32),
-        ],
-        interpret=interpret,
-    )(x, w)
-    return y, stats[:4].sum(0), stats[4:].sum(0)
+from .conv_bn import dot_col_stats, matmul_col_stats  # noqa: F401

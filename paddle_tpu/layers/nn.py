@@ -318,6 +318,137 @@ def batch_norm(
     return helper.append_activation(out)
 
 
+def conv2d_bn(
+    input,
+    num_filters,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups=None,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    residual=None,
+    is_test=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    name=None,
+    moving_mean_name=None,
+    moving_variance_name=None,
+    use_global_stats=False,
+):
+    """Fused conv2d (bias-free) + batch_norm [+ residual add] [+ act] as
+    ONE `conv2d_bn` op (ops/nn_ops.py lower_conv2d_bn, kernels/conv_bn.py)
+    — the FLAGS_fused_bn route models select for conv->bn[->add->relu]
+    chains (models/resnet.py conv_bn_layer).
+
+    Parameters and moving-stat variables are created through the SAME
+    LayerHelper name sequence as the unfused `conv2d(bias_attr=False)` +
+    `batch_norm` pair, so parameter names — and therefore checkpoints —
+    are identical whichever route FLAGS_fused_bn picks (asserted in
+    tests/test_conv_bn.py).  `param_attr` names the conv filter attr
+    (conv2d parity); scale/bias take batch_norm's defaults.  `act` must
+    be None or "relu" (the fusable epilogues); `residual` is added after
+    the BN scale/shift and before the activation, replacing the separate
+    `elementwise_add(residual, bn, act=act)` op."""
+    if act not in (None, "relu"):
+        raise ValueError(f"conv2d_bn fuses act None|'relu', got {act!r}")
+    conv_helper = LayerHelper("conv2d", param_attr=param_attr, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[-1 if data_format == "NHWC" else 1]
+    groups = groups or 1
+
+    def _pair(x):
+        return list(x) if isinstance(x, (list, tuple)) else [x, x]
+
+    filter_size = _pair(filter_size)
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+
+    import numpy as np
+
+    from ..initializer import NormalInitializer
+
+    fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+    std = float(np.sqrt(2.0 / fan_in))
+    w = conv_helper.create_parameter(
+        conv_helper.param_attr(),
+        shape=filter_shape,
+        dtype=dtype,
+        default_initializer=NormalInitializer(0.0, std),
+    )
+
+    bn_helper = LayerHelper("batch_norm", bias_attr=bias_attr)
+    c = num_filters
+    scale = bn_helper.create_parameter(
+        bn_helper.param_attr(), shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(1.0),
+    )
+    bias = bn_helper.create_parameter(
+        bn_helper.bias_attr(), shape=[c], dtype=dtype, is_bias=True
+    )
+    mean = bn_helper.create_global_variable(
+        name=moving_mean_name or fw.unique_name(
+            ".".join([bn_helper.name, "mean"])),
+        shape=[c],
+        dtype=dtype,
+        persistable=True,
+    )
+    bn_helper.set_variable_initializer(mean, ConstantInitializer(0.0))
+    variance = bn_helper.create_global_variable(
+        name=moving_variance_name or fw.unique_name(
+            ".".join([bn_helper.name, "var"])),
+        shape=[c],
+        dtype=dtype,
+        persistable=True,
+    )
+    bn_helper.set_variable_initializer(variance, ConstantInitializer(1.0))
+
+    saved_mean = bn_helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    saved_var = bn_helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    out = bn_helper.create_variable_for_type_inference(dtype)
+    inputs = {
+        "Input": [input],
+        "Filter": [w],
+        "Scale": [scale],
+        "Bias": [bias],
+        "Mean": [mean],
+        "Variance": [variance],
+    }
+    if residual is not None:
+        inputs["Residual"] = [residual]
+    bn_helper.append_op(
+        "conv2d_bn",
+        inputs=inputs,
+        outputs={
+            "Y": [out],
+            "MeanOut": [mean],
+            "VarianceOut": [variance],
+            "SavedMean": [saved_mean],
+            "SavedVariance": [saved_var],
+        },
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+            "data_format": data_format,
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "is_test": is_test,
+            "use_global_stats": use_global_stats,
+            "act": act or "",
+        },
+    )
+    return out
+
+
 def layer_norm(
     input,
     scale=True,

@@ -10,8 +10,36 @@ from __future__ import annotations
 from .. import layers
 
 
+def _fused_bn_site(is_train, data_format):
+    """The fused conv+BN route (PERF.md r07) arms for NHWC training
+    graphs under FLAGS_fused_bn (default on).  NCHW and inference keep
+    the reference conv2d + batch_norm [+ elementwise_add] composition —
+    with the flag off the emitted graph is op-for-op identical to the
+    pre-fusion builder (asserted in tests/test_conv_bn.py)."""
+    from ..flags import FLAGS
+
+    return bool(FLAGS.fused_bn) and data_format == "NHWC" and is_train
+
+
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
-                  is_train=True, data_format="NCHW"):
+                  is_train=True, data_format="NCHW", residual=None):
+    """conv -> batch_norm [-> + residual] [-> act].  Fused sites emit ONE
+    conv2d_bn op (1x1-conv+stats-epilogue / one-pass-stats kernels with
+    the fused apply — kernels/conv_bn.py); the reference route keeps the
+    separate ops, with a trailing residual handled by the same
+    elementwise_add(residual, bn, act) the original blocks used."""
+    if _fused_bn_site(is_train, data_format):
+        return layers.conv2d_bn(
+            input=input,
+            num_filters=ch_out,
+            filter_size=filter_size,
+            stride=stride,
+            padding=padding,
+            act=act,
+            residual=residual,
+            is_test=not is_train,
+            data_format=data_format,
+        )
     conv1 = layers.conv2d(
         input=input,
         filter_size=filter_size,
@@ -22,8 +50,12 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
         bias_attr=False,
         data_format=data_format,
     )
-    return layers.batch_norm(input=conv1, act=act, is_test=not is_train,
-                             data_layout=data_format)
+    bn = layers.batch_norm(
+        input=conv1, act=None if residual is not None else act,
+        is_test=not is_train, data_layout=data_format)
+    if residual is not None:
+        return layers.elementwise_add(residual, bn, act=act)
+    return bn
 
 
 def shortcut(input, ch_out, stride, is_train=True, data_format="NCHW"):
@@ -39,9 +71,9 @@ def basicblock(input, ch_out, stride, is_train=True, data_format="NCHW"):
                      data_format=data_format)
     conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_train=is_train,
                           data_format=data_format)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None,
-                          is_train=is_train, data_format=data_format)
-    return layers.elementwise_add(short, conv2, act="relu")
+    return conv_bn_layer(conv1, ch_out, 3, 1, 1, act="relu",
+                         residual=short, is_train=is_train,
+                         data_format=data_format)
 
 
 def bottleneck(input, ch_out, stride, is_train=True, data_format="NCHW"):
@@ -51,9 +83,9 @@ def bottleneck(input, ch_out, stride, is_train=True, data_format="NCHW"):
                           data_format=data_format)
     conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_train=is_train,
                           data_format=data_format)
-    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
-                          is_train=is_train, data_format=data_format)
-    return layers.elementwise_add(short, conv3, act="relu")
+    return conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act="relu",
+                         residual=short, is_train=is_train,
+                         data_format=data_format)
 
 
 def layer_warp(block_func, input, ch_out, count, stride, is_train=True,

@@ -264,6 +264,37 @@ def lower_batch_norm(ctx, ins):
     # x's dtype, so a bf16 conv->bn->relu chain stays bf16 and XLA fuses it.
     stat_dtype = jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
 
+    # Fused route (FLAGS_fused_bn, NHWC training): one-pass Pallas
+    # channel-stats kernel + fused apply whose custom VJP folds the
+    # dgamma/dbeta reductions into the dx pass (kernels/conv_bn.py) —
+    # same math, same fp32 stat accumulation, same stateful contract.
+    from ..flags import FLAGS as _FLAGS
+
+    fused = (not use_global and _FLAGS.fused_bn and layout == "NHWC"
+             and x.ndim == 4
+             and x.dtype in (jnp.float32, jnp.bfloat16))
+    if fused:
+        from ..kernels import conv_bn as _cbn
+
+        n_count = 1
+        for s in x.shape[:-1]:
+            n_count *= int(s)
+        s1, s2 = _cbn.channel_stats(x)
+        mean = s1 / n_count
+        var = s2 / n_count - jnp.square(mean)
+        m = jax.lax.stop_gradient(mean)
+        v = jax.lax.stop_gradient(var)
+        mean_out = mean_in * momentum + m * (1 - momentum)
+        var_out = var_in * momentum + v * (1 - momentum)
+        y = _cbn.bn_apply(x, scale, bias, mean, var, eps=eps)
+        return {
+            "Y": [y],
+            "MeanOut": [mean_out],
+            "VarianceOut": [var_out],
+            "SavedMean": [m],
+            "SavedVariance": [v],
+        }
+
     if use_global:
         mean, var = mean_in, var_in
         mean_out, var_out = mean_in, var_in
@@ -289,6 +320,133 @@ def lower_batch_norm(ctx, ins):
         "SavedMean": [saved_mean],
         "SavedVariance": [saved_var],
     }
+
+
+def _conv_bn_infer(ctx):
+    xs = ctx.input_shape("Input")
+    ws = ctx.input_shape("Filter")
+    if xs is None or ws is None:
+        return
+    strides = ctx.attr("strides", [1, 1])
+    paddings = ctx.attr("paddings", [0, 0])
+    dilations = ctx.attr("dilations", [1, 1])
+    nhwc = ctx.attr("data_format", "NCHW") == "NHWC"
+    if nhwc:
+        n, h, w, _ = xs
+    else:
+        n, _, h, w = xs
+    oc, _, kh, kw = ws
+    oh = (h + 2 * paddings[0] - (dilations[0] * (kh - 1) + 1)) // strides[0] + 1
+    ow = (w + 2 * paddings[1] - (dilations[1] * (kw - 1) + 1)) // strides[1] + 1
+    out = (n, oh, ow, oc) if nhwc else (n, oc, oh, ow)
+    ctx.set_output("Y", out, ctx.input_dtype("Input"))
+    stat_dtype = ctx.input_dtype("Mean") or ctx.input_dtype("Input")
+    for slot in ("SavedMean", "SavedVariance"):
+        ctx.set_output(slot, (oc,), stat_dtype)
+
+
+@register("conv2d_bn", infer_shape=_conv_bn_infer)
+def lower_conv2d_bn(ctx, ins):
+    """Fused conv2d + batch_norm [+ residual add] [+ ReLU] — ONE op for
+    the conv->bn[->add->relu] chains the models emit under FLAGS_fused_bn
+    (layers/nn.py conv2d_bn; kernels/conv_bn.py).
+
+    Contract: the batch_norm op's stateful contract is preserved verbatim
+    — MeanOut/VarianceOut (same var names as the Mean/Variance inputs)
+    are returned and the executor writes them back to the Scope; Saved*
+    carry the batch statistics.  The conv is bias-free (reference resnet
+    conv_bn_layer convention: the BN shift subsumes the bias).
+
+    Fused lowering (training, NHWC): kernels/conv_bn.py conv_bn_stats
+    (1x1 convs as a dot with a per-channel sum/sum² epilogue — the conv
+    output is never re-read from HBM for statistics; other shapes keep
+    XLA's conv with the one-pass stats kernel) + bn_apply (normalize +
+    scale/shift + residual + ReLU in one read, backward folds the
+    dgamma/dbeta reductions into the dx pass).  Inference/use_global,
+    NCHW, or FLAGS_fused_bn off at trace time: the reference XLA
+    composition, numerically identical to the unfused op chain."""
+    import jax
+    import jax.lax as lax
+
+    jnp = _jnp()
+    from ..flags import FLAGS
+
+    x, w = ins["Input"][0], ins["Filter"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean_in, var_in = ins["Mean"][0], ins["Variance"][0]
+    residual = ins["Residual"][0] if ins.get("Residual") else None
+    strides = tuple(ctx.attr("strides", [1, 1]))
+    p = ctx.attr("paddings", [0, 0])
+    dil = tuple(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    fmt = ctx.attr("data_format", "NHWC")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    act = ctx.attr("act", "") or ""
+    if act not in ("", "relu"):
+        raise ValueError(f"conv2d_bn: unsupported act {act!r}")
+    is_test = ctx.attr("is_test", False) or ctx.is_test
+    use_global = ctx.attr("use_global_stats", False) or is_test
+
+    fused = (not use_global and FLAGS.fused_bn and fmt == "NHWC"
+             and x.dtype in (jnp.float32, jnp.bfloat16))
+    if fused:
+        from ..kernels import conv_bn as _cbn
+
+        y, s1, s2 = _cbn.conv_bn_stats(x, w, strides, p, dil, groups)
+        n_count = 1
+        for s in y.shape[:-1]:
+            n_count *= int(s)
+        mean = s1 / n_count
+        var = s2 / n_count - jnp.square(mean)
+        m = jax.lax.stop_gradient(mean)
+        v = jax.lax.stop_gradient(var)
+        mean_out = mean_in * momentum + m * (1 - momentum)
+        var_out = var_in * momentum + v * (1 - momentum)
+        out = _cbn.bn_apply(y, scale, bias, mean, var, residual=residual,
+                            eps=eps, act=act)
+        return {"Y": [out], "MeanOut": [mean_out], "VarianceOut": [var_out],
+                "SavedMean": [m], "SavedVariance": [v]}
+
+    # reference XLA composition (inference/use_global, NCHW, or flag off
+    # at trace time): conv + folded scale/shift (+residual) (+relu) —
+    # XLA fuses the epilogue chain into one elementwise pass
+    y = lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=dil,
+        dimension_numbers=(fmt, "OIHW", fmt),
+        feature_group_count=groups,
+    )
+    caxis = 1 if fmt == "NCHW" else y.ndim - 1
+    stat_dtype = jnp.float32 if y.dtype == jnp.bfloat16 else y.dtype
+    axes = tuple(i for i in range(y.ndim) if i != caxis)
+    bshape = [1] * y.ndim
+    bshape[caxis] = y.shape[caxis]
+    if use_global:
+        mean, var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+        m, v = mean_in, var_in
+    else:
+        ys = y.astype(stat_dtype)
+        mean = jnp.mean(ys, axis=axes)
+        var = jnp.mean(jnp.square(ys), axis=axes) - jnp.square(mean)
+        m = jax.lax.stop_gradient(mean)
+        v = jax.lax.stop_gradient(var)
+        mean_out = mean_in * momentum + m * (1 - momentum)
+        var_out = var_in * momentum + v * (1 - momentum)
+    inv_std = jax.lax.rsqrt(var.astype(stat_dtype) + eps)
+    wv = scale.astype(stat_dtype) * inv_std
+    bv = bias.astype(stat_dtype) - mean.astype(stat_dtype) * wv
+    out = (y * wv.astype(y.dtype).reshape(bshape)
+           + bv.astype(y.dtype).reshape(bshape))
+    if residual is not None:
+        out = out + residual.astype(out.dtype)
+    if act == "relu":
+        out = jax.nn.relu(out)
+    return {"Y": [out], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [m], "SavedVariance": [v]}
 
 
 def layer_norm_core(x, scale, bias, axis, eps):

@@ -1083,13 +1083,18 @@ def test_polynomial_decay_cycle():
 
 
 def test_matmul_col_stats_kernel():
-    """kernels/matmul_stats.py: fused y = x@w + per-column sum/sum² (the
-    measured-and-parked ResNet conv+stats candidate — PERF.md r5). The
-    kernel path (interpret on CPU) must match plain XLA exactly."""
+    """kernels/conv_bn.py (ex matmul_stats.py, now a deprecation alias):
+    fused y = x@w + per-column sum/sum² — the r05 experiment whose cost
+    model seeded the r07 fused-BN path.  The kernel path (interpret on
+    CPU) must match plain XLA, and the alias module must keep
+    re-exporting the entry point."""
     import jax
     import jax.numpy as jnp
 
-    from paddle_tpu.kernels.matmul_stats import matmul_col_stats
+    from paddle_tpu.kernels import matmul_stats as _alias
+    from paddle_tpu.kernels.conv_bn import matmul_col_stats
+
+    assert _alias.matmul_col_stats is matmul_col_stats
 
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(1024, 128).astype("float32"))
@@ -1111,7 +1116,7 @@ def test_matmul_col_stats_grads():
     import jax
     import jax.numpy as jnp
 
-    from paddle_tpu.kernels.matmul_stats import matmul_col_stats
+    from paddle_tpu.kernels.conv_bn import matmul_col_stats
 
     rng = np.random.RandomState(1)
     x = jnp.asarray(rng.randn(256, 128).astype("float32"))

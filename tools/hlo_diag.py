@@ -4,7 +4,18 @@ step: counts copy/transpose/custom-call instructions by shape and locates
 them relative to the flash-attention custom-calls.  Perf tooling for
 PERF.md leads 1-2 (attention layout copies, scan-carry copies).
 
-Usage: python tools/hlo_diag.py [transformer|transformer_noflash|resnet50] [out.txt]
+Usage: python tools/hlo_diag.py [transformer|transformer_noflash|resnet50]
+           [out.txt] [--bn-fusion]
+
+--bn-fusion (resnet50): the round-7 BN-wall attribution report — counts
+the BN-statistics channel reductions (full passes over 3/4-D activations
+producing per-channel vectors), the layout-dual filter copies (the same
+[O,I,kh,kw] filter held in two layouts for fwd vs bwd conv — the r04
+"momentum chain in two layout duals" finding), and the activation bytes
+those reduction passes re-read.  Run it with FLAGS_fused_bn=0 vs =1 (env
+var) and diff the counters: the A/B attribution of the fused-BN levers is
+mechanical (tests/test_conv_bn.py asserts the fused path removes the
+reduction passes).
 """
 
 import os
@@ -142,9 +153,112 @@ def analyze(txt):
     return "\n".join(out)
 
 
+# --bn-fusion: BN-statistics / layout-dual attribution ----------------------
+
+# `%name = f32[64]{0} reduce(f32[2,8,8,64]{3,2,1,0} %op, f32[] %init), ...`
+_REDUCE_RE = re.compile(
+    r"= ([a-z0-9]+)\[([\d,]*)\][^ ]* reduce\(([a-z0-9]+)\[([\d,]*)\]")
+_COPY_RE = re.compile(
+    r"= ([a-z0-9]+)\[([\d,]*)\](\{[\d,]+\})? copy\(")
+_SRC_RE = re.compile(r'source_file="([^"]*)" source_line=(\d+)')
+_FILTER_KSIZES = (1, 3, 7)
+_FLOAT_DTS = ("f32", "bf16", "f16", "f64")
+
+
+def _dims(s):
+    return tuple(int(x) for x in s.split(",") if x)
+
+
+def analyze_bn_fusion(txt):
+    """BN-wall counters from optimized-HLO text (the whole dump is
+    scanned, so reductions inside fusion computation bodies count too):
+
+      channel_reduces      float reduce instrs producing a 1-D per-channel
+                           vector (>= 8 lanes) — the BN sum/sum²/dgamma/
+                           dbeta tier, fwd AND bwd, wherever it came from
+                           (XLA freely bitcasts the activation first, so
+                           the rule keys on the OUTPUT shape)
+      channel_reduce_read_mb  MB of inputs those reductions re-read (each
+                           is a full pass over the activation it consumes)
+      bn_stat_reduces      the subset whose source metadata points into
+                           ops/nn_ops.py — i.e. emitted by the batch_norm
+                           lowering itself; the fused path must drive this
+                           to ZERO (its statistics ride the conv_bn.py
+                           kernels; interpret-mode emulation attributes to
+                           conv_bn.py, compiled Mosaic emits no reduce)
+      filter_copies / filter_copy_mb / filter_layout_duals
+                           copy instrs of 4-D [O,I,kh,kw] filter-shaped
+                           tensors, and the dim-shapes held in >= 2
+                           distinct layouts — the fwd/bwd layout duals of
+                           the r04 momentum-chain finding
+    """
+    channel_reduces = 0
+    read_bytes = 0
+    bn_stat_reduces = 0
+    bn_read_bytes = 0
+    filter_copies = 0
+    filter_copy_bytes = 0
+    layouts_by_filter = collections.defaultdict(set)
+    for ln in txt.splitlines():
+        s = ln.strip()
+        m = _REDUCE_RE.search(s)
+        if m:
+            out_dt, out_dims, in_dt, in_dims = m.groups()
+            od, idm = _dims(out_dims), _dims(in_dims)
+            if (out_dt in _FLOAT_DTS and len(od) == 1 and od[0] >= 8
+                    and len(idm) >= 2):
+                nbytes = DT_BYTES.get(in_dt, 4) * int(np.prod(idm))
+                channel_reduces += 1
+                read_bytes += nbytes
+                src = _SRC_RE.search(s)
+                if src and src.group(1).endswith("nn_ops.py"):
+                    bn_stat_reduces += 1
+                    bn_read_bytes += nbytes
+            continue
+        m = _COPY_RE.search(s)
+        if m:
+            dt, dims, layout = m.groups()
+            d = _dims(dims)
+            if (len(d) == 4 and d[2] == d[3] and d[2] in _FILTER_KSIZES
+                    and d[0] >= 8 and d[1] >= 8):
+                filter_copies += 1
+                filter_copy_bytes += DT_BYTES.get(dt, 4) * int(np.prod(d))
+                layouts_by_filter[d].add(layout or "{default}")
+    duals = {d: sorted(ls) for d, ls in layouts_by_filter.items()
+             if len(ls) >= 2}
+    return {
+        "channel_reduces": channel_reduces,
+        "channel_reduce_read_mb": round(read_bytes / 1e6, 1),
+        "bn_stat_reduces": bn_stat_reduces,
+        "bn_stat_read_mb": round(bn_read_bytes / 1e6, 1),
+        "filter_copies": filter_copies,
+        "filter_copy_mb": round(filter_copy_bytes / 1e6, 1),
+        "filter_layout_duals": len(duals),
+        "filter_layout_dual_shapes": {
+            "x".join(map(str, d)): ls for d, ls in sorted(duals.items())},
+    }
+
+
+def format_bn_fusion(rep):
+    out = ["== BN-fusion report (PERF.md r07 attribution) =="]
+    out.append(f"  channel-stat reduction passes: {rep['channel_reduces']} "
+               f"(re-reading {rep['channel_reduce_read_mb']} MB)")
+    out.append(f"  ... emitted by the batch_norm lowering: "
+               f"{rep['bn_stat_reduces']} ({rep['bn_stat_read_mb']} MB) "
+               "— 0 on the fused path")
+    out.append(f"  filter-shaped copies: {rep['filter_copies']} "
+               f"({rep['filter_copy_mb']} MB)")
+    out.append(f"  filter layout duals: {rep['filter_layout_duals']}")
+    for shape, layouts in rep["filter_layout_dual_shapes"].items():
+        out.append(f"    {shape}: {', '.join(layouts)}")
+    return "\n".join(out)
+
+
 def main():
-    which = sys.argv[1] if len(sys.argv) > 1 else "transformer"
-    out_path = sys.argv[2] if len(sys.argv) > 2 else f"/tmp/hlo_{which}.txt"
+    argv = [a for a in sys.argv[1:] if not a.startswith("--")]
+    bn_fusion = "--bn-fusion" in sys.argv[1:]
+    which = argv[0] if argv else "transformer"
+    out_path = argv[1] if len(argv) > 1 else f"/tmp/hlo_{which}.txt"
     if which == "transformer":
         args = compile_transformer()
     elif which == "transformer_noflash":
@@ -158,6 +272,8 @@ def main():
         f.write(txt)
     print(f"[hlo_diag] optimized HLO -> {out_path} ({len(txt)} bytes)")
     print(analyze(txt))
+    if bn_fusion:
+        print(format_bn_fusion(analyze_bn_fusion(txt)))
 
 
 if __name__ == "__main__":

@@ -42,6 +42,11 @@ if [[ "${1:-}" != "fast" ]]; then
       --monitor-snapshot ci_artifacts/metrics.prom \
     | tee ci_artifacts/bench_smoke.json
   echo "-- A/B bench record artifact: ci_artifacts/bench_smoke.json ($(grep -c '' ci_artifacts/bench_smoke.json) records, streamed above)"
+  # conv+BN microbench leg (PERF.md r07 per-lever A/B): tiny shapes under
+  # the same warnings gate; the JSON record sits next to bench_smoke.json
+  python -W error::UserWarning bench.py --model convbn --smoke \
+    | tee ci_artifacts/bench_convbn_smoke.json
+  echo "-- convbn A/B record artifact: ci_artifacts/bench_convbn_smoke.json"
   echo "-- metrics snapshot:"
   head -40 ci_artifacts/metrics.prom || true
   echo "-- flight record (black box of the smoke run):"
