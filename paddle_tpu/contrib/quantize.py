@@ -246,7 +246,11 @@ def freeze_int8(program: fw.Program, scope, startup_program=None) -> int:
                 traced, in_slots[nt], scale_slots[nt]):
             to_remove.add(qinfo[0])
             to_remove.add(dinfo[0])
-            if orig in params:
+            # a load_inference_model program loses Parameter-ness
+            # (parse_from_string rebuilds plain Variables), but weights
+            # are exactly the scope-resident quantized inputs — the
+            # serving tier freezes loaded artifacts through here
+            if orig in params or scope.find_var(orig) is not None:
                 if orig in frozen_weights:
                     # shared weight already int8: REUSE its scale var
                     # (re-quantizing the int8 tensor would compute
@@ -303,6 +307,19 @@ def freeze_int8(program: fw.Program, scope, startup_program=None) -> int:
         block.remove_op(j)
     block._bump()
     return count
+
+
+def count_fake_quant_ops(program: fw.Program) -> int:
+    """How many fake_quantize/fake_dequantize ops the program carries —
+    i.e. whether freeze_int8 has anything to freeze.  The serving tier
+    uses this to validate an int8-replica request BEFORE loading: a model
+    exported without QAT (QuantizeTranspiler.training_transpile) has no
+    trained scales, and freezing it would silently serve the float path."""
+    return sum(
+        1 for op in program.global_block().ops
+        if op.type.startswith("fake_quantize")
+        or op.type.startswith("fake_dequantize")
+    )
 
 
 def quantize_var(x, scale, name=None):

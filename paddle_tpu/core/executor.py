@@ -406,10 +406,10 @@ class _CompiledEntry:
     """
 
     __slots__ = ("fn", "rw_state", "ro_state", "state_writes", "needs_key",
-                 "nan_check_ops", "jitted")
+                 "nan_check_ops", "jitted", "run_lock")
 
     def __init__(self, fn, rw_state, ro_state, state_writes, needs_key,
-                 nan_check_ops=None, jitted=None):
+                 nan_check_ops=None, jitted=None, run_lock=None):
         self.fn = fn
         # the underlying jax.jit-wrapped callable, for AOT introspection
         # (profiler tooling lowers it to optimized HLO)
@@ -422,24 +422,56 @@ class _CompiledEntry:
         # outputs of fn); None when the mode is off.  The list is filled in
         # during the first trace of fn.
         self.nan_check_ops = nan_check_ops
+        # A stateful entry donates its rw buffers to the executable:
+        # concurrent calls would hand the SAME donated buffer to two
+        # executions (use-after-donate) and interleave the scope
+        # write-backs (torn state).  The lock's domain is the SHARED
+        # SCOPE STATE, not the entry: different feed signatures of one
+        # program donate the same scope arrays, so every stateful entry
+        # of an Executor carries the executor's one stateful-run lock
+        # (None for stateless entries — purely functional, serving
+        # threads run those concurrently).
+        self.run_lock = run_lock if state_writes else None
 
 
 class Executor:
     def __init__(self, place: Optional[Place] = None,
                  check_nan_inf: Optional[bool] = None):
         import os
+        import threading
 
         self.place = place or default_place()
         self._cache: Dict[Any, _CompiledEntry] = {}
         self._ref_names_cache: Dict[Any, tuple] = {}
         self._run_counter = 0
+        # Serving threads (paddle_tpu/serving dynamic batcher, user thread
+        # pools over Predictor) hammer run() concurrently: the compile
+        # cache uses per-key locks so N threads x M signatures compile
+        # exactly M times (double-checked under the key's lock), and the
+        # run counter draws under a lock so key-deriving programs never
+        # fold in a duplicate counter value.
+        self._counter_lock = threading.Lock()
+        self._compile_locks_guard = threading.Lock()
+        self._compile_locks: Dict[Any, threading.Lock] = {}
+        # ONE lock for every stateful run of this executor: stateful
+        # entries donate scope rw buffers, and entries of DIFFERENT feed
+        # signatures (serving bucket ladder) donate the SAME scope
+        # arrays — per-entry locking would let two signatures race a
+        # use-after-donate.  Predictor hands this same lock to its AOT
+        # bundles (inference.py), closing the JIT-vs-bundle race too.
+        self._stateful_lock = threading.Lock()
+        # recompile-detector state below is shared mutable: serialize
+        # lookups/commits so concurrent serving threads cannot tear the
+        # pending-stamp bookkeeping (recompile attribution would drift)
+        self._detector_lock = threading.Lock()
         # recompile detector state: last cache key per (mode, program)
         # + the program-stamps that have compiled at least once (a later
-        # miss on a seen stamp IS a recompile); only written when
-        # FLAGS.monitor is on
+        # miss on a seen stamp IS a recompile); pending = missed but not
+        # yet committed to the cache (a retried failed compile is not a
+        # recompile); only written when FLAGS.monitor is on
         self._last_key_by_program = {}
         self._compiled_stamps = set()
-        self._pending_stamp = None
+        self._pending_stamps = set()
         # debug mode, parity with the reference's FLAGS_check_nan_inf
         # (operator.cc:943): validate every op's outputs are finite
         if check_nan_inf is None:
@@ -527,48 +559,88 @@ class Executor:
 
         entry = self._cache.get(key) if use_program_cache else None
         compiled_now = entry is None
+        # hit/miss is NOTED only once the double-check below resolves it
+        # (a race-losing thread must not count a spurious miss), but t0
+        # starts here so a compile's duration lands in its flight event
         mon, t0 = self._begin_monitored(_RUN_KEY_PARTS, key,
-                                        not compiled_now)
+                                        not compiled_now, note=False)
         if entry is None:
-            try:
-                entry = self._compile(program, feed, feed_names,
-                                      fetch_names, scope)
-            except Exception:
-                self._count_error(mon)
-                raise
             if use_program_cache:
-                self._cache[key] = entry
-                self._commit_stamp()
+                with self._compile_locks_guard:
+                    import threading as _threading
 
-        rw_vals = [scope.find_var(n) for n in entry.rw_state]
-        ro_vals = [scope.find_var(n) for n in entry.ro_state]
+                    klock = self._compile_locks.setdefault(
+                        key, _threading.Lock())
+                with klock:
+                    # double-check: another thread may have compiled this
+                    # signature while we waited on its lock — N concurrent
+                    # callers of M signatures produce exactly M compiles
+                    entry = self._cache.get(key)
+                    if entry is None:
+                        if mon:
+                            self._note_cache_lookup(_RUN_KEY_PARTS, key,
+                                                    False)
+                        try:
+                            entry = self._compile(program, feed, feed_names,
+                                                  fetch_names, scope)
+                        except Exception:
+                            self._count_error(mon)
+                            raise
+                        self._cache[key] = entry
+                        self._commit_stamp(_RUN_KEY_PARTS, key)
+                    else:
+                        compiled_now = False
+                        if mon:
+                            self._note_cache_lookup(_RUN_KEY_PARTS, key,
+                                                    True)
+            else:
+                if mon:
+                    self._note_cache_lookup(_RUN_KEY_PARTS, key, False)
+                try:
+                    entry = self._compile(program, feed, feed_names,
+                                          fetch_names, scope)
+                except Exception:
+                    self._count_error(mon)
+                    raise
+        elif mon:
+            self._note_cache_lookup(_RUN_KEY_PARTS, key, True)
+
         feed_vals = [self._to_device_array(program, n, feed[n]) for n in feed_names]
+
+        import contextlib
 
         import jax
 
-        self._run_counter += 1
-        try:
-            if entry.needs_key:
-                seed = program.random_seed or 0
-                key_arr = jax.random.fold_in(prng_key(seed),
-                                             self._run_counter)
-                result = entry.fn(feed_vals, rw_vals, ro_vals, key_arr)
+        # stateful entries serialize (donated rw buffers + scope
+        # write-back must be atomic); stateless ones run concurrently
+        with entry.run_lock if entry.run_lock is not None \
+                else contextlib.nullcontext():
+            rw_vals = [scope.find_var(n) for n in entry.rw_state]
+            ro_vals = [scope.find_var(n) for n in entry.ro_state]
+            try:
+                if entry.needs_key:
+                    seed = program.random_seed or 0
+                    key_arr = jax.random.fold_in(prng_key(seed),
+                                                 self._next_run_id())
+                    result = entry.fn(feed_vals, rw_vals, ro_vals, key_arr)
+                else:
+                    self._next_run_id()
+                    result = entry.fn(feed_vals, rw_vals, ro_vals)
+            except Exception:
+                self._count_error(mon)
+                raise
+            if entry.nan_check_ops is not None:
+                fetches, new_state, nan_flags = result
             else:
-                result = entry.fn(feed_vals, rw_vals, ro_vals)
-        except Exception:
-            self._count_error(mon)
-            raise
-        if entry.nan_check_ops is not None:
-            fetches, new_state, nan_flags = result
-        else:
-            fetches, new_state = result
-            nan_flags = None
+                fetches, new_state = result
+                nan_flags = None
 
-        # Write state back BEFORE any nan/inf raise: the rw buffers were
-        # donated to the executable, so skipping this would leave the scope
-        # holding deleted arrays and poison every subsequent run.
-        for n, v in zip(entry.state_writes, new_state):
-            scope.set_var(n, v)
+            # Write state back BEFORE any nan/inf raise: the rw buffers
+            # were donated to the executable, so skipping this would leave
+            # the scope holding deleted arrays and poison every subsequent
+            # run.
+            for n, v in zip(entry.state_writes, new_state):
+                scope.set_var(n, v)
 
         if nan_flags is not None:
             bad = [
@@ -658,7 +730,7 @@ class Executor:
                 self._count_error(mon)
                 raise
             self._cache[key] = entry
-            self._commit_stamp()
+            self._commit_stamp(_STEPS_KEY_PARTS, key)
 
         rw_vals = [scope.find_var(n) for n in entry.rw_state]
         ro_vals = [scope.find_var(n) for n in entry.ro_state]
@@ -666,11 +738,8 @@ class Executor:
 
         import jax
 
-        self._run_counter += 1
         seed = program.random_seed or 0
-        base_key = jax.random.fold_in(
-            prng_key(seed), self._run_counter
-        )
+        base_key = jax.random.fold_in(prng_key(seed), self._next_run_id())
         try:
             result = entry.fn(feed_vals, rw_vals, ro_vals, base_key)
         except Exception:
@@ -808,14 +877,13 @@ class Executor:
                 self._count_error(mon)
                 raise
             self._cache[key] = entry
-            self._commit_stamp()
+            self._commit_stamp(_ACC_KEY_PARTS, key)
 
         rw_vals = [scope.find_var(n) for n in entry.rw_state]
         ro_vals = [scope.find_var(n) for n in entry.ro_state]
         feed_vals = [feed_stack[n] for n in feed_names]
-        self._run_counter += 1
         seed = program.random_seed or 0
-        base_key = jax.random.fold_in(prng_key(seed), self._run_counter)
+        base_key = jax.random.fold_in(prng_key(seed), self._next_run_id())
         try:
             fetches, new_state, nan_flags = entry.fn(
                 feed_vals, rw_vals, ro_vals, base_key)
@@ -976,7 +1044,7 @@ class Executor:
             lambda f, rw, ro, key: jitted(f, rw, ro, key),
             rw_state, ro_state, state_writes, True,
             nan_check_ops=nan_check_ops if check else None,
-            jitted=jitted,
+            jitted=jitted, run_lock=self._stateful_lock,
         )
 
     def _compile_steps(self, program, feed_names, fetch_names, scope, steps):
@@ -1053,7 +1121,7 @@ class Executor:
             lambda f, rw, ro, key: jitted(f, rw, ro, key),
             rw_state, ro_state, state_writes, True,
             nan_check_ops=nan_check_ops if check else None,
-            jitted=jitted,
+            jitted=jitted, run_lock=self._stateful_lock,
         )
 
     # -- telemetry internals (callers gate on monitor.enabled()) ---------
@@ -1085,20 +1153,25 @@ class Executor:
         # mode-qualified stamp: run/run_steps/run_accumulated executables
         # are distinct, so each mode gets its own first compile for free
         stamp = (part_names, key[part_names.index("program-stamp")])
-        # per-(mode, program) history: diffing against another program's
-        # (or call mode's) key would blame program-stamp/call-mode and
-        # bury the component that actually churned
-        prev = self._last_key_by_program.get(stamp)
-        self._last_key_by_program[stamp] = key
-        self._pending_stamp = None
-        if hit:
-            return
-        if stamp not in self._compiled_stamps:
-            # first compile of this program — registered only once the
-            # entry lands in the cache (_commit_stamp), so retrying a
-            # failed compile is still not a recompile
-            self._pending_stamp = stamp
-            return
+        with self._detector_lock:
+            # per-(mode, program) history: diffing against another
+            # program's (or call mode's) key would blame
+            # program-stamp/call-mode and bury the component that
+            # actually churned
+            prev = self._last_key_by_program.get(stamp)
+            self._last_key_by_program[stamp] = key
+            # a fresh lookup supersedes this stamp's uncommitted pending
+            # (the prior compile failed); OTHER stamps' pendings belong
+            # to concurrent threads and stay
+            self._pending_stamps.discard(stamp)
+            if hit:
+                return
+            if stamp not in self._compiled_stamps:
+                # first compile of this program — registered only once
+                # the entry lands in the cache (_commit_stamp), so
+                # retrying a failed compile is still not a recompile
+                self._pending_stamps.add(stamp)
+                return
         monitor.counter("executor.recompiles").inc()
         if prev is None:
             changed = ["(no prior lookup of this program)"]
@@ -1115,25 +1188,33 @@ class Executor:
             vlog(1, "executor recompile: changed key component(s): %s",
                  ", ".join(changed))
 
-    def _commit_stamp(self):
+    def _commit_stamp(self, part_names, key):
         """The compiled entry reached the cache: future misses of this
         program-stamp (in this call mode) are recompiles — even if the
         first execution later fails (e.g. check_nan_inf raises)."""
-        if self._pending_stamp is not None:
-            self._compiled_stamps.add(self._pending_stamp)
-            self._pending_stamp = None
+        try:
+            stamp = (part_names, key[part_names.index("program-stamp")])
+        except ValueError:
+            return
+        with self._detector_lock:
+            if stamp in self._pending_stamps:
+                self._pending_stamps.discard(stamp)
+                self._compiled_stamps.add(stamp)
 
-    def _begin_monitored(self, part_names, key, hit: bool):
+    def _begin_monitored(self, part_names, key, hit: bool, note: bool = True):
         """Telemetry prologue shared by run/run_steps/run_accumulated:
         returns (enabled, t0).  Zero registry work when FLAGS.monitor is
-        off — the hot path pays one flag read."""
+        off — the hot path pays one flag read.  note=False skips the
+        cache-lookup note (run() notes after its double-check resolves
+        the true hit/miss)."""
         from ..monitor import enabled
 
         if not enabled():
             return False, 0.0
         import time as _time
 
-        self._note_cache_lookup(part_names, key, hit)
+        if note:
+            self._note_cache_lookup(part_names, key, hit)
         return True, _time.perf_counter()
 
     def _finish_monitored(self, mode, mon, t0, compiled_now, feed_vals,
@@ -1205,6 +1286,14 @@ class Executor:
                 sum(int(getattr(o, "nbytes", 0) or 0) for o in np_outs))
 
     # -- internals -------------------------------------------------------
+    def _next_run_id(self) -> int:
+        """Draw the next run-counter value under a lock: key-deriving
+        programs fold this into their PRNG key, and concurrent serving
+        threads must never fold in the same value twice."""
+        with self._counter_lock:
+            self._run_counter += 1
+            return self._run_counter
+
     def _scope_signature(self, program, feed_names, scope) -> frozenset:
         """Which program-referenced names resolve to a live scope var.
 
@@ -1310,6 +1399,7 @@ class Executor:
         return _CompiledEntry(
             jitted, rw_state, ro_state, state_writes, probe_random,
             nan_check_ops=nan_check_ops if check else None,
+            run_lock=self._stateful_lock,
         )
 
 

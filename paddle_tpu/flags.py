@@ -184,6 +184,32 @@ FLAGS.define(
     "Prometheus text, /health, /flight last-N events; 0 disables the "
     "server")
 FLAGS.define(
+    "health_stall_s", float, 600.0,
+    "/health reports a trainer as stalled (HTTP 503) when a step monitor "
+    "exists but no step completed for this many seconds; a process that "
+    "never stepped (a pure inference server) is never 'stalled' — its "
+    "health comes from serving READINESS (monitor/serve.py)")
+FLAGS.define(
+    "serving_buckets", str, "1,2,4,8,16",
+    "default pad-to-bucket batch-size ladder for the inference server "
+    "(paddle_tpu/serving): requests coalesce and pad up to the smallest "
+    "bucket >= total rows, so the executor compile cache sees a BOUNDED "
+    "set of feed signatures; per-model override via ModelConfig.buckets")
+FLAGS.define(
+    "serving_max_batch", int, 16,
+    "default dynamic-batcher cap on coalesced rows per executed batch "
+    "(paddle_tpu/serving); effective cap is min(this, largest bucket)")
+FLAGS.define(
+    "serving_max_wait_ms", float, 5.0,
+    "default dynamic-batcher deadline: a queued request is executed at "
+    "most this many ms after arrival even if its batch is not full "
+    "(latency/fill tradeoff knob of the batching policy)")
+FLAGS.define(
+    "serving_cache_dir", str, "",
+    "persistent XLA compilation-cache directory for the inference server "
+    "(jax compilation cache): warmup compiles of the bucket ladder are "
+    "reused across server restarts; empty disables persistence")
+FLAGS.define(
     "record_lowered_ops", bool, False,
     "test/debug flag: the executor trace records every lowered op type "
     "into the flight recorder (monitor/flight.py lowered_op_types) — the "

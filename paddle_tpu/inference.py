@@ -19,6 +19,7 @@ params-frozen-to-device behavior.
 
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Dict, List, Optional
 
@@ -135,6 +136,13 @@ def inference_transpile(program: fw.Program, scope: Scope) -> int:
 
 
 AOT_DIRNAME = "__aot__"
+# v2: executables are serialized WITHOUT buffer donation.  v1 bundles
+# baked the executor's donate_argnums aliasing into the payload, and
+# jax's deserialized-Compiled path lacks the donation bookkeeping that
+# marks consumed arrays deleted — running one returns state arrays
+# aliasing freed buffers (use-after-free; nondeterministic corruption
+# under serving load).  Loaders REJECT v1 bundles (JIT fallback).
+AOT_VERSION = 2
 
 
 def _feed_signature(feed_names, feed):
@@ -185,6 +193,57 @@ def export_aot_bundle(dirname, feed_examples, place=None) -> int:
     import jax
     from jax.experimental import serialize_executable as se
 
+    with _persistent_cache_disabled():
+        return _export_aot_bundle(dirname, feed_examples, place, jax, se,
+                                  json)
+
+
+def reset_compilation_cache_singleton():
+    """Reset jax's persistent-compilation-cache singleton: jax memoizes
+    cache-enablement at first compile, so flipping
+    jax_compilation_cache_dir without this leaves the old cache live.
+    Best-effort private-API workaround, shared by export (cache OFF
+    around bundle serialization) and the serving server (cache ON at
+    startup) — keep the jax-upgrade fix in this one place."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API: best-effort
+        pass
+
+
+@contextlib.contextmanager
+def _persistent_cache_disabled():
+    """Disable jax's persistent compilation cache for the duration.
+
+    An executable LOADED from the persistent cache re-serializes as a
+    thin reference to in-process jit symbols (XLA:CPU deserialize then
+    fails with "Symbols not found" in any other process), so
+    export_aot_bundle must compile its payloads fresh — a bundle's whole
+    point is surviving the process that wrote it."""
+    import jax
+
+    try:
+        prev = jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        prev = None
+
+    if prev is None:
+        # a live singleton can outlast config=None
+        reset_compilation_cache_singleton()
+        yield
+        return
+    jax.config.update("jax_compilation_cache_dir", None)
+    reset_compilation_cache_singleton()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        reset_compilation_cache_singleton()
+
+
+def _export_aot_bundle(dirname, feed_examples, place, jax, se, json) -> int:
     pred = Predictor(dirname, place=place, optimize=False, use_aot=False)
     exe, scope, program = pred._exe, pred._scope, pred._program
     out_dir = os.path.join(dirname, AOT_DIRNAME)
@@ -210,8 +269,23 @@ def export_aot_bundle(dirname, feed_examples, place=None) -> int:
 
             args = args + (jax.random.fold_in(
                 prng_key(program.random_seed or 0), 0),)
+        # The executor's entry is jitted with donate_argnums=(1,) (rw
+        # buffers update in place), and that input/output aliasing gets
+        # baked into the serialized executable.  jax's deserialized
+        # Compiled call path has none of the donation bookkeeping that
+        # marks consumed arrays deleted, so a donating bundle returns
+        # state arrays aliasing freed buffers — serving reads then race
+        # the allocator (nondeterministic corruption under load).
+        # Bundles therefore serialize a donation-FREE recompile; rw
+        # state on inference programs is tiny (quant scalars, BN stats),
+        # so the per-call copy is noise.
+        entry_src = getattr(entry.fn, "__wrapped__", None)
+        if entry_src is None:
+            raise RuntimeError(
+                "export_aot_bundle: executor entry is not a jitted "
+                "function; cannot build a donation-free executable")
         payload, in_tree, out_tree = se.serialize(
-            entry.fn.lower(*args).compile())
+            jax.jit(entry_src).lower(*args).compile())
         # the bundle stores only counts; verify the rebuilt trees match
         # the real ones so a convention drift fails at EXPORT, not serve
         want_in, want_out = _aot_trees(
@@ -223,6 +297,7 @@ def export_aot_bundle(dirname, feed_examples, place=None) -> int:
                 "export_aot_bundle: executable pytree structure diverged "
                 "from the executor calling convention — update _aot_trees")
         manifest = {
+            "aot_version": AOT_VERSION,
             "signature": _feed_signature(feed_names, feed),
             "feed_names": feed_names,
             "rw_state": entry.rw_state,
@@ -263,7 +338,17 @@ class Predictor:
     use_aot defaults to FALSE: bundle deserialization runs jax's
     serialize_executable unpickler over the payload, so a bundle must be
     treated like a pickle file — opt in only for model directories you
-    trust (ones your own pipeline exported)."""
+    trust (ones your own pipeline exported).
+
+    run() is THREAD-SAFE: the per-signature compile cache is guarded by
+    per-key locks in the Executor (N concurrent callers x M signatures
+    compile exactly M executables), stateless executables run fully
+    concurrently, and stateful ones (scope write-backs, e.g. unfolded BN
+    pass-through) serialize on the executor's ONE stateful-run lock —
+    every feed signature (and every AOT bundle) donates the same scope
+    arrays, so per-entry locking would race a use-after-donate.
+    Required by the serving tier's dynamic batcher, whose scheduler
+    threads drain into this cache."""
 
     def __init__(
         self,
@@ -316,6 +401,12 @@ class Predictor:
                     raise RuntimeError(
                         f"bundle platform {bundle['platform']} != runtime "
                         f"{jax.default_backend()}")
+                if bundle.get("aot_version", 1) != AOT_VERSION:
+                    raise RuntimeError(
+                        f"bundle version {bundle.get('aot_version', 1)} != "
+                        f"{AOT_VERSION} (v1 bundles donate buffers, which "
+                        "corrupts state through jax's deserialized call "
+                        "path — re-export with export_aot_bundle)")
                 with open(path[:-5] + ".xla", "rb") as f:
                     payload = f.read()
                 in_tree, out_tree = _aot_trees(
@@ -329,12 +420,33 @@ class Predictor:
                     payload, in_tree, out_tree,
                     n_devices=bundle.get("n_devices", 1))
                 bundle["loaded"] = loaded
+                # stateful bundles (scope write-backs) serialize on the
+                # EXECUTOR's one stateful-run lock — the same scope
+                # state backs every bundle signature AND the JIT
+                # entries, so a per-bundle lock would let two
+                # signatures interleave their write-backs; stateless
+                # bundles run concurrently from serving threads
+                bundle["run_lock"] = (self._exe._stateful_lock
+                                      if bundle["state_writes"] else None)
                 sig = tuple((n, tuple(shape), dt)
                             for n, shape, dt in bundle["signature"])
                 self._aot[sig] = bundle
             except Exception as e:  # noqa: BLE001 — any mismatch: retrace
+                from . import monitor
                 from .log import vlog
 
+                # degrade, never fail the model load: the JIT path serves
+                # every signature the bundle would have; the NAMED counter
+                # + flight event make the silent-retrace cause visible on
+                # /metrics and /flight (serving satellite: a corrupted
+                # sig_*.xla must not take the model down)
+                if monitor.enabled():
+                    monitor.counter("inference.aot_bundle_errors").inc()
+                    from .monitor import flight as _mflight
+
+                    _mflight.record(
+                        "inference.aot_bundle_error", path=path,
+                        error=f"{type(e).__name__}: {str(e)[:200]}")
                 vlog(1, f"Predictor: AOT bundle {path} unusable "
                         f"({type(e).__name__}: {e}); falling back to "
                         "retrace")
@@ -346,6 +458,33 @@ class Predictor:
     @property
     def fetch_names(self) -> List[str]:
         return list(self._fetch_names)
+
+    def feed_var_specs(self) -> Dict[str, tuple]:
+        """{feed name: (declared shape tuple, dtype str)} from the loaded
+        program — the leading batch dim is -1 for data-layer feeds.  The
+        serving tier derives warmup shapes for its bucket ladder from
+        this (serving/model.py)."""
+        block = self._program.global_block()
+        specs = {}
+        for n in self._feed_names:
+            v = block._find_var_recursive(n)
+            specs[n] = (tuple(v.shape) if v is not None else None,
+                        str(v.dtype) if v is not None else "float32")
+        return specs
+
+    def fetch_var_specs(self) -> List[tuple]:
+        """[(fetch name, declared shape tuple or None, dtype str)] in
+        fetch order — a leading -1 marks a batch-dependent output.  The
+        serving batcher uses this to decide which outputs to slice back
+        per coalesced request (serving/batcher.py)."""
+        specs = []
+        for v in self._fetch_vars:
+            try:
+                shape = tuple(v.shape)
+            except (AttributeError, TypeError):
+                shape = None
+            specs.append((v.name, shape, str(getattr(v, "dtype", "float32"))))
+        return specs
 
     @property
     def program(self) -> fw.Program:
@@ -360,24 +499,28 @@ class Predictor:
         return list(self._aot)
 
     def _run_aot(self, bundle, feed, return_numpy):
+        import contextlib
+
         import jax
 
         feed_names = bundle["feed_names"]
         feed_vals = [self._exe._to_device_array(self._program, n, feed[n])
                      for n in feed_names]
-        rw_vals = [self._scope.find_var(n) for n in bundle["rw_state"]]
-        ro_vals = [self._scope.find_var(n) for n in bundle["ro_state"]]
-        args = (feed_vals, rw_vals, ro_vals)
-        if bundle["needs_key"]:
-            from .core.executor import prng_key
+        lock = bundle.get("run_lock")
+        with lock if lock is not None else contextlib.nullcontext():
+            rw_vals = [self._scope.find_var(n) for n in bundle["rw_state"]]
+            ro_vals = [self._scope.find_var(n)
+                       for n in bundle["ro_state"]]
+            args = (feed_vals, rw_vals, ro_vals)
+            if bundle["needs_key"]:
+                from .core.executor import prng_key
 
-            self._exe._run_counter += 1
-            args = args + (jax.random.fold_in(
-                prng_key(self._program.random_seed or 0),
-                self._exe._run_counter),)
-        fetches, new_state = bundle["loaded"](*args)
-        for n, v in zip(bundle["state_writes"], new_state):
-            self._scope.set_var(n, v)
+                args = args + (jax.random.fold_in(
+                    prng_key(self._program.random_seed or 0),
+                    self._exe._next_run_id()),)
+            fetches, new_state = bundle["loaded"](*args)
+            for n, v in zip(bundle["state_writes"], new_state):
+                self._scope.set_var(n, v)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)

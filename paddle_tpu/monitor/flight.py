@@ -42,6 +42,32 @@ from typing import Dict, List, Optional
 
 from .registry import _json_safe, enabled
 
+# Thread-local execution-context tag: a subsystem driving the executor from
+# its own threads (the serving tier's dynamic batcher) wraps its calls in
+# `with context("serving/<model>")` and every flight event recorded inside
+# — executor compiles, RECOMPILE-CAUSE events, errors — carries a `ctx`
+# field naming the originator.  A retrace storm in /flight is then
+# attributable to the serving tier (vs. a training loop) without guessing.
+_context = threading.local()
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def context(tag: str):
+    """Tag every flight event recorded by this thread inside the block."""
+    prev = getattr(_context, "tag", None)
+    _context.tag = tag
+    try:
+        yield
+    finally:
+        _context.tag = prev
+
+
+def current_context() -> Optional[str]:
+    return getattr(_context, "tag", None)
+
 
 class FlightRecorder:
     """Thread-safe bounded ring of event dicts + JSONL dump."""
@@ -72,6 +98,9 @@ class FlightRecorder:
         those as chrome-trace slices; everything else is an instant."""
         ev = {"seq": next(self._seq), "ts": round(time.time(), 6),
               "kind": kind}
+        tag = getattr(_context, "tag", None)
+        if tag is not None and "ctx" not in fields:
+            ev["ctx"] = tag
         ev.update(fields)
         with self._lock:
             if len(self._ring) == self._ring.maxlen:

@@ -132,6 +132,26 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-resolution quantile estimate (the upper bound of the
+        bucket holding the q-th observation, Prometheus histogram_quantile
+        style).  Returns None with no observations; observations past the
+        top bucket return +Inf — widen the buckets if that matters."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return None
+        target = q * total
+        cum = 0
+        for le, c in zip(self.buckets + (float("inf"),), counts):
+            cum += c
+            if cum >= target:
+                return le
+        return float("inf")
+
     def snapshot(self) -> dict:
         with self._lock:
             counts = list(self._counts)

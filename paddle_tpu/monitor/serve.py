@@ -6,9 +6,12 @@ ROADMAP north star) needs scrape-based monitoring.  Three endpoints:
 
   * /metrics — Prometheus text exposition of the default registry
     (PR-1 counters/gauges/histograms; scrape-ready);
-  * /health  — JSON {status, last_step, last_loss, seconds_since_step};
-    returns 503 when a step monitor exists but nothing stepped for 10
-    minutes (a load balancer can evict a hung trainer);
+  * /health  — JSON {status, trainer, serving, ...}: TRAINER LIVENESS
+    (503 "stalled" when a step monitor exists but nothing stepped for
+    FLAGS.health_stall_s seconds — a load balancer can evict a hung
+    trainer; a process with zero steps is NOT stalled) and SERVING
+    READINESS (503 "not_ready" until a registered readiness provider —
+    the paddle_tpu/serving server — reports its models warmed);
   * /flight  — last-N flight-recorder events as JSONL (?n=100, ?kind=...).
 
 Start with `start(port)` (FLAGS.monitor_port; port 0 picks an ephemeral
@@ -31,11 +34,77 @@ from . import registry as _registry
 _server: Optional[ThreadingHTTPServer] = None
 _thread: Optional[threading.Thread] = None
 
-HEALTH_STALL_S = 600.0
+# Serving-readiness hook: the inference server (paddle_tpu/serving)
+# registers a zero-arg callable returning {"ready": bool, ...}; /health
+# then distinguishes TRAINER LIVENESS (steps flowing) from SERVING
+# READINESS (models loaded + warmed).  A pure inference process has no
+# steps, and zero steps is NOT a stall — only a step monitor that went
+# quiet for FLAGS.health_stall_s seconds is.
+_readiness_provider = None
 
 
-class _Handler(BaseHTTPRequestHandler):
+def set_readiness_provider(fn) -> None:
+    """Register (or clear, fn=None) the serving-readiness callable."""
+    global _readiness_provider
+    _readiness_provider = fn
+
+
+def health_body():
+    """The /health JSON + status code, shared by the monitor endpoint and
+    the inference server's own /health."""
+    import time
+
+    from ..flags import FLAGS
+
+    rec = _flight.default_recorder()
+    since = (time.time() - rec.last_step_ts
+             if rec.last_step_ts is not None else None)
+    # a process that never stepped (inference server, pre-first-step
+    # trainer) is not stalled — stall needs a step monitor that went quiet
+    stalled = since is not None and since > FLAGS.health_stall_s
+    trainer = None
+    if rec.last_step_ts is not None:
+        trainer = {
+            "alive": not stalled,
+            "last_step": rec.last_step,
+            "last_loss": rec.last_loss,
+            "seconds_since_step": round(since, 1),
+            "stall_after_s": FLAGS.health_stall_s,
+        }
+    serving = None
+    not_ready = False
+    if _readiness_provider is not None:
+        try:
+            serving = _readiness_provider()
+        except Exception as e:  # a probe must answer, whatever broke
+            serving = {"ready": False,
+                       "error": f"{type(e).__name__}: {e}"}
+        not_ready = not (serving or {}).get("ready", False)
+    status = ("stalled" if stalled
+              else "not_ready" if not_ready else "ok")
+    body = {
+        "status": status,
+        "monitor": _registry.enabled(),
+        "trainer": trainer,
+        "serving": serving,
+        # legacy top-level fields (pre-serving /health consumers)
+        "last_step": rec.last_step,
+        "last_loss": rec.last_loss,
+        "seconds_since_step":
+            round(since, 1) if since is not None else None,
+    }
+    return body, (503 if (stalled or not_ready) else 200)
+
+
+class MonitorHandler(BaseHTTPRequestHandler):
+    """/metrics /health /flight handler; the inference server's handler
+    (serving/server.py) subclasses this to add the /v1 model routes."""
+
     server_version = "paddle-tpu-monitor/1.0"
+    # keep-alive: every response sets Content-Length, so persistent
+    # connections are safe — a serving client pays the TCP+thread setup
+    # once per connection instead of once per request
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # quiet: route through vlog(2)
         from ..log import vlog
@@ -53,23 +122,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         try:
             url = urlparse(self.path)
-            if url.path in ("/metrics", "/"):
-                self._send(
-                    200, _registry.default_registry().prometheus_text())
-            elif url.path == "/health":
-                self._health()
-            elif url.path == "/flight":
-                q = parse_qs(url.query)
-                n = int(q.get("n", ["100"])[0])
-                kind = q.get("kind", [None])[0]
-                rec = _flight.default_recorder()
-                lines = [json.dumps(_registry._json_safe(
-                    rec.header("serve")))]
-                lines += [json.dumps(_registry._json_safe(e))
-                          for e in rec.events(n=n, kind=kind)]
-                self._send(200, "\n".join(lines) + "\n",
-                           "application/jsonl")
-            else:
+            if not self._route_get(url):
                 self._send(404, "not found: try /metrics /health /flight\n")
         except Exception as e:  # serving must not kill the run
             try:
@@ -77,24 +130,36 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
 
-    def _health(self):
-        import time
+    def _route_get(self, url) -> bool:
+        """Dispatch one GET; returns False for unknown paths (subclasses
+        try their own routes first, then fall back here)."""
+        if url.path in ("/metrics", "/"):
+            self._send(
+                200, _registry.default_registry().prometheus_text())
+        elif url.path == "/health":
+            self._health()
+        elif url.path == "/flight":
+            q = parse_qs(url.query)
+            n = int(q.get("n", ["100"])[0])
+            kind = q.get("kind", [None])[0]
+            rec = _flight.default_recorder()
+            lines = [json.dumps(_registry._json_safe(
+                rec.header("serve")))]
+            lines += [json.dumps(_registry._json_safe(e))
+                      for e in rec.events(n=n, kind=kind)]
+            self._send(200, "\n".join(lines) + "\n",
+                       "application/jsonl")
+        else:
+            return False
+        return True
 
-        rec = _flight.default_recorder()
-        since = (time.time() - rec.last_step_ts
-                 if rec.last_step_ts is not None else None)
-        stalled = since is not None and since > HEALTH_STALL_S
-        body = {
-            "status": "stalled" if stalled else "ok",
-            "monitor": _registry.enabled(),
-            "last_step": rec.last_step,
-            "last_loss": rec.last_loss,
-            "seconds_since_step":
-                round(since, 1) if since is not None else None,
-        }
-        self._send(503 if stalled else 200,
-                   json.dumps(_registry._json_safe(body)) + "\n",
+    def _health(self):
+        body, code = health_body()
+        self._send(code, json.dumps(_registry._json_safe(body)) + "\n",
                    "application/json")
+
+
+_Handler = MonitorHandler  # pre-serving-tier name
 
 
 def start(port: Optional[int] = None,

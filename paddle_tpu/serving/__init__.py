@@ -1,0 +1,30 @@
+"""Production serving tier: multi-model inference server with dynamic
+batching on the AOT-bundle path (ROADMAP item 1 — the reference's
+out-of-Python serving property, api/paddle_api.h:153, grown into the
+"heavy traffic" story).
+
+Three layers:
+
+  * `model.py`   — ServingModel: a Predictor (+ optional int8 replica via
+    contrib.quantize.freeze_int8) with a pad-to-bucket batch ladder,
+    startup warmup, and serving-tier recompile tagging.
+  * `batcher.py` — DynamicBatcher: per-model request queue drained by a
+    scheduler thread that coalesces concurrent requests into bucket
+    shapes (max-wait deadline, max-batch cap), so every executed batch
+    hits a warm entry in the executor's compile cache.
+  * `server.py`  — InferenceServer: stdlib-HTTP multi-model endpoint
+    (JSON + npz), /v1/models introspection, /metrics //health //flight
+    inherited from the monitor stack, persistent XLA compilation cache.
+
+CLI: `python -m paddle_tpu.serving --model name=/path/to/export ...`
+Load test: `python tools/loadgen.py --url http://host:port --model name`.
+"""
+
+from .batcher import DynamicBatcher, FILL_BUCKETS  # noqa: F401
+from .model import ModelConfig, ServingModel, parse_buckets  # noqa: F401
+from .server import (  # noqa: F401
+    InferenceServer,
+    RequestError,
+    ServingHandler,
+    enable_compilation_cache,
+)

@@ -1,0 +1,102 @@
+"""CLI: `python -m paddle_tpu.serving --model name=/path/to/export ...`
+
+Boots an InferenceServer, warms every model's bucket ladder, prints ONE
+machine-readable ready line to stdout —
+
+    {"event": "serving_ready", "port": N, "models": [...]}
+
+— then serves until SIGTERM/SIGINT (the CI gate and subprocess tests
+parse the ready line for the ephemeral port).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving",
+        description="multi-model inference server with dynamic batching")
+    p.add_argument("--model", action="append", default=[],
+                   metavar="NAME=DIR", required=True,
+                   help="serve the exported model at DIR as NAME "
+                        "(repeatable)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 picks an ephemeral port (printed in the ready "
+                        "line)")
+    p.add_argument("--buckets", default=None,
+                   help="pad-to-bucket ladder, e.g. 1,2,4,8,16 "
+                        "(default FLAGS_serving_buckets)")
+    p.add_argument("--max-batch", type=int, default=None)
+    p.add_argument("--max-wait-ms", type=float, default=None)
+    p.add_argument("--use-aot", action="store_true",
+                   help="load serialized AOT executable bundles — TRUSTED "
+                        "artifacts only (pickle-based deserialization)")
+    p.add_argument("--int8", action="append", default=[], metavar="NAME",
+                   help="also serve an int8 replica of NAME (QAT-exported "
+                        "models; selectable per request via precision)")
+    p.add_argument("--no-optimize", action="store_true",
+                   help="skip the BN-fold inference pass")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip bucket-ladder pre-compilation (first "
+                        "requests then pay the compiles)")
+    p.add_argument("--cache-dir", default=None,
+                   help="persistent XLA compilation cache dir "
+                        "(default FLAGS_serving_cache_dir)")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.serving import InferenceServer, ModelConfig
+
+    if args.cache_dir is not None:
+        FLAGS.serving_cache_dir = args.cache_dir
+
+    int8_names = set(args.int8)
+    configs = []
+    for spec in args.model:
+        name, sep, dirname = spec.partition("=")
+        if not sep or not name or not dirname:
+            p.error(f"--model expects NAME=DIR, got {spec!r}")
+        configs.append(ModelConfig(
+            name=name, dirname=dirname, use_aot=args.use_aot,
+            optimize=not args.no_optimize, int8=name in int8_names,
+            buckets=args.buckets, max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms))
+    unknown = int8_names - {c.name for c in configs}
+    if unknown:
+        p.error(f"--int8 names not among --model entries: {sorted(unknown)}")
+
+    server = InferenceServer(configs, host=args.host, port=args.port)
+    server.start(warmup=not args.no_warmup)
+    print(json.dumps({
+        "event": "serving_ready",
+        "port": server.port,
+        "host": args.host,
+        "models": server.model_names,
+    }), flush=True)
+
+    done = threading.Event()
+
+    def _shutdown(signum, frame):
+        done.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _shutdown)
+        except (ValueError, OSError):
+            pass
+    try:
+        done.wait()
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

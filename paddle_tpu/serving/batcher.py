@@ -1,0 +1,303 @@
+"""DynamicBatcher: per-model request queue drained by a scheduler thread
+that coalesces concurrent requests into pad-to-bucket batch shapes.
+
+The serving tier's core loop (continuous/dynamic batching — Orca OSDI'22,
+Clipper NSDI'17 adaptive batching — mapped onto the executor's
+per-feed-signature compile cache):
+
+  * callers (HTTP handler threads) `submit()` a feed and block on an
+    event; the scheduler thread takes the oldest request and keeps
+    collecting compatible ones (same item signature + precision) until
+    the batch is full or the first request's max-wait deadline passes;
+  * the coalesced rows are padded UP to the model's bucket ladder, so
+    every executed batch hits a warm compiled signature (pad rows repeat
+    the last row and are sliced off the outputs);
+  * incompatible requests spill to the front of the queue for the next
+    round — one ragged stream never head-of-line-blocks another shape.
+
+Policy knobs (per model, flag defaults): bucket ladder, max_batch rows,
+max_wait deadline.  Observability: queue-latency + batch-fill histograms,
+per-model in-flight gauge and request/row counters, all in the PR-1
+registry.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .model import ServingModel, item_signature
+
+# batch-fill is a fraction of the executed bucket: fixed 0..1 ladder
+FILL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+_STOP = object()
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "sig", "precision", "t_enqueue",
+                 "event", "outputs", "meta", "error")
+
+    def __init__(self, feed, rows, sig, precision):
+        self.feed = feed
+        self.rows = rows
+        self.sig = sig
+        self.precision = precision
+        self.t_enqueue = time.perf_counter()
+        self.event = threading.Event()
+        self.outputs = None
+        self.meta = None
+        self.error = None
+
+
+class DynamicBatcher:
+    """One scheduler thread + queue per served model."""
+
+    def __init__(self, model: ServingModel,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None):
+        self.model = model
+        mb = max_batch if max_batch is not None else model.config.max_batch
+        # never coalesce past the ladder: a batch bigger than the largest
+        # bucket cannot pad DOWN and would compile a fresh signature
+        self.max_batch = max(1, min(int(mb), model.buckets[-1]))
+        wait = (max_wait_ms if max_wait_ms is not None
+                else model.config.max_wait_ms)
+        self.max_wait_s = max(0.0, float(wait) / 1000.0)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._spill: "collections.deque" = collections.deque()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serving-batcher-{self.model.name}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if not self._running:
+            return
+        self._running = False
+        self._queue.put(_STOP)
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    # -- client side -----------------------------------------------------
+    def submit(self, feed: Dict[str, np.ndarray],
+               precision: str = "fp32", timeout: float = 30.0):
+        """Block until the batch containing this request executes; returns
+        (outputs list parallel to fetch_names, batch meta dict)."""
+        from .. import monitor
+
+        self.model.predictor(precision)  # validate precision early
+        missing = [n for n in self.model.feed_names if n not in feed]
+        if missing:
+            raise KeyError(
+                f"model {self.model.name!r}: missing feeds {missing}")
+        feed = {n: np.asarray(feed[n]) for n in self.model.feed_names}
+        scalars = [n for n, a in feed.items() if not np.asarray(a).ndim]
+        if scalars:
+            # 0-d arrays carry no batch dim: item_signature (shape[1:])
+            # would coalesce them with 1-d requests and the concatenate/
+            # pad path would crash the whole batch
+            raise ValueError(
+                f"model {self.model.name!r}: feeds {scalars} are 0-d — "
+                "serving feeds need a leading batch dim (send [[v]], "
+                "not v)")
+        rows = {int(a.shape[0]) for a in feed.values()}
+        if len(rows) != 1:
+            raise ValueError(
+                f"model {self.model.name!r}: feed arrays disagree on the "
+                f"leading batch dim ({sorted(rows)})")
+        (n_rows,) = rows
+        if n_rows == 0:
+            raise ValueError("empty batch (0 rows)")
+        req = _Request(feed, n_rows, item_signature(feed), precision)
+
+        mon = monitor.enabled()
+        inflight = (monitor.gauge(f"serving.{self.model.name}.inflight")
+                    if mon else None)
+        t0 = time.perf_counter()
+        if inflight is not None:
+            inflight.inc()
+        try:
+            self._queue.put(req)
+            if not req.event.wait(timeout):
+                req.error = TimeoutError(
+                    f"request not served within {timeout}s "
+                    f"(model {self.model.name!r})")
+                if mon:
+                    monitor.counter(
+                        f"serving.{self.model.name}.timeouts").inc()
+                raise req.error
+        finally:
+            if inflight is not None:
+                inflight.dec()
+        if req.error is not None:
+            if mon:
+                monitor.counter(
+                    f"serving.{self.model.name}.request_errors").inc()
+            raise req.error
+        if mon:
+            dt = time.perf_counter() - t0
+            monitor.counter(f"serving.{self.model.name}.requests").inc()
+            monitor.counter("serving.requests").inc()
+            monitor.counter(f"serving.{self.model.name}.rows").inc(n_rows)
+            monitor.histogram(
+                f"serving.{self.model.name}.request_seconds").observe(dt)
+            monitor.histogram("serving.request_seconds").observe(dt)
+        return req.outputs, req.meta
+
+    # -- scheduler side --------------------------------------------------
+    def _take(self, timeout: float):
+        """Next pending request: spilled (incompatible last round) first,
+        then the shared queue.  timeout <= 0 means poll (non-blocking)."""
+        if self._spill:
+            return self._spill.popleft()
+        try:
+            if timeout <= 0:
+                return self._queue.get_nowait()
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _loop(self) -> None:
+        while self._running:
+            first = self._take(0.1)
+            if first is None:
+                continue
+            if first is _STOP:
+                break
+            group = [first]
+            rows = first.rows
+            # the max-wait deadline bounds a request's QUEUE time; under
+            # saturation it is often already past when the scheduler gets
+            # here (the request aged while the previous batch executed) —
+            # so pending requests always drain for free (poll), and the
+            # scheduler only BLOCKS for stragglers while under deadline
+            # with an unfilled batch
+            deadline = first.t_enqueue + self.max_wait_s
+            defer = []
+            while rows < self.max_batch:
+                nxt = self._take(0.0)
+                if nxt is None:
+                    rem = deadline - time.perf_counter()
+                    if rem <= 0:
+                        break
+                    nxt = self._take(rem)
+                    if nxt is None:
+                        break
+                if nxt is _STOP:
+                    self._running = False
+                    break
+                if (nxt.precision == first.precision
+                        and nxt.sig == first.sig
+                        and rows + nxt.rows <= self.max_batch):
+                    group.append(nxt)
+                    rows += nxt.rows
+                else:
+                    defer.append(nxt)
+            # deferred requests lead the next round, in arrival order
+            self._spill.extendleft(reversed(defer))
+            self._execute(group, rows)
+        # drain: fail whatever is still queued so no caller hangs
+        leftovers = list(self._spill)
+        self._spill.clear()
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if r is not _STOP:
+                leftovers.append(r)
+        for r in leftovers:
+            r.error = RuntimeError(
+                f"serving batcher for {self.model.name!r} stopped")
+            r.event.set()
+
+    def _execute(self, group, rows: int) -> None:
+        from .. import monitor
+
+        model = self.model
+        mon = monitor.enabled()
+        t_start = time.perf_counter()
+        if mon:
+            qh = monitor.histogram(
+                f"serving.{model.name}.queue_seconds")
+            for r in group:
+                qh.observe(t_start - r.t_enqueue)
+        bucket = model.bucket_for(rows)
+        if bucket is None:
+            # oversize: runs at its exact shape (fresh signature) — named
+            # counter + the run_batch flight tag make the ladder gap loud
+            bucket = rows
+            if mon:
+                monitor.counter(
+                    f"serving.{model.name}.oversize_batches").inc()
+        feed = {
+            n: (np.concatenate([r.feed[n] for r in group], axis=0)
+                if len(group) > 1 else group[0].feed[n])
+            for n in model.feed_names
+        }
+        feed = model.pad_feed(feed, rows, bucket)
+        try:
+            outs = model.run_batch(group[0].precision, feed, rows, bucket,
+                                   group[0].sig)
+        except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+            for r in group:
+                r.error = e
+                r.event.set()
+            if mon:
+                monitor.counter(f"serving.{model.name}.batch_errors").inc()
+            return
+        if mon:
+            monitor.counter(f"serving.{model.name}.batches").inc()
+            monitor.counter(f"serving.{model.name}.padded_rows").inc(
+                bucket - rows)
+            monitor.histogram(f"serving.{model.name}.batch_fill",
+                              buckets=FILL_BUCKETS).observe(rows / bucket)
+            monitor.histogram("serving.batch_fill",
+                              buckets=FILL_BUCKETS).observe(rows / bucket)
+        exec_ms = round((time.perf_counter() - t_start) * 1e3, 3)
+        batched_flags = model.fetch_batched
+        offset = 0
+        for r in group:
+            sliced = []
+            for j, o in enumerate(outs):
+                arr = np.asarray(o)
+                is_batched = (batched_flags[j]
+                              if j < len(batched_flags) else None)
+                if is_batched is None:
+                    # unknown declared shape: fall back to the shape
+                    # heuristic (can't distinguish a fixed leading dim
+                    # that happens to equal the bucket)
+                    is_batched = bool(arr.ndim) and arr.shape[0] == bucket
+                if is_batched and arr.ndim and arr.shape[0] == bucket:
+                    sliced.append(arr[offset:offset + r.rows])
+                else:
+                    # non-batched fetch (reduced scalar / fixed-dim
+                    # output): every request gets the whole value
+                    sliced.append(arr)
+            r.outputs = sliced
+            r.meta = {
+                "bucket": bucket,
+                "batch_rows": rows,
+                "request_rows": r.rows,
+                "coalesced": len(group),
+                "precision": r.precision,
+                "queue_ms": round((t_start - r.t_enqueue) * 1e3, 3),
+                "exec_ms": exec_ms,
+            }
+            offset += r.rows
+            r.event.set()

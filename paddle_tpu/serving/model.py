@@ -1,0 +1,302 @@
+"""ServingModel: one exported model directory made servable.
+
+Wraps a Predictor (inference.py) with everything the dynamic batcher
+needs to keep every executed batch on a WARM entry of the executor's
+per-feed-signature compile cache:
+
+  * a pad-to-bucket batch-size ladder (requests coalesce and pad up to
+    the smallest bucket >= total rows, so an unbounded stream of request
+    shapes maps onto a BOUNDED set of compiled signatures);
+  * warmup: pre-compile (or AOT-load) every bucket signature at startup,
+    so no production request ever pays a compile;
+  * optional int8 replica via the existing contrib.quantize.freeze_int8
+    path (QAT-exported models only), selectable per request;
+  * a serving-tier recompile-cause tag: any compile that happens while
+    serving a batch is flight-recorded with the REQUESTED vs BUCKETED
+    feed signature, so an undersized bucket ladder is diagnosable from
+    /flight instead of showing up as silent retrace stalls.
+
+Reference role: the multi-model half of the reference's C++ serving
+story (api/paddle_api.h:153 — one PaddlePredictor per model, load once /
+serve many); the bucket ladder is the adaptive-batching idea of
+Clipper (NSDI'17) mapped onto XLA's compile-per-signature reality.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..inference import Predictor
+
+
+def parse_buckets(spec) -> Tuple[int, ...]:
+    """"1,2,4,8" / [1, 2, 4, 8] -> sorted, deduped, validated tuple."""
+    if isinstance(spec, str):
+        parts = [p.strip() for p in spec.split(",") if p.strip()]
+        vals = [int(p) for p in parts]
+    else:
+        vals = [int(v) for v in spec]
+    if not vals or any(v <= 0 for v in vals):
+        raise ValueError(f"bucket ladder must be positive ints, got {spec!r}")
+    return tuple(sorted(set(vals)))
+
+
+class ModelConfig:
+    """Per-model serving policy (CLI flags / server API both build this)."""
+
+    __slots__ = ("name", "dirname", "use_aot", "optimize", "int8",
+                 "buckets", "max_batch", "max_wait_ms", "warmup_shapes")
+
+    def __init__(self, name: str, dirname: str, use_aot: bool = False,
+                 optimize: bool = True, int8: bool = False,
+                 buckets=None, max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 warmup_shapes: Optional[Dict[str, tuple]] = None):
+        from ..flags import FLAGS
+
+        if not name or "/" in name or ":" in name:
+            raise ValueError(f"model name {name!r} must be URL-path safe")
+        self.name = name
+        self.dirname = dirname
+        # AOT bundles deserialize via jax's pickle-based executable
+        # loader: opt-in per model, trusted artifacts only (the PR-1
+        # posture — same default as Predictor)
+        self.use_aot = use_aot
+        self.optimize = optimize
+        self.int8 = int8
+        self.buckets = parse_buckets(
+            buckets if buckets is not None else FLAGS.serving_buckets)
+        self.max_batch = (int(max_batch) if max_batch is not None
+                          else FLAGS.serving_max_batch)
+        self.max_wait_ms = (float(max_wait_ms) if max_wait_ms is not None
+                            else FLAGS.serving_max_wait_ms)
+        # override for feed dims the saved program declares as -1 beyond
+        # the leading batch dim (warmup can't guess those)
+        self.warmup_shapes = dict(warmup_shapes or {})
+
+
+def item_signature(feed: Dict[str, np.ndarray]) -> tuple:
+    """Per-request shape identity MINUS the batch dim: requests with the
+    same item signature coalesce into one padded batch."""
+    return tuple(
+        (n, tuple(np.asarray(feed[n]).shape[1:]),
+         str(np.asarray(feed[n]).dtype))
+        for n in sorted(feed)
+    )
+
+
+class ServingModel:
+    """One model directory, loaded once, servable at one or more
+    precisions ("fp32" always; "int8" when the artifact was QAT-exported
+    and the config asks for a replica)."""
+
+    def __init__(self, config: ModelConfig):
+        self.config = config
+        self.name = config.name
+        self.buckets = config.buckets
+        self.ready = False
+        self._warm_sigs: set = set()
+        # one predictor per precision replica, each with a private scope
+        self._predictors: Dict[str, Predictor] = {
+            "fp32": Predictor(config.dirname, optimize=config.optimize,
+                              use_aot=config.use_aot)
+        }
+        if config.int8:
+            self._predictors["int8"] = self._build_int8_replica()
+        # the loaded program never changes: compute the feed/fetch specs
+        # once instead of re-walking the program block per request
+        self.feed_specs = self._predictors["fp32"].feed_var_specs()
+        # per-fetch batch-dim flags (declared leading -1 = batch-sized,
+        # slice per request; fixed leading dim = whole value per request;
+        # None = unknown shape, the batcher falls back to its heuristic)
+        self.fetch_batched = [
+            None if shape is None
+            else bool(shape) and int(shape[0]) < 0
+            for (_n, shape, _d) in
+            self._predictors["fp32"].fetch_var_specs()
+        ]
+
+    # -- replicas --------------------------------------------------------
+    def _build_int8_replica(self) -> Predictor:
+        """Freeze a second Predictor of the same artifact to int8 via the
+        existing contrib.quantize.freeze_int8 path (int8 weights in its
+        private scope, int8_mul/int8_conv2d consumers, runtime activation
+        quantize against the trained moving-average scales)."""
+        from ..contrib.quantize import count_fake_quant_ops, freeze_int8
+
+        pred = Predictor(self.config.dirname, optimize=False,
+                         use_aot=False)
+        if count_fake_quant_ops(pred._program) == 0:
+            raise ValueError(
+                f"model {self.name!r}: int8 replica requested but the "
+                "artifact carries no fake_quantize ops — export it from a "
+                "QAT program (contrib.quantize.QuantizeTranspiler."
+                "training_transpile before save_inference_model)")
+        n = freeze_int8(pred._program, pred._scope)
+        from ..log import vlog
+
+        vlog(1, "serving: model %s int8 replica frozen (%d consumers)",
+             self.name, n)
+        return pred
+
+    @property
+    def precisions(self) -> List[str]:
+        return sorted(self._predictors)
+
+    def predictor(self, precision: str = "fp32") -> Predictor:
+        p = self._predictors.get(precision)
+        if p is None:
+            raise KeyError(
+                f"model {self.name!r} has no {precision!r} replica "
+                f"(available: {self.precisions})")
+        return p
+
+    @property
+    def feed_names(self) -> List[str]:
+        return self._predictors["fp32"].feed_names
+
+    @property
+    def fetch_names(self) -> List[str]:
+        return self._predictors["fp32"].fetch_names
+
+    # -- bucket ladder ---------------------------------------------------
+    def bucket_for(self, rows: int) -> Optional[int]:
+        """Smallest bucket >= rows; None when rows exceed the ladder
+        (the batch then runs at its exact size — counted, flight-tagged,
+        and visible as an unplanned compile)."""
+        for b in self.buckets:
+            if b >= rows:
+                return b
+        return None
+
+    @staticmethod
+    def pad_feed(feed: Dict[str, np.ndarray], rows: int,
+                 target: int) -> Dict[str, np.ndarray]:
+        """Pad every feed array's leading dim from `rows` to `target` by
+        repeating the last row (real-data values keep every op's numerics
+        in-distribution; the pad rows are sliced off the outputs)."""
+        if target == rows:
+            return feed
+        out = {}
+        for n, a in feed.items():
+            a = np.asarray(a)
+            pad = np.repeat(a[-1:], target - rows, axis=0)
+            out[n] = np.concatenate([a, pad], axis=0)
+        return out
+
+    # -- warmup ----------------------------------------------------------
+    def _warmup_feed(self, precision: str, batch: int):
+        """Synthesize one feed dict of `batch` rows from the program's
+        declared feed shapes (leading -1 := batch); returns None when a
+        non-leading dim is unknown and no warmup_shapes override names it
+        (that feed signature then compiles on first live request)."""
+        specs = self.feed_specs
+        feed = {}
+        for n, (shape, dtype) in specs.items():
+            item = self.config.warmup_shapes.get(n)
+            if item is None:
+                if shape is None:
+                    return None
+                item = shape[1:]
+            if any(d is None or int(d) < 0 for d in item):
+                return None
+            feed[n] = np.zeros((batch,) + tuple(int(d) for d in item),
+                               dtype=np.dtype(dtype) if dtype != "bfloat16"
+                               else np.float32)
+        return feed
+
+    def warmup(self) -> int:
+        """Pre-compile (or AOT-serve) every (precision, bucket) signature
+        so production traffic never pays a trace.  Returns how many
+        signatures were warmed; flips `ready` (the /health readiness
+        signal) even on partial warmup — remaining signatures compile on
+        first request and are counted as unplanned."""
+        from .. import monitor
+
+        warmed = 0
+        for precision in self.precisions:
+            pred = self.predictor(precision)
+            for b in self.buckets:
+                feed = self._warmup_feed(precision, b)
+                if feed is None:
+                    if monitor.enabled():
+                        monitor.counter(
+                            f"serving.{self.name}.warmup_skipped").inc()
+                    continue
+                pred.run(feed)
+                self._warm_sigs.add((precision, item_signature(feed), b))
+                warmed += 1
+        if monitor.enabled():
+            monitor.counter(f"serving.{self.name}.warmup_signatures").inc(
+                warmed)
+        self.ready = True
+        return warmed
+
+    # -- execution -------------------------------------------------------
+    def run_batch(self, precision: str, feed: Dict[str, np.ndarray],
+                  rows: int, bucket: int, requested_sig: tuple):
+        """Run one coalesced/padded batch; any compile-cache miss taken
+        HERE is a serving-tier recompile and is flight-tagged with the
+        requested vs bucketed signature (satellite: undersized ladders
+        must be diagnosable from /flight, not silent retrace stalls)."""
+        from .. import monitor
+        from ..monitor import flight
+
+        pred = self.predictor(precision)
+        before = pred.compile_count
+        with flight.context(f"serving/{self.name}"):
+            outs = pred.run(feed)
+            if pred.compile_count > before:
+                bucketed_sig = item_signature(feed)
+                after_warmup = self.ready
+                flight.record(
+                    "serving.compile", model=self.name, precision=precision,
+                    requested_rows=rows, bucketed_rows=bucket,
+                    requested_signature=[[n, list(s), d]
+                                         for n, s, d in requested_sig],
+                    bucketed_signature=[[n, list(s), d]
+                                        for n, s, d in bucketed_sig],
+                    after_warmup=after_warmup)
+                if after_warmup and monitor.enabled():
+                    monitor.counter("serving.unplanned_compiles").inc()
+                    monitor.counter(
+                        f"serving.{self.name}.unplanned_compiles").inc()
+        return outs
+
+    # -- introspection ---------------------------------------------------
+    def info(self) -> dict:
+        """/v1/models payload for this model."""
+        from .. import monitor
+
+        fp32 = self._predictors["fp32"]
+        reg = monitor.default_registry()
+        lat = reg.get(f"serving.{self.name}.request_seconds")
+        req = reg.get(f"serving.{self.name}.requests")
+        info = {
+            "name": self.name,
+            "ready": self.ready,
+            "precisions": self.precisions,
+            "buckets": list(self.buckets),
+            "max_batch": self.config.max_batch,
+            "max_wait_ms": self.config.max_wait_ms,
+            "feeds": {
+                n: {"shape": list(s) if s else None, "dtype": d}
+                for n, (s, d) in self.feed_specs.items()
+            },
+            "fetches": fp32.fetch_names,
+            "use_aot": self.config.use_aot,
+            "aot_signatures": len(fp32.aot_signatures),
+            "warm_signatures": len(self._warm_sigs),
+            "compiled_signatures": {
+                p: self._predictors[p].compile_count
+                for p in self.precisions
+            },
+            "requests": req.value if req is not None else 0,
+        }
+        if lat is not None and lat.count:
+            info["latency_s"] = {"p50": lat.quantile(0.5),
+                                 "p99": lat.quantile(0.99),
+                                 "count": lat.count}
+        return info

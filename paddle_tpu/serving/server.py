@@ -1,0 +1,387 @@
+"""InferenceServer: multi-model HTTP inference on the stdlib HTTP stack.
+
+The production serving tier the ROADMAP north star asks for (the
+reference's out-of-Python serving property, api/paddle_api.h:153, scaled
+to many models + concurrent clients): load one or more exported model
+dirs (AOT bundles opt-in for trusted artifacts), accept concurrent
+JSON / npz requests, and drain them through per-model dynamic batchers
+so every executed batch lands on a warm compiled signature.
+
+Endpoints (handler subclasses monitor/serve.py's MonitorHandler, so the
+observability routes come for free):
+
+  * POST /v1/models/<name>:predict   (also .../predict) — run inference;
+      JSON body  {"inputs": {feed: nested-list | {"b64","dtype","shape"}},
+                  "precision": "fp32"|"int8"}  ->
+                 {"outputs": {fetch: nested-list}, "batch": {...}}
+      npz body   (Content-Type: application/x-npz, arrays keyed by feed
+                 name; add ?format=npz for an npz response) — the binary
+                 path for large tensors, np.load(allow_pickle=False).
+  * GET  /v1/models            — model list w/ readiness, buckets, stats
+  * GET  /v1/models/<name>     — one model's info
+  * GET  /metrics /health /flight — inherited; /health reports serving
+      READINESS (distinct from trainer liveness) via the registered
+      readiness provider.
+
+Startup: `InferenceServer([...ModelConfig...]).start()` enables
+telemetry, arms the persistent XLA compilation cache
+(FLAGS.serving_cache_dir — warmup compiles survive restarts), starts the
+batcher threads + HTTP listener, then warms every model's bucket ladder.
+"""
+
+from __future__ import annotations
+
+import base64
+import io as _io
+import json
+import threading
+from http.server import ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ..monitor import serve as mserve
+from ..monitor.registry import _json_safe
+from .batcher import DynamicBatcher
+from .model import ModelConfig, ServingModel
+
+
+class RequestError(Exception):
+    """Client-side error -> HTTP 4xx with a JSON body."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _decode_inputs(body: bytes, ctype: str, specs) -> tuple:
+    """Request body -> (feed dict, options dict).  JSON (nested lists or
+    b64 raw buffers) and npz (allow_pickle=False) are supported; values
+    are cast to the program's declared feed dtypes."""
+    if "json" in ctype or ctype.startswith("text/plain"):
+        try:
+            payload = json.loads(body.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise RequestError(400, f"malformed JSON body: {e}")
+        if not isinstance(payload, dict) or "inputs" not in payload:
+            raise RequestError(400, 'JSON body must carry an "inputs" map')
+        raw = payload["inputs"]
+        if not isinstance(raw, dict):
+            raise RequestError(400, '"inputs" must map feed name -> value')
+        feed = {}
+        for n, v in raw.items():
+            dtype = np.dtype(specs[n][1]) if (
+                n in specs and specs[n][1] != "bfloat16") else np.float32
+            try:
+                if isinstance(v, dict) and "b64" in v:
+                    buf = base64.b64decode(v["b64"])
+                    a = np.frombuffer(buf, dtype=np.dtype(v.get(
+                        "dtype", str(dtype))))
+                    if "shape" in v:
+                        a = a.reshape([int(d) for d in v["shape"]])
+                    feed[n] = a.astype(dtype, copy=False)
+                else:
+                    feed[n] = np.asarray(v, dtype=dtype)
+            except (ValueError, TypeError) as e:
+                raise RequestError(400, f"input {n!r}: {e}")
+        opts = {k: v for k, v in payload.items() if k != "inputs"}
+        return feed, opts
+    if "npz" in ctype or "octet-stream" in ctype:
+        try:
+            with np.load(_io.BytesIO(body), allow_pickle=False) as z:
+                feed = {n: z[n] for n in z.files}
+        except (ValueError, OSError) as e:
+            raise RequestError(400, f"malformed npz body: {e}")
+        return feed, {}
+    raise RequestError(
+        415, f"unsupported Content-Type {ctype!r} "
+             "(use application/json or application/x-npz)")
+
+
+def _encode_outputs(fetch_names, outs, meta, want_npz: bool):
+    """-> (body bytes, content type)."""
+    if want_npz:
+        buf = _io.BytesIO()
+        np.savez(buf, **{n: np.asarray(o)
+                         for n, o in zip(fetch_names, outs)})
+        return buf.getvalue(), "application/x-npz"
+    body = {
+        "outputs": {n: np.asarray(o).tolist()
+                    for n, o in zip(fetch_names, outs)},
+        "batch": meta,
+    }
+    return (json.dumps(_json_safe(body)) + "\n").encode(), \
+        "application/json"
+
+
+class _ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    inference_server: "InferenceServer" = None
+
+
+class ServingHandler(mserve.MonitorHandler):
+    server_version = "paddle-tpu-serving/1.0"
+
+    # -- GET: model listing + inherited monitor routes -------------------
+    def _route_get(self, url) -> bool:
+        srv = self.server.inference_server
+        if url.path == "/v1/models":
+            self._send_json(200, {"models": srv.models_info()})
+        elif url.path.startswith("/v1/models/"):
+            name = url.path[len("/v1/models/"):]
+            model = srv.model(name)
+            if model is None:
+                self._send_json(404, {"error": f"no model {name!r}"})
+            else:
+                self._send_json(200, model.info())
+        else:
+            return super()._route_get(url)
+        return True
+
+    # -- POST: prediction ------------------------------------------------
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        try:
+            url = urlparse(self.path)
+            name = self._predict_target(url.path)
+            if name is None:
+                self._send_json(404, {
+                    "error": "POST /v1/models/<name>:predict"})
+                return
+            srv = self.server.inference_server
+            model = srv.model(name)
+            if model is None:
+                self._send_json(404, {"error": f"no model {name!r}"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            if length <= 0:
+                raise RequestError(411, "request body required")
+            body = self.rfile.read(length)
+            ctype = (self.headers.get("Content-Type")
+                     or "application/json").lower()
+            specs = model.feed_specs
+            feed, opts = _decode_inputs(body, ctype, specs)
+            q = parse_qs(url.query)
+            precision = str(opts.get(
+                "precision", q.get("precision", ["fp32"])[0]))
+            if precision not in model.precisions:
+                raise RequestError(
+                    400, f"model {name!r} has no {precision!r} replica "
+                         f"(available: {model.precisions})")
+            try:
+                timeout = float(opts.get("timeout_s", 30.0))
+            except (TypeError, ValueError):
+                raise RequestError(
+                    400, f'"timeout_s" must be a number, got '
+                         f'{opts.get("timeout_s")!r}')
+            try:
+                outs, meta = srv.submit(name, feed, precision=precision,
+                                        timeout=timeout)
+            except (KeyError, ValueError) as e:
+                raise RequestError(400, str(e))
+            except TimeoutError as e:
+                raise RequestError(504, str(e))
+            want_npz = ("npz" in q.get("format", [""])[0]
+                        or "npz" in (self.headers.get("Accept") or ""))
+            data, out_ctype = _encode_outputs(
+                model.fetch_names, outs, meta, want_npz)
+            self.send_response(200)
+            self.send_header("Content-Type", out_ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except RequestError as e:
+            self._send_json(e.code, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — a request must not kill serving
+            try:
+                self._send_json(500, {
+                    "error": f"{type(e).__name__}: {e}"})
+            except OSError:
+                pass
+
+    @staticmethod
+    def _predict_target(path: str) -> Optional[str]:
+        if not path.startswith("/v1/models/"):
+            return None
+        rest = path[len("/v1/models/"):]
+        if rest.endswith(":predict"):
+            return rest[:-len(":predict")]
+        if rest.endswith("/predict"):
+            return rest[:-len("/predict")]
+        return None
+
+    def _send_json(self, code: int, body: dict) -> None:
+        self._send(code, json.dumps(_json_safe(body)) + "\n",
+                   "application/json")
+
+
+def enable_compilation_cache() -> bool:
+    """Point jax's persistent compilation cache at
+    FLAGS.serving_cache_dir so the warmup ladder's XLA compiles are
+    reused across server restarts (cold start pays trace+compile once
+    per artifact change, not once per process).  Best-effort: an old jax
+    or an unsupported backend downgrades to in-process caching only."""
+    import os
+
+    from ..flags import FLAGS
+    from ..log import vlog, warning
+
+    d = FLAGS.serving_cache_dir
+    if not d:
+        return False
+    try:
+        import jax
+
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # serving compiles are worth persisting even when fast (CPU CI):
+        # drop the min-compile-time / min-entry-size skip heuristics
+        for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                         ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(opt, val)
+            except Exception:  # noqa: BLE001 — older jax: option absent
+                pass
+        # jax memoizes "cache disabled" at the first compile; a process
+        # that compiled anything before this call (warm startup code, an
+        # in-process test) must reset the cache singleton to pick the new
+        # dir up
+        from ..inference import reset_compilation_cache_singleton
+
+        reset_compilation_cache_singleton()
+        vlog(1, "serving: persistent compilation cache at %s", d)
+        return True
+    except Exception as e:  # noqa: BLE001 — never fail startup over caching
+        warning("serving: compilation cache disabled (%s: %s)",
+                type(e).__name__, e)
+        return False
+
+
+class InferenceServer:
+    """Load-many, serve-many: the multi-model production server."""
+
+    def __init__(self, configs=None, host: str = "127.0.0.1",
+                 port: int = 0, monitor: bool = True):
+        # telemetry goes on BEFORE any model loads: load-time events (a
+        # corrupted AOT bundle's inference.aot_bundle_errors counter +
+        # flight event) must be counted, not lost to a late flag flip
+        if monitor:
+            from ..flags import FLAGS
+
+            FLAGS.monitor = True
+        self._monitor = monitor
+        self.host = host
+        self._requested_port = port
+        self._models: Dict[str, ServingModel] = {}
+        self._batchers: Dict[str, DynamicBatcher] = {}
+        self._httpd: Optional[_ServingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        for c in configs or []:
+            self.add_model(c)
+
+    # -- model management ------------------------------------------------
+    def add_model(self, config: ModelConfig) -> ServingModel:
+        if config.name in self._models:
+            raise ValueError(f"model {config.name!r} already served")
+        model = ServingModel(config)
+        batcher = DynamicBatcher(model)
+        self._models[config.name] = model
+        self._batchers[config.name] = batcher
+        if self._started:
+            batcher.start()
+            model.warmup()
+        return model
+
+    def model(self, name: str) -> Optional[ServingModel]:
+        return self._models.get(name)
+
+    @property
+    def model_names(self) -> List[str]:
+        return sorted(self._models)
+
+    def models_info(self) -> List[dict]:
+        return [self._models[n].info() for n in self.model_names]
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self, warmup: bool = True) -> int:
+        """Boot the serving tier; returns the bound port.  Construction
+        already turned FLAGS.monitor on (unless monitor=False) — a serving
+        process without its latency histograms and compile counters is
+        undebuggable, and the hot-path cost is the PR-1 contract (cheap
+        registry writes)."""
+        if self._started:
+            return self.port
+        from ..flags import FLAGS
+
+        if self._monitor:
+            FLAGS.monitor = True
+        enable_compilation_cache()
+        for b in self._batchers.values():
+            b.start()
+        self._httpd = _ServingHTTPServer(
+            (self.host, int(self._requested_port)), ServingHandler)
+        self._httpd.inference_server = self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="paddle-tpu-serving-http", daemon=True)
+        self._thread.start()
+        self._started = True
+        # /health (here AND on a separately-started monitor endpoint)
+        # now reports serving readiness distinct from trainer liveness
+        mserve.set_readiness_provider(self.readiness)
+        if warmup:
+            self.warmup()
+        from ..log import vlog
+
+        vlog(1, "serving: listening on %s:%d (models: %s)",
+             self.host, self.port, ", ".join(self.model_names) or "-")
+        return self.port
+
+    def warmup(self) -> int:
+        """Pre-compile every model's (precision x bucket) ladder; with
+        FLAGS.serving_cache_dir set the compiles persist across
+        restarts.  Returns total signatures warmed."""
+        return sum(m.warmup() for m in self._models.values())
+
+    def stop(self) -> None:
+        for b in self._batchers.values():
+            b.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if mserve._readiness_provider == self.readiness:
+            mserve.set_readiness_provider(None)
+        self._started = False
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            return 0
+        return self._httpd.server_address[1]
+
+    # -- serving ---------------------------------------------------------
+    def submit(self, name: str, feed, precision: str = "fp32",
+               timeout: float = 30.0):
+        """Programmatic entry (the HTTP handler and in-process callers
+        share the same batcher path)."""
+        batcher = self._batchers.get(name)
+        if batcher is None:
+            raise KeyError(f"no model {name!r} "
+                           f"(served: {self.model_names})")
+        return batcher.submit(feed, precision=precision, timeout=timeout)
+
+    def readiness(self) -> dict:
+        models = {
+            n: {"ready": m.ready, "precisions": m.precisions}
+            for n, m in self._models.items()
+        }
+        return {
+            "ready": bool(self._models)
+            and all(m.ready for m in self._models.values()),
+            "models": models,
+        }

@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""loadgen: closed/open-loop load-test harness for the serving tier.
+
+Drives a running InferenceServer (`python -m paddle_tpu.serving ...`)
+with concurrent JSON requests of ragged batch sizes, measures
+client-side latency percentiles + QPS, scrapes /metrics before/after for
+the server-side story (compile counters, batch-fill, padded rows), and
+emits ONE JSON artifact — the QPS/p99-vs-batching-policy record the
+ROADMAP serving item asks for (tools/run_ci.sh archives it).
+
+  closed loop:  --concurrency C workers, each firing its next request as
+                soon as the previous answers (throughput-bound: measures
+                the server's saturated QPS);
+  open loop:    --qps R arrivals on a fixed schedule regardless of
+                completions (latency-under-offered-load; reports
+                schedule lag so an overloaded run is self-describing).
+
+Feed shapes/dtypes are discovered from GET /v1/models/<name>; batch
+sizes cycle through --batch-sizes so the request stream is
+shape-varying (the dynamic batcher's pad-to-bucket path, not one warm
+signature).
+
+Usage:
+  python tools/loadgen.py --url http://127.0.0.1:8000 --model demo \
+      --requests 300 --concurrency 8 --out loadgen.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import sys
+import threading
+import time
+import urllib.request
+from urllib.parse import urlparse
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# prometheus text parsing (scrape-side metrics for the artifact)
+# ---------------------------------------------------------------------------
+
+
+def parse_prometheus(text: str):
+    """-> (scalars {name: value}, histograms {name: {buckets, sum, count}})."""
+    scalars, hists = {}, {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value = line.rsplit(None, 1)
+            value = float(value)
+        except ValueError:
+            continue
+        if "{" in name_part:
+            name, rest = name_part.split("{", 1)
+            if name.endswith("_bucket"):
+                base = name[: -len("_bucket")]
+                le = rest.split('le="', 1)[1].split('"', 1)[0]
+                le = float("inf") if le == "+Inf" else float(le)
+                hists.setdefault(base, {"buckets": [], "sum": 0.0,
+                                        "count": 0})
+                hists[base]["buckets"].append([le, value])
+                continue
+            scalars[name_part] = value
+        elif name_part.endswith("_sum"):
+            hists.setdefault(name_part[:-4], {"buckets": [], "sum": 0.0,
+                                              "count": 0})["sum"] = value
+        elif name_part.endswith("_count"):
+            hists.setdefault(name_part[:-6], {"buckets": [], "sum": 0.0,
+                                              "count": 0})["count"] = value
+        else:
+            scalars[name_part] = value
+    return scalars, hists
+
+
+def _get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _get_json(url: str, timeout: float = 10.0):
+    return json.loads(_get(url, timeout))
+
+
+# ---------------------------------------------------------------------------
+# request synthesis
+# ---------------------------------------------------------------------------
+
+
+def synth_feed(feeds: dict, rows: int, rng: np.random.RandomState) -> dict:
+    """Random inputs matching the model's declared feed specs."""
+    out = {}
+    for name, spec in feeds.items():
+        shape = spec.get("shape") or [-1]
+        item = [int(d) if int(d) > 0 else 1 for d in shape[1:]]
+        dtype = spec.get("dtype", "float32")
+        if "int" in dtype:
+            out[name] = rng.randint(0, 4, size=[rows] + item).tolist()
+        else:
+            out[name] = rng.randn(rows, *item).astype("float32").tolist()
+    return out
+
+
+class _Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies = []
+        self.errors = 0
+        self.lag = []  # open loop: send lateness vs schedule
+
+    def ok(self, dt: float, lag: float = 0.0):
+        with self.lock:
+            self.latencies.append(dt)
+            if lag:
+                self.lag.append(lag)
+
+    def fail(self):
+        with self.lock:
+            self.errors += 1
+
+
+class _Conn:
+    """One persistent keep-alive connection per worker thread (the server
+    speaks HTTP/1.1): connection setup is paid once per worker, not once
+    per request, so the measurement sees the serving tier and not the
+    client's TCP churn.  Reconnects transparently on a dropped socket."""
+
+    def __init__(self, url: str, timeout: float):
+        p = urlparse(url)
+        self.host, self.port = p.hostname, p.port
+        self.timeout = timeout
+        self.conn = None
+
+    def request(self, target: str, body: bytes) -> bool:
+        for attempt in (0, 1):  # one transparent reconnect
+            try:
+                if self.conn is None:
+                    self.conn = http.client.HTTPConnection(
+                        self.host, self.port, timeout=self.timeout)
+                self.conn.request(
+                    "POST", target, body=body,
+                    headers={"Content-Type": "application/json"})
+                r = self.conn.getresponse()
+                r.read()
+                return 200 <= r.status < 300
+            except (http.client.HTTPException, OSError):
+                self.close()
+                if attempt:
+                    return False
+        return False
+
+    def close(self):
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except OSError:
+                pass
+            self.conn = None
+
+
+def _fire(conn: _Conn, model: str, body: bytes, precision: str,
+          stats: _Stats, lag: float = 0.0) -> None:
+    target = f"/v1/models/{model}:predict"
+    if precision != "fp32":
+        target += f"?precision={precision}"
+    t0 = time.perf_counter()
+    if conn.request(target, body):
+        stats.ok(time.perf_counter() - t0, lag)
+    else:
+        stats.fail()
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--url", required=True,
+                   help="server base url, e.g. http://127.0.0.1:8000")
+    p.add_argument("--model", required=True)
+    p.add_argument("--requests", type=int, default=300)
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--qps", type=float, default=100.0,
+                   help="open-loop offered arrival rate")
+    p.add_argument("--batch-sizes", default="1,2,3,4",
+                   help="request batch sizes, cycled (shape-varying "
+                        "stream)")
+    p.add_argument("--precision", default="fp32")
+    p.add_argument("--timeout-s", type=float, default=30.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="",
+                   help="write the JSON artifact here (always printed to "
+                        "stdout)")
+    args = p.parse_args(argv)
+
+    info = None
+    for m in _get_json(f"{args.url}/v1/models")["models"]:
+        if m["name"] == args.model:
+            info = m
+            break
+    if info is None:
+        print(f"loadgen: no model {args.model!r} at {args.url}",
+              file=sys.stderr)
+        return 2
+    rng = np.random.RandomState(args.seed)
+    sizes = [int(s) for s in args.batch_sizes.split(",") if s.strip()]
+    # pre-serialized bodies (one per batch size): the generator must not
+    # bottleneck the measurement
+    bodies = [
+        json.dumps({"inputs": synth_feed(info["feeds"], b, rng)}).encode()
+        for b in sizes
+    ]
+
+    prom_before = parse_prometheus(_get(f"{args.url}/metrics").decode())
+    stats = _Stats()
+    t_start = time.perf_counter()
+
+    if args.mode == "closed":
+        counter = [0]
+        lock = threading.Lock()
+
+        def worker():
+            conn = _Conn(args.url, args.timeout_s)
+            try:
+                while True:
+                    with lock:
+                        i = counter[0]
+                        if i >= args.requests:
+                            return
+                        counter[0] += 1
+                    _fire(conn, args.model, bodies[i % len(bodies)],
+                          args.precision, stats)
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(args.concurrency)]
+    else:  # open loop: fixed arrival schedule, pool large enough to
+        # absorb in-flight overlap
+        interval = 1.0 / max(args.qps, 1e-6)
+        sched_q = []
+        for i in range(args.requests):
+            sched_q.append((t_start + i * interval, i))
+        qlock = threading.Lock()
+
+        def worker():
+            conn = _Conn(args.url, args.timeout_s)
+            try:
+                while True:
+                    with qlock:
+                        if not sched_q:
+                            return
+                        due, i = sched_q.pop(0)
+                    now = time.perf_counter()
+                    if due > now:
+                        time.sleep(due - now)
+                    lag = max(0.0, time.perf_counter() - due)
+                    _fire(conn, args.model, bodies[i % len(bodies)],
+                          args.precision, stats, lag)
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(max(args.concurrency, 4))]
+
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+
+    prom_after = parse_prometheus(_get(f"{args.url}/metrics").decode())
+    lat = np.asarray(sorted(stats.latencies)) if stats.latencies else None
+
+    def delta(name):
+        return (prom_after[0].get(name, 0.0)
+                - prom_before[0].get(name, 0.0))
+
+    mname = args.model
+    fill = prom_after[1].get(f"serving_{mname}_batch_fill")
+    fill_before = prom_before[1].get(f"serving_{mname}_batch_fill",
+                                     {"sum": 0.0, "count": 0})
+    artifact = {
+        "tool": "loadgen",
+        "url": args.url,
+        "model": mname,
+        "mode": args.mode,
+        "precision": args.precision,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "batch_sizes": sizes,
+        "offered_qps": args.qps if args.mode == "open" else None,
+        "elapsed_s": round(elapsed, 4),
+        "completed": len(stats.latencies),
+        "errors": stats.errors,
+        "qps": round(len(stats.latencies) / elapsed, 2) if elapsed else 0,
+        "latency_ms": None if lat is None else {
+            "mean": round(float(lat.mean()) * 1e3, 3),
+            "p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p90": round(float(np.percentile(lat, 90)) * 1e3, 3),
+            "p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "max": round(float(lat[-1]) * 1e3, 3),
+        },
+        "schedule_lag_ms_p99": (
+            round(float(np.percentile(stats.lag, 99)) * 1e3, 3)
+            if stats.lag else None),
+        "policy": {
+            "buckets": info.get("buckets"),
+            "max_batch": info.get("max_batch"),
+            "max_wait_ms": info.get("max_wait_ms"),
+            "use_aot": info.get("use_aot"),
+        },
+        "server_metrics": {
+            "executor_compiles_during_load": delta("executor_compiles"),
+            "executor_recompiles_during_load": delta("executor_recompiles"),
+            "batches": delta(f"serving_{mname}_batches"),
+            "padded_rows": delta(f"serving_{mname}_padded_rows"),
+            "rows": delta(f"serving_{mname}_rows"),
+            "unplanned_compiles": delta(
+                f"serving_{mname}_unplanned_compiles"),
+            "batch_fill_mean": (
+                round((fill["sum"] - fill_before["sum"])
+                      / max(1, fill["count"] - fill_before["count"]), 4)
+                if fill and fill["count"] > fill_before["count"] else None),
+        },
+    }
+    out = json.dumps(artifact, indent=2)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    return 0 if stats.errors == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

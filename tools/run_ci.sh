@@ -7,11 +7,15 @@
 #   3. bench smoke (real chip if present, else CPU) with telemetry,
 #      flight recorder, and metrics-snapshot artifacts
 #   4. compile-check + multichip dryrun (the driver's graft contract)
+#   5. serving smoke gate: export a model, boot the inference server,
+#      drive tools/loadgen.py — p99/batch-fill histograms on /metrics,
+#      zero recompiles across a shape-varying stream, and the dynamic-
+#      batching A/B (batched >= 2x batch-size-1 QPS)
 # Usage: tools/run_ci.sh [fast]   — "fast" skips the bench smoke.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/5] lint gate"
+echo "== [1/6] lint gate"
 if command -v ruff >/dev/null 2>&1; then
   ruff check paddle_tpu tools bench.py __graft_entry__.py
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -21,11 +25,11 @@ else
   python -m compileall -q paddle_tpu tools bench.py __graft_entry__.py
 fi
 
-echo "== [2/5] test suite (virtual 8-device CPU mesh)"
+echo "== [2/6] test suite (virtual 8-device CPU mesh)"
 python -m pytest tests/ -q
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [3/5] bench smoke (telemetry on; snapshot + flight artifacts)"
+  echo "== [3/6] bench smoke (telemetry on; snapshot + flight artifacts)"
   mkdir -p ci_artifacts
   rm -f ci_artifacts/bench_steps.jsonl  # StepMonitor appends; keep one run
   rm -rf ci_artifacts/flight && mkdir -p ci_artifacts/flight
@@ -55,7 +59,7 @@ if [[ "${1:-}" != "fast" ]]; then
 fi
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [4/5] chaos smoke: kill-and-resume fault-tolerance gate"
+  echo "== [4/6] chaos smoke: kill-and-resume fault-tolerance gate"
   # A training subprocess is SIGKILLed mid-run by the chaos harness, then
   # resumed from the latest verifiable checkpoint; the gate passes when the
   # resumed run reports a non-zero start step and finishes.  Artifacts: the
@@ -89,7 +93,25 @@ PY
   ls ci_artifacts/chaos/ckpt
 fi
 
-echo "== [5/5] entry compile-check + multichip dryrun"
+if [[ "${1:-}" != "fast" ]]; then
+  echo "== [5/6] serving smoke: dynamic-batching inference gate"
+  # Exports a demo model, boots two inference servers (batched + forced
+  # --max-batch 1), and drives tools/loadgen.py through both:
+  #   * a shape-varying stream must finish with the executor compile
+  #     counter FLAT (warm bucket ladder, zero recompiles) and the
+  #     request-latency p99 / batch-fill histograms on /metrics;
+  #   * the A/B: dynamic batching must serve >= 2x the QPS of
+  #     batch-size-1 mode on the same single-row stream (interleaved
+  #     trial pairs absorb noisy-neighbour CI variance).
+  # Artifacts: ci_artifacts/serving/loadgen_*.json + ab_summary.json.
+  rm -rf ci_artifacts/serving && mkdir -p ci_artifacts/serving
+  JAX_PLATFORMS=cpu python tools/serving_smoke.py \
+    --out-dir ci_artifacts/serving
+  echo "-- serving artifacts:"
+  ls ci_artifacts/serving/
+fi
+
+echo "== [6/6] entry compile-check + multichip dryrun"
 python __graft_entry__.py
 
 echo "CI OK"
