@@ -769,10 +769,15 @@ def run_deepfm(args, peak):
     emb_bytes = D.SPARSE_SLOTS * (10 + 1) * 4  # per-example rows (k=10 + w1)
     bytes_per_ex = emb_bytes * (1 + 2 + 4)  # fwd + grad r/w + m,v r/w
     hbm_gbps = eps * bytes_per_ex / 1e9
+    from paddle_tpu.flags import FLAGS as _FLAGS
+
     emit_metric("deepfm_ctr_train_examples_per_sec_per_chip", eps,
                 "examples/sec", eps / DEEPFM_TARGET_EXAMPLES_PER_SEC,
                 None, loss,
                 {"batch": bs, "hash_dim": hash_dim, "sparse": True,
+                 # the r08 A/B knob: run once with FLAGS_fused_embedding=0
+                 # for the per-slot baseline record (tools/run_ci.sh does)
+                 "fused_embedding": bool(_FLAGS.fused_embedding),
                  "runs": [round(r, 1) for r in runs],
                  "spread": round(spread, 1),
                  "hbm_gbps_analytic": round(hbm_gbps, 2),

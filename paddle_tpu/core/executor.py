@@ -193,6 +193,11 @@ class TraceContext:
         # after the step and names the first offending op
         self.check_nan_inf = check_nan_inf
         self.nan_checks: List[Tuple[str, Any]] = []
+        # sparse-tier trace census (FLAGS_monitor only): the embedding
+        # lowerings accumulate gather-launch / rows-touched counts here
+        # (ops/nn_ops.py _note_embed_stats); trace_block publishes them as
+        # per-step `embedding.*` gauges — a traced block IS one step
+        self.embed_stats = {"gather_launches": 0, "sparse_rows_touched": 0}
 
     def next_rng_key(self, op=None):
         import jax
@@ -252,6 +257,19 @@ def trace_block(block: fw.Block, env: Dict[str, Any], tctx: TraceContext,
             flag = _all_finite_flag(outs)
             if flag is not None:
                 tctx.nan_checks.append((repr(op), flag))
+    if any(tctx.embed_stats.values()):
+        # per-step sparse-tier gauges (trace-time writes only; the outer
+        # block's publish runs last, so sub-block traces never leave a
+        # partial count behind).  Guarded inside _note_embed_stats: the
+        # accumulators stay zero unless FLAGS.monitor was on at trace
+        # time.  The same census rides the flight ring so
+        # tools/trace_report.py can surface it from a postmortem dump.
+        from .. import monitor
+        from ..monitor import flight as _flight
+
+        for k, v in tctx.embed_stats.items():
+            monitor.gauge(f"embedding.{k}").set(v)
+        _flight.record("embedding.census", **tctx.embed_stats)
     return env
 
 

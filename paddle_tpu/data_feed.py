@@ -378,9 +378,19 @@ class AsyncExecutor:
         queue_capacity: int = 8,
         shard_retries: int = 2,
         on_shard_error: str = "skip",
+        pipeline: Optional[bool] = None,
     ) -> List[List[float]]:
         """Train over every batch in `filelist`; returns the fetch values
         per batch (floats for scalar fetches).
+
+        Pipelined ingest (`pipeline`, default FLAGS.pipelined_feed): the
+        consumer double-buffers the device side of the loop — batch N+1's
+        feed arrays are converted/device_put (an async enqueue under jax)
+        and step N+1 is DISPATCHED before step N's fetches are
+        materialized, so the host->device transfer and the next parse
+        overlap the device step instead of serializing behind its
+        readback.  Results are identical to the strict loop (same batches,
+        same order); only the host-side sync point moves one step later.
 
         Fault tolerance: a shard file that fails to read/parse is retried
         with jittered backoff (`shard_retries` extra attempts, duplicate
@@ -390,8 +400,12 @@ class AsyncExecutor:
         worker — one flaky file costs its own batches, not the job.  Set
         on_shard_error="raise" to restore fail-fast semantics (the
         give-up RetryError surfaces on the consumer thread)."""
+        from .flags import FLAGS
         from .testing import chaos
         from .utils.retry import RetryError, retry_call
+
+        if pipeline is None:
+            pipeline = FLAGS.pipelined_feed
 
         if on_shard_error not in ("skip", "raise"):
             raise ValueError(f"on_shard_error {on_shard_error!r} "
@@ -472,7 +486,18 @@ class AsyncExecutor:
         # every sub-ms take would flood the bounded ring with noise
         _STALL_SPAN_S = 0.005
 
+        if mon and pipeline:
+            pipelined_ctr = monitor.counter("data_feed.pipelined_batches")
+            inflight_gauge = monitor.gauge("data_feed.inflight_steps")
+
+        def materialize(outs):
+            # the device sync: converting fetches to host values
+            return [float(np.asarray(o).reshape(-1)[0])
+                    if np.asarray(o).size == 1 else np.asarray(o)
+                    for o in outs]
+
         results: List[List[float]] = []
+        pending = None  # pipelined: dispatched step awaiting materialize
         done = 0
         while done < len(threads):
             if mon:
@@ -490,12 +515,35 @@ class AsyncExecutor:
                 done += 1
                 continue
             if isinstance(item, _Err):
+                if pending is not None:
+                    results.append(materialize(pending))
                 raise item.exc
             if mon:
                 batch_ctr.inc()
-            outs = self.executor.run(
-                program, feed=item, fetch_list=fetch_list, scope=scope)
-            results.append([float(np.asarray(o).reshape(-1)[0])
-                            if np.asarray(o).size == 1 else np.asarray(o)
-                            for o in outs])
+            if pipeline:
+                # double buffer: enqueue batch N+1's host->device puts and
+                # DISPATCH step N+1 (jax queues the execution) before
+                # blocking on step N's fetches — transfer and parse
+                # overlap the device step
+                feed_dev = {
+                    k: self.executor._to_device_array(program, k, v)
+                    for k, v in item.items()
+                }
+                outs = self.executor.run(
+                    program, feed=feed_dev, fetch_list=fetch_list,
+                    scope=scope, return_numpy=False)
+                if mon:
+                    pipelined_ctr.inc()
+                    inflight_gauge.set(1)
+                if pending is not None:
+                    results.append(materialize(pending))
+                pending = outs
+            else:
+                outs = self.executor.run(
+                    program, feed=item, fetch_list=fetch_list, scope=scope)
+                results.append(materialize(outs))
+        if pending is not None:
+            results.append(materialize(pending))
+            if mon:
+                inflight_gauge.set(0)
         return results

@@ -148,6 +148,22 @@ FLAGS.define(
     "batch_norm composition with XLA's separate stat reductions "
     "(flag-off graphs are op-for-op identical to the pre-fusion ones)")
 FLAGS.define(
+    "fused_embedding", bool, True,
+    "the sparse embedding tier coalesces same-shape per-slot lookup_table "
+    "op groups into one fused multi-table gather launch (ids prefetched "
+    "via scalar memory), their grads into one SelectedRows-compatible "
+    "fused grad, and the per-table sgd/lazy-adam chains into one "
+    "row-sparse group apply (kernels/embedding.py, passes.py "
+    "fused_embedding pass; applied by models/deepfm.py); off = the "
+    "reference per-slot composition, graphs op-for-op identical to the "
+    "pre-fusion ones")
+FLAGS.define(
+    "pipelined_feed", bool, True,
+    "AsyncExecutor.run_from_files overlaps host ingest with device "
+    "compute: batch N+1's feed arrays are device_put while step N "
+    "executes, and step N's fetches materialize one step late "
+    "(data_feed.py; off = the strict parse->put->run->sync loop)")
+FLAGS.define(
     "fused_dropout_add", bool, True,
     "the bundled transformer/BERT models lower their dropout+residual "
     "pairs through the fused dropout-add epilogue kernel "

@@ -85,6 +85,51 @@ def embedding(
     return tmp
 
 
+def fused_embedding(
+    inputs,
+    size,
+    is_sparse=False,
+    padding_idx=None,
+    param_attrs=None,
+    dtype="float32",
+):
+    """One fused multi-table lookup over a GROUP of slots sharing the
+    same [vocab, dim] table shape: each slot keeps its own parameter
+    (checkpoint layout identical to per-slot `embedding` calls with the
+    same names) but every gather rides one launch
+    (kernels/embedding.py multi_table_gather; PERF.md round 8).  Returns
+    one output per slot.  `param_attrs` is an optional per-slot list —
+    names default to the helper sequence, same as S separate embedding
+    calls.  Programs built with per-slot `embedding` get the same fusion
+    from the `fused_embedding` graph pass instead (passes.py)."""
+    if param_attrs is None:
+        param_attrs = [None] * len(inputs)
+    if len(param_attrs) != len(inputs):
+        raise ValueError(
+            f"fused_embedding: {len(inputs)} slots but "
+            f"{len(param_attrs)} param_attrs")
+    padding_idx = (
+        -1
+        if padding_idx is None
+        else padding_idx
+        if padding_idx >= 0
+        else size[0] + padding_idx
+    )
+    ws, outs = [], []
+    for attr in param_attrs:
+        helper = LayerHelper("embedding", param_attr=attr)
+        ws.append(helper.create_parameter(helper.param_attr(),
+                                          shape=list(size), dtype=dtype))
+        outs.append(helper.create_variable_for_type_inference(dtype))
+    helper.append_op(
+        "fused_lookup_table",
+        inputs={"Ids": list(inputs), "W": ws},
+        outputs={"Out": outs},
+        attrs={"is_sparse": is_sparse, "padding_idx": padding_idx},
+    )
+    return outs
+
+
 def conv2d(
     input,
     num_filters,

@@ -94,6 +94,19 @@ def build_train_net(embedding_size=10, hash_dim=HASH_DIM, is_sparse=True,
             opt_mod.SGD(learning_rate=lr).minimize(avg_cost)
         else:
             opt_mod.Adam(learning_rate=lr, lazy_mode=True).minimize(avg_cost)
+    # Fused sparse tier (PERF.md round 8): coalesce the 2x26 per-slot
+    # lookup_table ops, their grads, and the per-table sgd/lazy-adam
+    # chains into one multi-table launch per table group.  Parameter and
+    # grad names are untouched, so checkpoints interop across the flag;
+    # flag off leaves the graph op-for-op identical to the per-slot
+    # composition above.
+    from ..flags import FLAGS
+
+    if FLAGS.fused_embedding:
+        from .. import passes
+
+        prog = avg_cost.block.program
+        passes.apply_pass("fused_embedding", prog)
     feeds = ["dense_input"] + [f"C{i}" for i in range(SPARSE_SLOTS)] + ["click"]
     return avg_cost, auc_var, predict, feeds
 

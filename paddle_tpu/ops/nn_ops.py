@@ -710,6 +710,22 @@ def _lookup_infer(ctx):
     ctx.set_output("Out", tuple(base) + (ws[-1],), ctx.input_dtype("W"))
 
 
+def _note_embed_stats(ctx, launches, rows):
+    """Trace-time sparse-tier telemetry: accumulate gather-launch / rows-
+    touched counts on the TraceContext (published once per traced step as
+    `embedding.*` gauges by trace_block — see core/executor.py).  One
+    monitor-enabled flag read at TRACE time; the run hot path never sees
+    this, and eager contexts (no TraceContext accumulator) skip."""
+    from .. import monitor
+
+    if not monitor.enabled():
+        return
+    stats = getattr(ctx.executor_ctx, "embed_stats", None)
+    if stats is not None:
+        stats["gather_launches"] += launches
+        stats["sparse_rows_touched"] += rows
+
+
 def _lookup_table_grad_maker(op, no_grad_set, grad_sub_block_map=None):
     """Sparse-aware grad: emits lookup_table_grad producing a row-sparse
     gradient (SelectedRows parity, lookup_table_op.h:132) when is_sparse."""
@@ -742,6 +758,7 @@ def lower_lookup_table(ctx, ins):
         mask = (flat != padding_idx)[:, None]
         out = out * mask.astype(out.dtype)
     base = idshape[:-1] if idshape and idshape[-1] == 1 else idshape
+    _note_embed_stats(ctx, 1, int(flat.shape[0]))
     return {"Out": [out.reshape(tuple(base) + (w.shape[-1],))]}
 
 
@@ -769,6 +786,123 @@ def lower_lookup_table_grad(ctx, ins):
         return {"W@GRAD": [SelectedRows(ids, gout2.astype(w.dtype), w.shape[0])]}
     gw = jnp.zeros_like(w).at[ids].add(gout2.astype(w.dtype))
     return {"W@GRAD": [gw]}
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-table embedding (FLAGS_fused_embedding; passes.py
+# `fused_embedding` coalesces per-slot lookup_table ops into these —
+# PERF.md round 8, the DeepFM/CTR dispatch-wall attack).  One op gathers
+# every slot of a same-shape TABLE GROUP in one Pallas launch
+# (kernels/embedding.py); the grad keeps the per-table SelectedRows
+# contract so the sparse optimizer tier (fused or per-table) interops
+# unchanged.
+# ---------------------------------------------------------------------------
+
+
+def _fused_lookup_infer(ctx):
+    n = len(ctx.op.output("Out"))
+    for i in range(n):
+        ws = ctx.input_shape("W", i)
+        ids = ctx.input_shape("Ids", i)
+        if ws is None or ids is None:
+            continue
+        base = ids[:-1] if ids and ids[-1] == 1 else ids
+        ctx.set_output("Out", tuple(base) + (ws[-1],),
+                       ctx.input_dtype("W", i), i=i)
+
+
+def _fused_lookup_table_grad_maker(op, no_grad_set, grad_sub_block_map=None):
+    ws = op.input("W")
+    if all(w in no_grad_set for w in ws):
+        return []
+    # slots whose table is in no_grad_set keep an empty output name (the
+    # executor skips unnamed outputs when binding lowering results)
+    g_ws = [("" if w in no_grad_set else fw.grad_var_name(w)) for w in ws]
+    return [
+        {
+            "type": "fused_lookup_table_grad",
+            "inputs": {
+                "Ids": op.input("Ids"),
+                "W": ws,
+                "Out@GRAD": [fw.grad_var_name(n) for n in op.output("Out")],
+            },
+            "outputs": {"W@GRAD": g_ws},
+            "attrs": dict(op.attrs, **{fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward}),
+        }
+    ]
+
+
+def _stacked_slot_ids(id_vals):
+    """The per-slot lowering re-casts int64->int32 and re-reshapes per op;
+    the fused path hoists both: ONE [S, B] stack, ONE cast (the
+    no-per-slot-convert regression is asserted in
+    tests/test_fused_embedding.py)."""
+    jnp = _jnp()
+
+    return jnp.stack([i.reshape(-1) for i in id_vals]).astype("int32")
+
+
+@register("fused_lookup_table", infer_shape=_fused_lookup_infer,
+          grad_maker=_fused_lookup_table_grad_maker)
+def lower_fused_lookup_table(ctx, ins):
+    """Multi-table gather: Ids (S tensors) + W (S same-shape tables) ->
+    S outputs, preserving each original lookup_table Out name/shape —
+    the graph around a coalesced group never changes.  One Pallas launch
+    gathers every slot (ids via scalar prefetch, tables HBM-resident);
+    see kernels/embedding.py multi_table_gather."""
+    from ..kernels.embedding import multi_table_gather
+
+    id_vals, ws = ins["Ids"], ins["W"]
+    ids = _stacked_slot_ids(id_vals)  # [S, B]
+    out = multi_table_gather(ws, ids)  # [S, B, D]
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[:, :, None]
+        out = out * mask.astype(out.dtype)
+    _note_embed_stats(ctx, 1, int(ids.shape[0] * ids.shape[1]))
+    outs = []
+    for s, (iv, w) in enumerate(zip(id_vals, ws)):
+        idshape = iv.shape
+        base = idshape[:-1] if idshape and idshape[-1] == 1 else idshape
+        outs.append(out[s].reshape(tuple(base) + (w.shape[-1],)))
+    return {"Out": outs}
+
+
+@register("fused_lookup_table_grad", no_grad=True)
+def lower_fused_lookup_table_grad(ctx, ins):
+    """Group backward, SelectedRows-compatible: is_sparse=True emits the
+    IDENTICAL per-table SelectedRows the per-slot path produces (rows ARE
+    the cotangent slices — no kernel needed), so sparse optimizers and
+    clipping interop unchanged.  is_sparse=False runs the matching
+    multi-table scatter-add kernel: duplicate rows merged (batched
+    MergeAdd), then ONE launch accumulates every table's dense grad."""
+    from ..core.selected_rows import SelectedRows
+    from ..kernels.embedding import merge_slot_rows, multi_table_scatter_add
+
+    jnp = _jnp()
+    id_vals, ws, gouts = ins["Ids"], ins["W"], ins["Out@GRAD"]
+    height = ws[0].shape[0]
+    padding_idx = ctx.attr("padding_idx", -1)
+    pad = padding_idx is not None and padding_idx >= 0
+    if ctx.attr("is_sparse", False):
+        grads = []
+        for iv, w, gout in zip(id_vals, ws, gouts):
+            ids_s = iv.reshape(-1).astype("int32")
+            g2 = gout.reshape(-1, w.shape[-1])
+            if pad:
+                g2 = g2 * (ids_s != padding_idx)[:, None].astype(g2.dtype)
+            grads.append(SelectedRows(ids_s, g2.astype(w.dtype), height))
+        return {"W@GRAD": grads}
+    ids = _stacked_slot_ids(id_vals)
+    rows = jnp.stack(
+        [g.reshape(-1, w.shape[-1]).astype(w.dtype)
+         for w, g in zip(ws, gouts)])
+    if pad:
+        rows = rows * (ids != padding_idx)[:, :, None].astype(rows.dtype)
+    uids, mrows = merge_slot_rows(ids, rows, height)
+    zeros = [jnp.zeros_like(w) for w in ws]
+    gws = multi_table_scatter_add(zeros, uids, mrows, jnp.float32(1.0))
+    return {"W@GRAD": list(gws)}
 
 
 # ---------------------------------------------------------------------------

@@ -366,6 +366,96 @@ def lower_proximal_adagrad(ctx, ins):
     return {"ParamOut": [p_out], "MomentOut": [m_out]}
 
 
+# ---------------------------------------------------------------------------
+# Fused row-sparse group updates (FLAGS_fused_embedding tier: passes.py
+# coalesces the per-table sgd / lazy-adam ops of one embedding table
+# group into these — ONE launch updates every touched row of every
+# table, kernels/embedding.py).  Emitted only for all-SelectedRows
+# groups; each keeps a per-table fallback so a pass mistake degrades to
+# the reference math instead of miscomputing.
+# ---------------------------------------------------------------------------
+
+
+def _stack_selected_rows(ps, gs):
+    """[S, K] merged-ready ids + [S, K, D] rows from the group's
+    SelectedRows grads (rows already carry the param dtype — the
+    lookup_table_grad contract)."""
+    jnp = _jnp()
+
+    ids = jnp.stack([g.ids.reshape(-1) for g in gs]).astype("int32")
+    rows = jnp.stack([g.rows.astype(p.dtype) for p, g in zip(ps, gs)])
+    return ids, rows
+
+
+@register("fused_sparse_sgd", no_grad=True)
+def lower_fused_sparse_sgd(ctx, ins):
+    """Group sparse SGD: merge duplicate rows per slot (batched MergeAdd —
+    one sort for the whole group), then one scatter-apply launch.
+    Reference math: sgd_op.h SelectedRows kernel, per table."""
+    from ..kernels.embedding import merge_slot_rows, multi_table_sparse_sgd
+
+    ps, gs = ins["Param"], ins["Grad"]
+    lr = _lr(ins)
+    if not all(_is_sparse(g) for g in gs):
+        # dense/mixed group (pass bug or hand-built program): reference math
+        outs = []
+        for p, g in zip(ps, gs):
+            if _is_sparse(g):
+                ids = g.ids.reshape(-1).astype("int32")
+                outs.append(p.at[ids].add((-lr * g.rows).astype(p.dtype),
+                                          mode="drop"))
+            else:
+                outs.append(p - lr * g.astype(p.dtype))
+        return {"ParamOut": outs}
+    ids, rows = _stack_selected_rows(ps, gs)
+    uids, mrows = merge_slot_rows(ids, rows, ps[0].shape[0])
+    return {"ParamOut": list(multi_table_sparse_sgd(ps, uids, mrows, lr))}
+
+
+@register("fused_sparse_adam", no_grad=True)
+def lower_fused_sparse_adam(ctx, ins):
+    """Group lazy Adam (adam_op.h SparseAdamFunctor lazy mode, multi-
+    table): duplicate ids merge ONCE per slot (one moment update per
+    touched row — the lazy contract), then one launch updates param +
+    both moments for every table.  Beta-pow accumulators advance in
+    lockstep across a group built by one optimizer, so slot 0's pair
+    drives the shared bias-corrected rate."""
+    jnp = _jnp()
+    from ..kernels.embedding import merge_slot_rows, multi_table_sparse_adam
+
+    ps, gs = ins["Param"], ins["Grad"]
+    m1s, m2s = ins["Moment1"], ins["Moment2"]
+    b1ps, b2ps = ins["Beta1Pow"], ins["Beta2Pow"]
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ins)
+    lazy = ctx.attr("lazy_mode", False)
+    if not (lazy and all(_is_sparse(g) for g in gs)):
+        # non-lazy densifies per table; mixed groups take reference math
+        p_out, m1_out, m2_out = [], [], []
+        for i in range(len(ps)):
+            po, m1o, m2o = _adam_one(ps[i], gs[i], m1s[i], m2s[i],
+                                     b1ps[i], b2ps[i], lr, b1, b2, eps, lazy)
+            p_out.append(po)
+            m1_out.append(m1o)
+            m2_out.append(m2o)
+    else:
+        ids, rows = _stack_selected_rows(ps, gs)
+        uids, mrows = merge_slot_rows(ids, rows, ps[0].shape[0])
+        b1p, b2p = b1ps[0], b2ps[0]
+        lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+        p_out, m1_out, m2_out = multi_table_sparse_adam(
+            ps, m1s, m2s, uids, mrows, lr_t, b1, b2, eps)
+    return {
+        "ParamOut": list(p_out),
+        "Moment1Out": list(m1_out),
+        "Moment2Out": list(m2_out),
+        "Beta1PowOut": [bp * b1 for bp in b1ps],
+        "Beta2PowOut": [bp * b2 for bp in b2ps],
+    }
+
+
 @register("proximal_gd", no_grad=True)
 def lower_proximal_gd(ctx, ins):
     jnp = _jnp()

@@ -177,6 +177,190 @@ def _layer_norm_gelu_fuse(program: fw.Program, scope=None) -> int:
     return n
 
 
+@register_pass("fused_embedding")
+def _fused_embedding_pass(program: fw.Program, scope=None) -> int:
+    """Coalesce per-slot `lookup_table` op groups into ONE
+    `fused_lookup_table` per same-shape table group, plus their
+    `lookup_table_grad` ops and per-table row-sparse optimizer chains
+    (sgd / lazy-mode adam) into `fused_lookup_table_grad` /
+    `fused_sparse_{sgd,adam}` — the graph tier of the round-8 DeepFM
+    dispatch-wall attack (ops/nn_ops.py, kernels/embedding.py; gate:
+    FLAGS_fused_embedding, applied by models/deepfm.py).
+
+    Every rewrite preserves variable names (parameters, outputs, grads),
+    so checkpoints interop across the flag and downstream consumers
+    never change.  Groups are conservative: >= 2 lookups over DISTINCT
+    single-use tables of identical [V, D] shape/dtype with identical
+    ids shapes and attrs; anything else (shared tables, distributed
+    lookups, producers interleaved past the fusion point) keeps the
+    per-slot composition, which remains correct alongside fused groups.
+    Returns the number of ops fused away."""
+    block = program.global_block()
+    removed_total = 0
+
+    def producers_and_first_consumers():
+        prod: Dict[str, int] = {}
+        first_use: Dict[str, int] = {}
+        for i, op in enumerate(block.ops):
+            for n in op.input_arg_names():
+                if n and n not in first_use:
+                    first_use[n] = i
+            for n in op.output_arg_names():
+                if n:
+                    prod.setdefault(n, i)
+        return prod, first_use
+
+    # ---- tier 1: forward lookups (one rewrite per O(ops) rescan: every
+    # rewrite shifts op indices, so group indices are refetched fresh) ---
+    fused_groups = []  # (ws, ids_names, out_names, attrs) per rewrite
+    changed = True
+    while changed:
+        changed = False
+        table_uses: Dict[str, int] = {}
+        for op in block.ops:
+            if op.type == "lookup_table":
+                w = op.input("W")[0]
+                table_uses[w] = table_uses.get(w, 0) + 1
+        groups: Dict[tuple, list] = {}
+        order: list = []
+        for i, op in enumerate(block.ops):
+            if op.type != "lookup_table" or op.attr("is_distributed", False):
+                continue
+            w, ids = op.input("W")[0], op.input("Ids")[0]
+            wv = block._find_var_recursive(w)
+            iv = block._find_var_recursive(ids)
+            if wv is None or iv is None or not wv.shape or table_uses[w] != 1:
+                continue
+            key = (tuple(wv.shape), wv.dtype, tuple(iv.shape or ()),
+                   bool(op.attr("is_sparse", False)),
+                   op.attr("padding_idx", -1))
+            if key not in groups:
+                order.append(key)
+            groups.setdefault(key, []).append((i, op))
+        prod, _ = producers_and_first_consumers()
+        for key in order:
+            items = groups[key]
+            if len(items) < 2:
+                continue
+            ws = [op.input("W")[0] for _, op in items]
+            if len(set(ws)) != len(ws):
+                continue
+            insert_at = min(i for i, _ in items)
+            max_idx = max(i for i, _ in items)
+            in_names = [op.input("Ids")[0] for _, op in items] + ws
+            # an input produced between the fusion point and its original
+            # op (e.g. hashed ids) blocks hoisting; producers PAST the
+            # group are next-iteration state writes (the optimizer's
+            # in-place ParamOut) and don't
+            if any(insert_at <= prod.get(n, -1) <= max_idx
+                   for n in in_names):
+                continue
+            idxs = sorted((i for i, _ in items), reverse=True)
+            inputs = {"Ids": [op.input("Ids")[0] for _, op in items],
+                      "W": ws}
+            outputs = {"Out": [op.output("Out")[0] for _, op in items]}
+            attrs = dict(items[0][1].attrs)
+            for i in idxs:
+                block.remove_op(i)
+            block.insert_op(insert_at, "fused_lookup_table", inputs=inputs,
+                            outputs=outputs, attrs=attrs)
+            removed_total += len(items) - 1
+            fused_groups.append((ws, inputs["Ids"], outputs["Out"], attrs))
+            changed = True
+            break  # indices shifted: rescan
+
+    # ---- tier 2: backward lookups --------------------------------------
+    for ws, ids_names, out_names, attrs in fused_groups:
+        wset = set(ws)
+        found = {}
+        for i, op in enumerate(block.ops):
+            if op.type == "lookup_table_grad" and op.input("W")[0] in wset:
+                found[op.input("W")[0]] = (i, op)
+        if len(found) != len(ws):
+            continue  # partial/no backward: per-slot grads stay correct
+        idxs = sorted((i for i, _ in found.values()), reverse=True)
+        insert_at = max(idxs) - (len(idxs) - 1)
+        _, first_use = producers_and_first_consumers()
+        g_outs = [found[w][1].output("W@GRAD")[0] for w in ws]
+        if any(first_use.get(n, len(block.ops)) <= max(idxs)
+               for n in g_outs):
+            continue  # a grad consumer sits between the per-slot grads
+        g_inputs = {
+            "Ids": [found[w][1].input("Ids")[0] for w in ws],
+            "W": list(ws),
+            "Out@GRAD": [found[w][1].input("Out@GRAD")[0] for w in ws],
+        }
+        g_attrs = dict(found[ws[0]][1].attrs)
+        for i in idxs:
+            block.remove_op(i)
+        block.insert_op(insert_at, "fused_lookup_table_grad",
+                        inputs=g_inputs, outputs={"W@GRAD": g_outs},
+                        attrs=g_attrs)
+        removed_total += len(ws) - 1
+
+        # ---- tier 3: the per-table row-sparse optimizer chain ----------
+        if not attrs.get("is_sparse", False):
+            continue  # dense grads keep the per-param dense updates
+        opt_found = {}
+        opt_type = None
+        for i, op in enumerate(block.ops):
+            if op.type not in ("sgd", "adam"):
+                continue
+            p = op.input("Param")[0]
+            if p not in wset:
+                continue
+            opt_found[p] = (i, op)
+            opt_type = op.type if opt_type in (None, op.type) else "mixed"
+        if len(opt_found) != len(ws) or opt_type not in ("sgd", "adam"):
+            continue
+        ops_g = [opt_found[w][1] for w in ws]
+        lrs = {op.input("LearningRate")[0] for op in ops_g}
+        if len(lrs) != 1:
+            continue  # per-table LR schedules: keep per-table ops
+        if opt_type == "adam":
+            hp = [(op.attr("beta1", 0.9), op.attr("beta2", 0.999),
+                   op.attr("epsilon", 1e-8), op.attr("lazy_mode", False))
+                  for op in ops_g]
+            if len(set(hp)) != 1 or not hp[0][3]:
+                continue  # non-lazy adam densifies per table — no group win
+        idxs = sorted((i for i, _ in opt_found.values()), reverse=True)
+        insert_at = max(idxs) - (len(idxs) - 1)
+        o_attrs = dict(ops_g[0].attrs)
+        if opt_type == "sgd":
+            inputs = {
+                "Param": list(ws),
+                "Grad": [op.input("Grad")[0] for op in ops_g],
+                "LearningRate": [lrs.pop()],
+            }
+            outputs = {"ParamOut": list(ws)}
+            fused_type = "fused_sparse_sgd"
+        else:
+            inputs = {
+                "Param": list(ws),
+                "Grad": [op.input("Grad")[0] for op in ops_g],
+                "LearningRate": [lrs.pop()],
+                "Moment1": [op.input("Moment1")[0] for op in ops_g],
+                "Moment2": [op.input("Moment2")[0] for op in ops_g],
+                "Beta1Pow": [op.input("Beta1Pow")[0] for op in ops_g],
+                "Beta2Pow": [op.input("Beta2Pow")[0] for op in ops_g],
+            }
+            outputs = {
+                "ParamOut": list(ws),
+                "Moment1Out": [op.output("Moment1Out")[0] for op in ops_g],
+                "Moment2Out": [op.output("Moment2Out")[0] for op in ops_g],
+                "Beta1PowOut": [op.output("Beta1PowOut")[0] for op in ops_g],
+                "Beta2PowOut": [op.output("Beta2PowOut")[0] for op in ops_g],
+            }
+            fused_type = "fused_sparse_adam"
+        for i in idxs:
+            block.remove_op(i)
+        block.insert_op(insert_at, fused_type, inputs=inputs,
+                        outputs=outputs, attrs=o_attrs)
+        removed_total += len(ws) - 1
+
+    return removed_total
+
+
 # ---------------------------------------------------------------------------
 # DAG pattern matching (GraphPatternDetector parity,
 # ir/graph_pattern_detector.cc: multi-input/multi-consumer patterns, not

@@ -1,0 +1,601 @@
+"""Fused multi-table embedding tier (PERF.md round 8): the Pallas
+gather/scatter-add/sparse-apply kernels (kernels/embedding.py), the
+fused_lookup_table / fused_sparse_{sgd,adam} ops, the `fused_embedding`
+graph pass, the dispatch-census collapse, and the pipelined CTR ingest.
+
+The aliasing case most likely to break a fused gather/modify/scatter
+pipeline is DUPLICATE ids within a batch — every trajectory test below
+plants duplicates (within slots and across steps) and asserts parity
+against the per-slot SelectedRows composition the reference semantics
+define (lookup_table_op.h:132, selected_rows_functor.h MergeAdd,
+adam_op.h lazy mode)."""
+
+import contextlib
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, passes
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.kernels import embedding as EK
+
+
+@contextlib.contextmanager
+def _fused(flag: bool):
+    FLAGS.fused_embedding = bool(flag)
+    try:
+        yield
+    finally:
+        FLAGS.reset("fused_embedding")
+
+
+def _hlo_diag():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "hlo_diag.py")
+    spec = importlib.util.spec_from_file_location("_hlo_diag_sparse", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# kernel tier
+# ---------------------------------------------------------------------------
+
+
+class TestKernels:
+    S, V, D, B = 5, 37, 10, 23  # awkward sizes: partial blocks, D < lane
+
+    def _group(self, seed=0):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(seed)
+        tables = [jnp.asarray(rng.rand(self.V, self.D), jnp.float32)
+                  for _ in range(self.S)]
+        ids = jnp.asarray(rng.randint(0, self.V, (self.S, self.B)), jnp.int32)
+        ids = ids.at[:, 5].set(ids[:, 3]).at[:, 9].set(ids[:, 3])  # dups
+        rows = jnp.asarray(rng.rand(self.S, self.B, self.D), jnp.float32)
+        return tables, ids, rows
+
+    def test_gather_matches_per_table(self):
+        tables, ids, _ = self._group()
+        out = EK.multi_table_gather(tables, ids, block_rows=8)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(EK.multi_table_gather_xla(tables, ids)))
+
+    def test_merge_matches_selected_rows_merged(self):
+        """Batched MergeAdd == per-slot SelectedRows.merged(), duplicate
+        ids included (same uids, same summed rows, same sentinel tail)."""
+        from paddle_tpu.core.selected_rows import SelectedRows
+
+        tables, ids, rows = self._group()
+        uids, mrows = EK.merge_slot_rows(ids, rows, self.V)
+        for s in range(self.S):
+            u_ref, m_ref = SelectedRows(ids[s], rows[s], self.V).merged()
+            np.testing.assert_array_equal(np.asarray(uids[s]),
+                                          np.asarray(u_ref))
+            np.testing.assert_allclose(np.asarray(mrows[s]),
+                                       np.asarray(m_ref), atol=1e-6)
+
+    def test_scatter_add_duplicates_exact(self):
+        """Fused scatter-add == numpy add.at accumulation (duplicates
+        merged first; sentinel tail rows are dropped)."""
+        import jax.numpy as jnp
+
+        tables, ids, rows = self._group()
+        uids, mrows = EK.merge_slot_rows(ids, rows, self.V)
+        # interpret=True: exercise the aliased DMA kernel itself on the
+        # CPU box (the interpret=None default takes the XLA apply off-TPU)
+        got = EK.multi_table_scatter_add(tables, uids, mrows,
+                                         jnp.float32(1.0), block_rows=8,
+                                         interpret=True)
+        for s in range(self.S):
+            ref = np.asarray(tables[s]).copy()
+            np.add.at(ref, np.asarray(ids[s]), np.asarray(rows[s]))
+            np.testing.assert_allclose(np.asarray(got[s]), ref, atol=1e-5)
+
+    def test_sparse_adam_matches_reference(self):
+        import jax.numpy as jnp
+
+        tables, ids, rows = self._group()
+        rng = np.random.RandomState(3)
+        m1s = [jnp.asarray(rng.rand(self.V, self.D), jnp.float32)
+               for _ in range(self.S)]
+        m2s = [jnp.asarray(rng.rand(self.V, self.D), jnp.float32)
+               for _ in range(self.S)]
+        uids, mrows = EK.merge_slot_rows(ids, rows, self.V)
+        args = (uids, mrows, jnp.float32(0.01), 0.9, 0.999, 1e-8)
+        po, m1o, m2o = EK.multi_table_sparse_adam(
+            tables, m1s, m2s, *args, block_rows=8, interpret=True)
+        pr, m1r, m2r = EK.multi_table_sparse_adam_xla(
+            tables, m1s, m2s, *args)
+        for got, ref in ((po, pr), (m1o, m1r), (m2o, m2r)):
+            for g, r in zip(got, ref):
+                np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                           atol=1e-6)
+
+    def test_non_float_group_falls_back_to_xla(self):
+        """Off-contract groups must take the per-table composition, not
+        crash in the kernel."""
+        import jax.numpy as jnp
+
+        tables = [jnp.arange(20, dtype=jnp.int32).reshape(10, 2)
+                  for _ in range(2)]
+        ids = jnp.asarray([[1, 2, 1], [0, 9, 9]], jnp.int32)
+        out = EK.multi_table_gather(tables, ids)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(EK.multi_table_gather_xla(tables, ids)))
+
+
+# ---------------------------------------------------------------------------
+# pass + op tier (mini group: fast compiles)
+# ---------------------------------------------------------------------------
+
+SLOTS, VOCAB, DIM = 4, 53, 8
+
+
+def _build_mini(optimizer="adam", is_sparse=True, fused=False):
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        with pt.core.framework.guard_unique_name():
+            slots = [layers.data(name=f"s{i}", shape=[1], dtype="int64")
+                     for i in range(SLOTS)]
+            y = layers.data(name="y", shape=[1], dtype="int64")
+            embs = [
+                layers.embedding(s, size=[VOCAB, DIM], is_sparse=is_sparse,
+                                 param_attr=pt.ParamAttr(name=f"tbl_{i}"))
+                for i, s in enumerate(slots)
+            ]
+            h = layers.concat(embs, axis=1)
+            logits = layers.fc(h, size=2)
+            loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+            if optimizer == "adam":
+                pt.optimizer.Adam(learning_rate=0.05,
+                                  lazy_mode=True).minimize(loss)
+            elif optimizer == "adam_nonlazy":
+                pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+            else:
+                pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    if fused:
+        passes.apply_pass("fused_embedding", prog)
+    prog.random_seed = 7
+    return prog, startup, loss
+
+
+def _mini_batch(bs=32, seed=0, dup=True):
+    rng = np.random.RandomState(seed)
+    feed = {f"s{i}": rng.randint(0, VOCAB, (bs, 1)).astype("int64")
+            for i in range(SLOTS)}
+    if dup:
+        for i in range(SLOTS):
+            feed[f"s{i}"][bs // 2:] = feed[f"s{i}"][:bs - bs // 2]
+    feed["y"] = rng.randint(0, 2, (bs, 1)).astype("int64")
+    return feed
+
+
+def _train(prog, startup, loss, batches, fetch_extra=()):
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    losses = []
+    for b in batches:
+        outs = exe.run(prog, feed=b, fetch_list=[loss, *fetch_extra],
+                       scope=scope)
+        losses.append(float(np.asarray(outs[0])))
+    return losses, scope
+
+
+def _ops(prog):
+    return [op.type for op in prog.global_block().ops]
+
+
+class TestPass:
+    def test_census_mini(self):
+        prog, _, _ = _build_mini(fused=True)
+        ops = _ops(prog)
+        assert ops.count("fused_lookup_table") == 1
+        assert ops.count("fused_lookup_table_grad") == 1
+        assert ops.count("fused_sparse_adam") == 1
+        assert "lookup_table" not in ops
+        assert "lookup_table_grad" not in ops
+        # the 4 per-table adam chains collapsed; only the fc ones remain
+        assert ops.count("adam") == 2  # fc w + b
+
+    def test_census_deepfm(self):
+        """The flagship CTR net: 2x26 lookups -> 2 fused groups, the 52
+        per-table lazy-adam chains -> 2 group applies (graph-level launch
+        collapse, program build only — no compile)."""
+        from paddle_tpu.models import deepfm as D
+
+        with _fused(True):
+            prog, _ = pt.Program(), pt.Program()
+            with pt.program_guard(prog, pt.Program()):
+                with pt.core.framework.guard_unique_name():
+                    D.build_train_net(hash_dim=101, embedding_size=4)
+        ops = _ops(prog)
+        assert ops.count("fused_lookup_table") == 2
+        assert ops.count("fused_lookup_table_grad") == 2
+        assert ops.count("fused_sparse_adam") == 2
+        assert "lookup_table" not in ops
+
+    def test_flag_off_graph_identical_to_per_slot(self):
+        """FLAGS_fused_embedding off => the model builder emits the exact
+        per-slot composition (no fused op anywhere), with the same
+        parameter set as the fused build (checkpoint interop)."""
+        from paddle_tpu.models import deepfm as D
+
+        progs = {}
+        for flag in (True, False):
+            with _fused(flag):
+                prog = pt.Program()
+                with pt.program_guard(prog, pt.Program()):
+                    with pt.core.framework.guard_unique_name():
+                        D.build_train_net(hash_dim=101, embedding_size=4)
+                progs[flag] = prog
+        ops_off = _ops(progs[False])
+        assert not any(t.startswith("fused_") for t in ops_off)
+        assert ops_off.count("lookup_table") == 52
+        params = {
+            flag: sorted(p.name
+                         for p in progs[flag].global_block().all_parameters())
+            for flag in progs
+        }
+        assert params[True] == params[False]
+
+    def test_pass_skips_shared_table(self):
+        """Two lookups through ONE table (grad accumulation via sum)
+        must not coalesce — the fused grad contract is one table per
+        slot."""
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            with pt.core.framework.guard_unique_name():
+                a = layers.data(name="a", shape=[1], dtype="int64")
+                b = layers.data(name="b", shape=[1], dtype="int64")
+                e1 = layers.embedding(a, size=[VOCAB, DIM], is_sparse=True,
+                                      param_attr=pt.ParamAttr(name="shared"))
+                e2 = layers.embedding(b, size=[VOCAB, DIM], is_sparse=True,
+                                      param_attr=pt.ParamAttr(name="shared"))
+                layers.mean(layers.concat([e1, e2], axis=1))
+        assert passes.apply_pass("fused_embedding", prog) == 0
+        assert "fused_lookup_table" not in _ops(prog)
+
+    def test_pass_skips_non_lazy_adam_optimizer_tier(self):
+        """Non-lazy adam densifies per table — the lookup/grad tiers fuse
+        but the optimizer ops stay per-table."""
+        prog, _, _ = _build_mini(optimizer="adam_nonlazy", fused=True)
+        ops = _ops(prog)
+        assert ops.count("fused_lookup_table") == 1
+        assert "fused_sparse_adam" not in ops
+        assert ops.count("adam") == SLOTS + 2
+
+    def test_layers_fused_embedding_helper(self):
+        """The direct-build route: layers.fused_embedding emits the op,
+        backward flows through the fused grad maker, training learns."""
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            with pt.core.framework.guard_unique_name():
+                slots = [layers.data(name=f"s{i}", shape=[1], dtype="int64")
+                         for i in range(SLOTS)]
+                y = layers.data(name="y", shape=[1], dtype="int64")
+                embs = layers.fused_embedding(
+                    slots, size=[VOCAB, DIM], is_sparse=True,
+                    param_attrs=[pt.ParamAttr(name=f"tbl_{i}")
+                                 for i in range(SLOTS)])
+                h = layers.concat(embs, axis=1)
+                loss = layers.mean(layers.softmax_with_cross_entropy(
+                    layers.fc(h, size=2), y))
+                pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        ops = _ops(prog)
+        assert ops.count("fused_lookup_table") == 1
+        assert ops.count("fused_lookup_table_grad") == 1
+        prog.random_seed = 7
+        losses, _ = _train(prog, startup, loss,
+                           [_mini_batch()] * 6)
+        assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# trajectory parity: fused vs per-slot (the acceptance A/B)
+# ---------------------------------------------------------------------------
+
+
+class TestTrajectoryParity:
+    def _run_mini(self, fused, optimizer, is_sparse=True, steps=6):
+        prog, startup, loss = _build_mini(optimizer=optimizer,
+                                          is_sparse=is_sparse, fused=fused)
+        batches = [_mini_batch(seed=s) for s in range(steps)]
+        losses, scope = _train(prog, startup, loss, batches)
+        tables = {f"tbl_{i}": np.asarray(scope.find_var(f"tbl_{i}"))
+                  for i in range(SLOTS)}
+        moments = {
+            n: np.asarray(scope.find_var(n))
+            for n in scope.local_var_names()
+            if "moment" in n and scope.find_var(n) is not None
+        }
+        return losses, tables, moments
+
+    @pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+    def test_mini_parity_duplicate_ids(self, optimizer):
+        """Fused vs per-slot trajectories on duplicate-heavy batches:
+        losses, final tables AND (lazy-adam) row-sparse moments match —
+        the SelectedRows duplicate-row merge + lazy moment semantics of
+        the reference survive the fusion."""
+        lf, tf, mf = self._run_mini(True, optimizer)
+        lu, tu, mu = self._run_mini(False, optimizer)
+        np.testing.assert_allclose(lf, lu, rtol=2e-4, atol=2e-5)
+        for n in tf:
+            np.testing.assert_allclose(tf[n], tu[n], rtol=2e-4, atol=2e-5)
+        assert set(mf) == set(mu)
+        for n in mf:
+            np.testing.assert_allclose(mf[n], mu[n], rtol=2e-4, atol=2e-5)
+
+    def test_mini_parity_dense_grads(self):
+        """is_sparse=False: the fused backward runs the multi-table
+        scatter-add kernel into dense grads — trajectories must still
+        match the per-slot dense composition."""
+        lf, tf, _ = self._run_mini(True, "sgd", is_sparse=False)
+        lu, tu, _ = self._run_mini(False, "sgd", is_sparse=False)
+        np.testing.assert_allclose(lf, lu, rtol=2e-4, atol=2e-5)
+        for n in tf:
+            np.testing.assert_allclose(tf[n], tu[n], rtol=2e-4, atol=2e-5)
+
+    def test_deepfm_train_step_parity(self):
+        """The acceptance A/B on the real DeepFM train step (26 slots,
+        both table groups, lazy adam), duplicate-ids batch included."""
+        from paddle_tpu.models import deepfm as D
+
+        results = {}
+        for flag in (True, False):
+            with _fused(flag):
+                prog, startup = pt.Program(), pt.Program()
+                with pt.program_guard(prog, startup):
+                    with pt.core.framework.guard_unique_name():
+                        avg, _, _, _ = D.build_train_net(
+                            hash_dim=101, embedding_size=4)
+                prog.random_seed = 7
+                scope = pt.Scope()
+                exe = pt.Executor(pt.CPUPlace())
+                exe.run(startup, scope=scope)
+                batch = D.make_batch(32, hash_dim=101,
+                                     rng=np.random.RandomState(0))
+                for i in range(26):  # plant within-batch duplicates
+                    batch[f"C{i}"][5:10] = batch[f"C{i}"][0]
+                losses = []
+                for _ in range(5):
+                    (l,) = exe.run(prog, feed=batch, fetch_list=[avg],
+                                   scope=scope)
+                    losses.append(float(np.asarray(l)))
+                results[flag] = (losses,
+                                 np.asarray(scope.find_var("deepfm_emb_3")))
+        np.testing.assert_allclose(results[True][0], results[False][0],
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(results[True][1], results[False][1],
+                                   rtol=2e-4, atol=2e-5)
+        assert results[True][0][-1] < results[True][0][0]
+
+    def test_checkpoint_interop_across_flag(self):
+        """Params trained on the fused path load into a flag-off program
+        (same names/shapes) and produce the identical next step."""
+        prog_f, startup_f, loss_f = _build_mini(fused=True)
+        batches = [_mini_batch(seed=s) for s in range(3)]
+        _, scope_f = _train(prog_f, startup_f, loss_f, batches)
+
+        prog_u, startup_u, loss_u = _build_mini(fused=False)
+        scope_u = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup_u, scope=scope_u)
+        for n in scope_u.local_var_names():
+            v = scope_f.find_var(n)
+            if v is not None:
+                # materialized copy: the flag-off run donates its buffers,
+                # which must not delete the fused scope's arrays
+                scope_u.set_var(n, np.array(np.asarray(v)))
+        nxt = _mini_batch(seed=9)
+        (lu,) = exe.run(prog_u, feed=nxt, fetch_list=[loss_u], scope=scope_u)
+        exe_f = pt.Executor(pt.CPUPlace())
+        (lf,) = exe_f.run(prog_f, feed=nxt, fetch_list=[loss_f],
+                          scope=scope_f)
+        np.testing.assert_allclose(float(np.asarray(lf)),
+                                   float(np.asarray(lu)),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# dispatch census + convert hoist (tools/hlo_diag.py --sparse)
+# ---------------------------------------------------------------------------
+
+
+class TestSparseCensus:
+    def _lower(self, fused):
+        import jax
+
+        prog, startup, loss = _build_mini(fused=fused)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        # run_steps keeps the jitted handle for AOT lowering
+        # (tools/hlo_diag.py lower_entry idiom)
+        feed = {k: v[None] for k, v in _mini_batch().items()}
+        exe.run_steps(prog, feed=feed, fetch_list=[loss], scope=scope)
+        (entry,) = [e for e in exe._cache.values() if e.jitted is not None]
+        rw = [scope.find_var(n) for n in entry.rw_state]
+        ro = [scope.find_var(n) for n in entry.ro_state]
+        feed_names = sorted(feed)
+        feed_vals = [exe._to_device_array(prog, n, feed[n])
+                     for n in feed_names]
+        lowered = entry.jitted.lower(feed_vals, rw, ro,
+                                     jax.random.PRNGKey(0))
+        return lowered.compile().as_text(), prog
+
+    def test_fused_census_collapse_and_convert_hoist(self):
+        """Satellites 1+2: the fused step's HLO drops the per-slot gather
+        tier (one launch per group) and the per-slot int64->int32
+        converts (one hoisted cast on the stacked ids)."""
+        hd = _hlo_diag()
+        txt_f, prog_f = self._lower(True)
+        txt_u, prog_u = self._lower(False)
+        rep_f = hd.analyze_sparse(txt_f, prog_f)
+        rep_u = hd.analyze_sparse(txt_u, prog_u)
+        # graph-level launch collapse: one fused gather for all slots
+        assert rep_u["graph"]["gather_launches"] == SLOTS
+        assert rep_f["graph"]["gather_launches"] == 1
+        assert rep_f["graph"]["optimizer_launches"] \
+            < rep_u["graph"]["optimizer_launches"]
+        # HLO-level: the per-slot embedding gathers are gone (residual
+        # gathers belong to the loss, not the lookup tier)
+        assert rep_f["hlo_gather"] <= rep_u["hlo_gather"] - (SLOTS - 1)
+        # convert hoist: per-slot casts collapse to the one stacked cast
+        assert rep_f["hlo_convert"] < rep_u["hlo_convert"]
+
+    def test_deepfm_graph_launch_targets(self):
+        """The acceptance numbers on the full CTR net (graph level, no
+        compile): ONE gather launch per 26-slot table group and >= 10x
+        fewer sparse optimizer applies."""
+        from paddle_tpu.models import deepfm as D
+
+        counts = {}
+        for flag in (True, False):
+            with _fused(flag):
+                prog = pt.Program()
+                with pt.program_guard(prog, pt.Program()):
+                    with pt.core.framework.guard_unique_name():
+                        D.build_train_net(hash_dim=101, embedding_size=4)
+            ops = _ops(prog)
+            counts[flag] = ops
+        assert counts[True].count("fused_lookup_table") == 2
+        assert counts[True].count("lookup_table") == 0
+        sparse_applies_unfused = counts[False].count("adam") - 8  # fc tier
+        sparse_applies_fused = counts[True].count("fused_sparse_adam")
+        assert sparse_applies_unfused == 52
+        assert sparse_applies_fused == 2
+        assert sparse_applies_unfused / sparse_applies_fused >= 10
+
+
+# ---------------------------------------------------------------------------
+# monitor gauges (satellite 6) + pipelined ingest
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetry:
+    def test_embedding_gauges_set_per_traced_step(self):
+        import paddle_tpu.monitor as monitor
+
+        monitor.default_registry().reset()
+        FLAGS.monitor = True
+        try:
+            prog, startup, loss = _build_mini(fused=True)
+            _train(prog, startup, loss, [_mini_batch()])
+            reg = monitor.default_registry()
+            g = reg.get("embedding.gather_launches")
+            rows = reg.get("embedding.sparse_rows_touched")
+            assert g is not None and g.value == 1
+            assert rows is not None and rows.value == SLOTS * 32
+        finally:
+            FLAGS.reset("monitor")
+            monitor.default_registry().reset()
+
+    def test_embedding_gauges_zero_cost_off(self):
+        import paddle_tpu.monitor as monitor
+
+        monitor.default_registry().reset()
+        prog, startup, loss = _build_mini(fused=True)
+        _train(prog, startup, loss, [_mini_batch()])
+        assert monitor.default_registry().get(
+            "embedding.gather_launches") is None
+
+    def test_per_slot_path_counts_every_launch(self):
+        import paddle_tpu.monitor as monitor
+
+        monitor.default_registry().reset()
+        FLAGS.monitor = True
+        try:
+            prog, startup, loss = _build_mini(fused=False)
+            _train(prog, startup, loss, [_mini_batch()])
+            g = monitor.default_registry().get("embedding.gather_launches")
+            assert g is not None and g.value == SLOTS
+        finally:
+            FLAGS.reset("monitor")
+            monitor.default_registry().reset()
+
+
+class TestPipelinedIngest:
+    def _files(self, tmp_path, n_files=2, lines=24):
+        rng = np.random.RandomState(5)
+        files = []
+        for fi in range(n_files):
+            path = tmp_path / f"part-{fi}.txt"
+            with open(path, "w") as f:
+                for _ in range(lines):
+                    ids = rng.randint(0, VOCAB, 3)
+                    label = float(ids[0] % 2)
+                    f.write("3 " + " ".join(map(str, ids))
+                            + f" 1 {label}\n")
+            files.append(str(path))
+        return files
+
+    def _net(self):
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            with pt.core.framework.guard_unique_name():
+                ids = layers.data(name="ids", shape=[8], dtype="int64")
+                label = layers.data(name="label", shape=[1],
+                                    dtype="float32")
+                emb = layers.embedding(
+                    layers.reshape(ids, [-1, 8, 1]), size=[VOCAB, DIM])
+                pooled = layers.reduce_sum(emb, dim=1)
+                logit = layers.fc(pooled, size=1)
+                loss = layers.mean(
+                    layers.sigmoid_cross_entropy_with_logits(logit, label))
+                pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        prog.random_seed = 3
+        return prog, startup, loss
+
+    def _desc(self):
+        desc = pt.DataFeedDesc(batch_size=8, name="ctr")
+        desc.add_slot("ids", type="uint64", max_len=8, id_space=VOCAB)
+        desc.add_slot("label", type="float", is_dense=True, dim=1)
+        return desc
+
+    def test_pipelined_matches_strict_loop(self, tmp_path):
+        """Double-buffered ingest returns the identical per-batch fetches
+        (same batches, same order, same values) as the strict
+        parse->put->run->sync loop."""
+        files = self._files(tmp_path)
+        results = {}
+        for pipeline in (False, True):
+            prog, startup, loss = self._net()
+            scope = pt.Scope()
+            aexe = pt.AsyncExecutor(pt.CPUPlace())
+            aexe.executor.run(startup, scope=scope)
+            res = aexe.run_from_files(
+                prog, self._desc(), files, thread_num=1,
+                fetch_list=[loss], scope=scope, pipeline=pipeline)
+            results[pipeline] = [r[0] for r in res]
+        assert len(results[True]) == len(results[False]) > 0
+        np.testing.assert_allclose(results[True], results[False],
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_pipelined_ingest_telemetry(self, tmp_path):
+        import paddle_tpu.monitor as monitor
+
+        monitor.default_registry().reset()
+        FLAGS.monitor = True
+        try:
+            files = self._files(tmp_path)
+            prog, startup, loss = self._net()
+            scope = pt.Scope()
+            aexe = pt.AsyncExecutor(pt.CPUPlace())
+            aexe.executor.run(startup, scope=scope)
+            aexe.run_from_files(prog, self._desc(), files, thread_num=1,
+                                fetch_list=[loss], scope=scope,
+                                pipeline=True)
+            reg = monitor.default_registry()
+            assert reg.get("data_feed.pipelined_batches").value > 0
+            assert reg.get("data_feed.inflight_steps").value == 0  # drained
+            assert reg.get("data_feed.batches").value > 0
+        finally:
+            FLAGS.reset("monitor")
+            monitor.default_registry().reset()

@@ -4,8 +4,8 @@ step: counts copy/transpose/custom-call instructions by shape and locates
 them relative to the flash-attention custom-calls.  Perf tooling for
 PERF.md leads 1-2 (attention layout copies, scan-carry copies).
 
-Usage: python tools/hlo_diag.py [transformer|transformer_noflash|resnet50]
-           [out.txt] [--bn-fusion]
+Usage: python tools/hlo_diag.py [transformer|transformer_noflash|resnet50
+           |deepfm] [out.txt] [--bn-fusion] [--sparse]
 
 --bn-fusion (resnet50): the round-7 BN-wall attribution report — counts
 the BN-statistics channel reductions (full passes over 3/4-D activations
@@ -16,6 +16,16 @@ those reduction passes re-read.  Run it with FLAGS_fused_bn=0 vs =1 (env
 var) and diff the counters: the A/B attribution of the fused-BN levers is
 mechanical (tests/test_conv_bn.py asserts the fused path removes the
 reduction passes).
+
+--sparse (deepfm): the round-8 dispatch/launch census of the CTR step —
+graph-level op counts (per-slot lookup_table / grad / optimizer chains
+vs their fused_* group forms) and the HLO instruction census the sparse
+tier lowers to (gather / scatter / dynamic-slice tiers + the bytes the
+gathers move + int64->int32 convert count).  Run with
+FLAGS_fused_embedding=0 vs =1 and diff: the fused path must show the
+launch-count collapse (one fused gather per table group, the per-table
+sort+segment+scatter optimizer chains collapsed to one group apply) —
+asserted in tests/test_fused_embedding.py.
 """
 
 import os
@@ -79,6 +89,26 @@ def compile_resnet50(scan_steps=4, batch_size=256, image_size=224,
         "label": rng.randint(0, 1000,
                              (scan_steps, batch_size, 1)).astype("int64"),
     }
+    return exe, prog, feed, [avg_cost], scope
+
+
+def compile_deepfm(scan_steps=2, batch_size=256, hash_dim=10001,
+                   embedding_size=10, optimizer="adam"):
+    import paddle_tpu as pt
+    from paddle_tpu.models import deepfm as D
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        avg_cost, _, _, _ = D.build_train_net(
+            hash_dim=hash_dim, embedding_size=embedding_size,
+            optimizer=optimizer)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    batches = [D.make_batch(batch_size, hash_dim=hash_dim,
+                            rng=np.random.RandomState(s))
+               for s in range(scan_steps)]
+    feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
     return exe, prog, feed, [avg_cost], scope
 
 
@@ -254,9 +284,92 @@ def format_bn_fusion(rep):
     return "\n".join(out)
 
 
+# --sparse: the round-8 dispatch/launch census of the sparse CTR tier ------
+
+_SPARSE_GRAPH_OPS = (
+    "lookup_table", "fused_lookup_table",
+    "lookup_table_grad", "fused_lookup_table_grad",
+    "sgd", "adam", "fused_sparse_sgd", "fused_sparse_adam",
+)
+# HLO opcodes the per-slot sparse tier lowers to.  `sort` counts the
+# per-table MergeAdd argsorts (the fused path runs ONE batched sort per
+# group); the dynamic-slice tiers are where the fused kernels' emulated /
+# compiled row DMAs land.
+_SPARSE_HLO_OPS = ("gather", "scatter", "dynamic-slice",
+                   "dynamic-update-slice", "convert", "sort", "while")
+# tuple-result instructions (sort/while): `%x = (f32[8]{0}, ...) sort(`
+_TUPLE_INSTR_RE = re.compile(r"%?[\w.-]+ = \(.*?\)(?:\{[\d,]*\})? ([a-z0-9-]+)\(")
+
+
+def analyze_sparse(txt, program=None):
+    """Dispatch census from optimized-HLO text (+ graph-level op counts
+    when the Program is given): how many gather/scatter/optimizer
+    dispatches one CTR step issues, and the bytes the gathers move.
+    Diff FLAGS_fused_embedding=0 vs =1: the fused path collapses the
+    52-launch lookup tier to one fused gather per table group and the
+    per-table optimizer chains to one group apply."""
+    hlo = {f"hlo_{k}": 0 for k in _SPARSE_HLO_OPS}
+    gather_bytes = 0
+    for ln in txt.splitlines():
+        s = ln.strip()
+        m = INSTR_RE.match(s)
+        if not m:
+            # sort (variadic argsort) and while carry TUPLE-shaped
+            # results — `%x = (f32[8]{0}, s32[8]{0}) sort(...)` — which
+            # INSTR_RE's array-shape pattern never matches
+            m2 = _TUPLE_INSTR_RE.match(s)
+            if m2 and m2.group(1) in _SPARSE_HLO_OPS:
+                hlo[f"hlo_{m2.group(1)}"] += 1
+            continue
+        _, dt, dims, _, opcode = m.groups()
+        if opcode in _SPARSE_HLO_OPS:
+            hlo[f"hlo_{opcode}"] += 1
+            if opcode == "gather":
+                gather_bytes += DT_BYTES.get(dt, 4) * int(
+                    np.prod([int(x) for x in dims.split(",") if x] or [1]))
+    rep = {
+        "graph": {},
+        **hlo,
+        "hlo_gather_mb": round(gather_bytes / 1e6, 3),
+    }
+    if program is not None:
+        ops = [op.type for op in program.global_block().ops]
+        rep["graph"] = {t: ops.count(t) for t in _SPARSE_GRAPH_OPS}
+        g = rep["graph"]
+        rep["graph"]["gather_launches"] = (
+            g["lookup_table"] + g["fused_lookup_table"])
+        rep["graph"]["sparse_grad_launches"] = (
+            g["lookup_table_grad"] + g["fused_lookup_table_grad"])
+        rep["graph"]["optimizer_launches"] = (
+            g["sgd"] + g["adam"] + g["fused_sparse_sgd"]
+            + g["fused_sparse_adam"])
+    return rep
+
+
+def format_sparse(rep):
+    out = ["== sparse dispatch census (PERF.md r08 attribution) =="]
+    g = rep.get("graph") or {}
+    if g:
+        out.append(
+            f"  graph: gather launches {g['gather_launches']} "
+            f"(lookup_table {g['lookup_table']} + fused "
+            f"{g['fused_lookup_table']}), grad launches "
+            f"{g['sparse_grad_launches']}, optimizer launches "
+            f"{g['optimizer_launches']} (fused sparse "
+            f"{g['fused_sparse_sgd'] + g['fused_sparse_adam']})")
+    out.append(
+        f"  HLO: {rep['hlo_gather']} gather ({rep['hlo_gather_mb']} MB "
+        f"moved/step-call), {rep['hlo_scatter']} scatter, "
+        f"{rep['hlo_sort']} sort, {rep['hlo_dynamic-slice']}/"
+        f"{rep['hlo_dynamic-update-slice']} dyn-slice/update, "
+        f"{rep['hlo_convert']} convert, {rep['hlo_while']} while")
+    return "\n".join(out)
+
+
 def main():
     argv = [a for a in sys.argv[1:] if not a.startswith("--")]
     bn_fusion = "--bn-fusion" in sys.argv[1:]
+    sparse = "--sparse" in sys.argv[1:]
     which = argv[0] if argv else "transformer"
     out_path = argv[1] if len(argv) > 1 else f"/tmp/hlo_{which}.txt"
     if which == "transformer":
@@ -265,6 +378,8 @@ def main():
         args = compile_transformer(use_flash=False)
     elif which == "resnet50":
         args = compile_resnet50()
+    elif which == "deepfm":
+        args = compile_deepfm()
     else:
         raise SystemExit(f"unknown workload {which}")
     txt = lower_entry(*args)
@@ -274,6 +389,8 @@ def main():
     print(analyze(txt))
     if bn_fusion:
         print(format_bn_fusion(analyze_bn_fusion(txt)))
+    if sparse:
+        print(format_sparse(analyze_sparse(txt, args[1])))
 
 
 if __name__ == "__main__":

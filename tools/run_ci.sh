@@ -51,6 +51,25 @@ if [[ "${1:-}" != "fast" ]]; then
   python -W error::UserWarning bench.py --model convbn --smoke \
     | tee ci_artifacts/bench_convbn_smoke.json
   echo "-- convbn A/B record artifact: ci_artifacts/bench_convbn_smoke.json"
+  # DeepFM sparse-tier leg (PERF.md r08 A/B): the fused multi-table
+  # embedding record next to its FLAGS_fused_embedding=0 per-slot
+  # baseline, both under the warnings gate; the paired records (config
+  # carries the flag + runs[]/spread) are the launch-collapse A/B artifact
+  python -W error::UserWarning bench.py --model deepfm --smoke \
+    | tee ci_artifacts/bench_deepfm_smoke.json
+  FLAGS_fused_embedding=0 python -W error::UserWarning bench.py \
+    --model deepfm --smoke | tee -a ci_artifacts/bench_deepfm_smoke.json
+  python - <<'PY'
+import json
+recs = [json.loads(l) for l in open("ci_artifacts/bench_deepfm_smoke.json")
+        if l.strip().startswith("{")]
+recs = [r for r in recs if r.get("metric", "").startswith("deepfm")]
+flags = {r["config"]["fused_embedding"] for r in recs}
+assert flags == {True, False}, f"need a fused AND an unfused record: {flags}"
+print("deepfm A/B records OK:", [(r["config"]["fused_embedding"],
+                                  r["value"]) for r in recs])
+PY
+  echo "-- deepfm A/B record artifact: ci_artifacts/bench_deepfm_smoke.json"
   echo "-- metrics snapshot:"
   head -40 ci_artifacts/metrics.prom || true
   echo "-- flight record (black box of the smoke run):"

@@ -111,6 +111,17 @@ def watchdog_trips(doc: dict):
             if ev.get("kind") == "watchdog.trip"]
 
 
+def embedding_census(doc: dict):
+    """Last sparse-tier trace census (gather launches / rows touched per
+    step — the embedding.* gauges, mirrored into the flight ring at
+    trace time by core/executor.py)."""
+    last = None
+    for ev in doc.get("flight", {}).get("events", []):
+        if ev.get("kind") == "embedding.census":
+            last = ev
+    return last
+
+
 def report(doc: dict, k: int = 20) -> str:
     lines = []
     hdr = doc.get("flight", {}).get("header", {})
@@ -151,6 +162,14 @@ def report(doc: dict, k: int = 20) -> str:
             lines.append(f"  {comp:<32} x{n}")
     else:
         lines.append("Recompiles: none recorded")
+
+    census = embedding_census(doc)
+    if census:
+        lines.append("")
+        lines.append("Sparse embedding census (per traced step)")
+        lines.append(f"  gather launches      {census.get('gather_launches')}")
+        lines.append(
+            f"  sparse rows touched  {census.get('sparse_rows_touched')}")
 
     trips = watchdog_trips(doc)
     if trips:
