@@ -40,6 +40,7 @@ WHITE_OPS = frozenset({
     "mul",
     "matmul",
     "fused_attention",
+    "fused_qkv_attention",
     "ring_attention",
 })
 

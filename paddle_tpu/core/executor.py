@@ -327,12 +327,12 @@ def _iter_ops_recursive(block: fw.Block):
 def program_uses_random(block: fw.Block) -> bool:
     """Whether lowering may draw PRNG bits (then the compiled fn takes a key
     argument).  Grad ops count: the generic vjp re-traces forward lowerings.
-    fused_attention counts only when its in-kernel weights dropout is on
-    (its mask seed derives from the step key)."""
+    fused_attention / fused_qkv_attention count only when their in-kernel
+    weights dropout is on (the mask seed derives from the step key)."""
     return any(
         op.type in _RANDOM_OPS
         or op.type.endswith("_grad")
-        or (op.type == "fused_attention"
+        or (op.type in ("fused_attention", "fused_qkv_attention")
             and op.attrs.get("dropout_rate", 0.0))
         for op in _iter_ops_recursive(block)
     )

@@ -95,45 +95,75 @@ def _chunk_bwd_xla(q, k, v, kbias, out, lse, g, scale, causal):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _chunk_fwd(q, k, v, kbias, scale, causal, block_q, block_k):
+def _to_bhtd(x, fmt):
+    return x.transpose(0, 2, 1, 3) if fmt == "bthd" else x
+
+
+def _chunk_fwd(q, k, v, kbias, scale, causal, block_q, block_k,
+               fmt="bhtd"):
     """One ring step's partial attention: Pallas flash kernel when the
     plan allows, XLA chunk otherwise.  Returns (o, lse') with the -inf
-    empty-row convention."""
+    empty-row convention.  fmt "bthd" runs the whole-head kernels on the
+    SAME block specs as the single-device path (attention.py _qkv_specs)
+    — the per-device shards stay [b, t_local, h, d] and no split-head
+    transpose exists anywhere on the ring (the relayout-copy class the
+    bthd kernels were built to kill); only the XLA fallback transposes."""
     from .attention import _flash_forward, _plan
 
-    ok, bq, bk, interp = _plan(q, k, block_q, block_k, None, "bhtd")
+    ok, bq, bk, interp = _plan(q, k, block_q, block_k, None, fmt)
     if not ok:
+        if fmt == "bthd":
+            o, lse = _chunk_fwd_xla(_to_bhtd(q, fmt), _to_bhtd(k, fmt),
+                                    _to_bhtd(v, fmt), kbias, scale, causal)
+            return o.transpose(0, 2, 1, 3), lse
         return _chunk_fwd_xla(q, k, v, kbias, scale, causal)
     import jax.numpy as jnp
 
     seed = jnp.zeros((1,), jnp.uint32)
     out, lse = _flash_forward(q, k, v, kbias, seed, scale, causal, bq, bk,
-                              interp, "bhtd", 0.0)
+                              interp, fmt, 0.0)
     return out, _pinf_to_ninf(lse)
 
 
 def _chunk_bwd(q, k, v, kbias, out, lse, g, scale, causal, block_q,
-               block_k):
+               block_k, fmt="bhtd"):
     """One ring step's backward (against global out/lse): Pallas backward
     kernels when possible, XLA otherwise.  `lse` uses the kernel's +inf
     convention for globally-empty rows."""
     from .attention import _flash_backward, _plan
 
-    ok, bq, bk, interp = _plan(q, k, block_q, block_k, None, "bhtd")
+    ok, bq, bk, interp = _plan(q, k, block_q, block_k, None, fmt)
     if not ok:
+        if fmt == "bthd":
+            dq, dk, dv = _chunk_bwd_xla(
+                _to_bhtd(q, fmt), _to_bhtd(k, fmt), _to_bhtd(v, fmt),
+                kbias, _to_bhtd(out, fmt), lse, _to_bhtd(g, fmt), scale,
+                causal)
+            return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+                    dv.transpose(0, 2, 1, 3))
         return _chunk_bwd_xla(q, k, v, kbias, out, lse, g, scale, causal)
     import jax.numpy as jnp
 
     seed = jnp.zeros((1,), jnp.uint32)
     return _flash_backward(q, k, v, kbias, seed, out, lse, g, scale,
-                           causal, bq, bk, interp, "bhtd", 0.0)
+                           causal, bq, bk, interp, fmt, 0.0)
 
 
-def _zeros_like_chunk(q, axis_name):
+def _stat_bcast(stat, fmt):
+    """[b, h, t] per-row statistic -> broadcastable against the chunk
+    output layout ([b, h, t, 1] bhtd / [b, t, h, 1] bthd)."""
+    if fmt == "bthd":
+        stat = stat.transpose(0, 2, 1)
+    return stat[..., None]
+
+
+def _zeros_like_chunk(q, axis_name, fmt="bhtd"):
     import jax
     import jax.numpy as jnp
 
-    b, h, t, _ = q.shape
+    from .attention import _dims
+
+    b, h, t, _ = _dims(q, fmt)
     # pvary: constants made inside a shard_map are unvaried over the mesh
     # axis; lax.cond demands both branches match the compute branch's
     # device-varying type
@@ -143,22 +173,25 @@ def _zeros_like_chunk(q, axis_name):
             pvary(jnp.full((b, h, t), -jnp.inf, jnp.float32), axis_name))
 
 
-def _ring_fwd(q, k, v, kbias, axis_name, scale, causal, block_q, block_k):
+def _ring_fwd(q, k, v, kbias, axis_name, scale, causal, block_q, block_k,
+              fmt="bhtd"):
     """Forward ring.  Returns (out, lse) with lse=+inf on rows that saw no
-    key anywhere (kernel convention, ready for _chunk_bwd)."""
+    key anywhere (kernel convention, ready for _chunk_bwd).  Shards are in
+    `fmt` layout; per-row statistics always ride [b, h, t]."""
     import jax
     import jax.numpy as jnp
 
+    from .attention import _dims
     from .jax_compat import axis_size
 
     n = axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
 
-    b, h, t, d = q.shape
+    b, h, t, d = _dims(q, fmt)
     m = jnp.full((b, h, t), -jnp.inf, jnp.float32)
     den = jnp.zeros((b, h, t), jnp.float32)
-    acc = jnp.zeros((b, h, t, d), jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
 
     k_cur, v_cur, kb_cur = k, v, kbias
 
@@ -168,15 +201,15 @@ def _ring_fwd(q, k, v, kbias, axis_name, scale, causal, block_q, block_k):
         def full_fn(args):
             qq, kk, vv, bb = args
             return _chunk_fwd(qq, kk, vv, bb, scale, False, block_q,
-                              block_k)
+                              block_k, fmt)
 
         def diag_fn(args):
             qq, kk, vv, bb = args
             return _chunk_fwd(qq, kk, vv, bb, scale, True, block_q,
-                              block_k)
+                              block_k, fmt)
 
         def skip_fn(args):
-            return _zeros_like_chunk(args[0], axis_name)
+            return _zeros_like_chunk(args[0], axis_name, fmt)
 
         args = (q, k_cur, v_cur, kb_cur)
         if not causal:
@@ -195,8 +228,8 @@ def _ring_fwd(q, k, v, kbias, axis_name, scale, causal, block_q, block_k):
         alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_safe))
         beta = jnp.exp(jnp.where(jnp.isneginf(lse_i), -jnp.inf,
                                  lse_i - m_safe))
-        acc = acc * alpha[..., None] + o_i.astype(jnp.float32) * beta[
-            ..., None]
+        acc = (acc * _stat_bcast(alpha, fmt)
+               + o_i.astype(jnp.float32) * _stat_bcast(beta, fmt))
         den = den * alpha + beta
         m = m_new
 
@@ -207,15 +240,15 @@ def _ring_fwd(q, k, v, kbias, axis_name, scale, causal, block_q, block_k):
                 kb_cur = jax.lax.ppermute(kb_cur, axis_name, fwd_perm)
 
     den_safe = jnp.where(den == 0.0, 1.0, den)
-    out = jnp.where(den[..., None] == 0.0, 0.0,
-                    acc / den_safe[..., None]).astype(q.dtype)
+    out = jnp.where(_stat_bcast(den, fmt) == 0.0, 0.0,
+                    acc / _stat_bcast(den_safe, fmt)).astype(q.dtype)
     lse = jnp.where(den == 0.0, jnp.inf,
                     jnp.where(jnp.isneginf(m), 0.0, m) + jnp.log(den_safe))
     return out, lse
 
 
 def _ring_bwd(q, k, v, kbias, out, lse, g, axis_name, scale, causal,
-              block_q, block_k):
+              block_q, block_k, fmt="bhtd"):
     """Backward ring: K/V (and their traveling dK/dV accumulators)
     circulate again; residual memory stays O(t_local)."""
     import jax
@@ -238,12 +271,12 @@ def _ring_bwd(q, k, v, kbias, out, lse, g, axis_name, scale, causal,
         def full_fn(args):
             qq, kk, vv, bb = args
             return _chunk_bwd(qq, kk, vv, bb, out, lse, g, scale, False,
-                              block_q, block_k)
+                              block_q, block_k, fmt)
 
         def diag_fn(args):
             qq, kk, vv, bb = args
             return _chunk_bwd(qq, kk, vv, bb, out, lse, g, scale, True,
-                              block_q, block_k)
+                              block_q, block_k, fmt)
 
         def skip_fn(args):
             qq, kk, vv, _ = args
@@ -284,11 +317,15 @@ def _ring_bwd(q, k, v, kbias, out, lse, g, axis_name, scale, causal,
 
 
 def ring_attention(q, k, v, axis_name, scale=1.0, causal=False, kbias=None,
-                   block_q=512, block_k=512):
+                   block_q=512, block_k=512, fmt="bhtd"):
     """Runs INSIDE shard_map: q,k,v are the per-device sequence shards
-    [b, h, t_local, d]; optional kbias [b|1, 1, 1, t_local] is an additive
-    key bias (padding mask) that travels the ring with its K/V chunk.
-    Exact softmax attention over the full (sharded) sequence."""
+    [b, h, t_local, d] (fmt "bhtd") or [b, t_local, h, d] (fmt "bthd" —
+    the transpose-free convention: the ring path reuses the single-device
+    bthd whole-head block specs, so context parallelism does not
+    re-introduce the split/merge-head transposes the bthd kernels
+    deleted); optional kbias [b|1, 1, 1, t_local] is an additive key bias
+    (padding mask) that travels the ring with its K/V chunk.  Exact
+    softmax attention over the full (sharded) sequence."""
     import jax
 
     have_bias = kbias is not None
@@ -296,19 +333,21 @@ def ring_attention(q, k, v, axis_name, scale=1.0, causal=False, kbias=None,
     @functools.partial(jax.custom_vjp, nondiff_argnums=())
     def _ring(q, k, v, kbias):
         out, _ = _ring_fwd(q, k, v, kbias if have_bias else None,
-                           axis_name, scale, causal, block_q, block_k)
+                           axis_name, scale, causal, block_q, block_k,
+                           fmt)
         return out
 
     def _fwd(q, k, v, kbias):
         out, lse = _ring_fwd(q, k, v, kbias if have_bias else None,
-                             axis_name, scale, causal, block_q, block_k)
+                             axis_name, scale, causal, block_q, block_k,
+                             fmt)
         return out, (q, k, v, kbias, out, lse)
 
     def _bwd(res, g):
         q, k, v, kbias, out, lse = res
         dq, dk, dv = _ring_bwd(q, k, v, kbias if have_bias else None, out,
                                lse, g, axis_name, scale, causal, block_q,
-                               block_k)
+                               block_k, fmt)
         import jax.numpy as jnp
 
         return dq, dk, dv, jnp.zeros_like(kbias)
@@ -318,39 +357,52 @@ def ring_attention(q, k, v, axis_name, scale=1.0, causal=False, kbias=None,
     if kbias is None:
         import jax.numpy as jnp
 
-        kbias = jnp.zeros((1, 1, 1, q.shape[2]), jnp.float32)
+        t_local = q.shape[1] if fmt == "bthd" else q.shape[2]
+        kbias = jnp.zeros((1, 1, 1, t_local), jnp.float32)
     return _ring(q, k, v, kbias)
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=1.0,
-                           causal=False):
-    """Whole-array entry: q,k,v are global [b, h, T, d] arrays; the
-    sequence dim shards over `axis_name` of `mesh`; returns global output
-    with the same sharding.  T that does not divide the axis is padded and
-    the pad keys masked via the ring-traveling key bias."""
+                           causal=False, fmt="bhtd"):
+    """Whole-array entry: q,k,v are global [b, h, T, d] (fmt "bhtd") or
+    [b, T, h, d] (fmt "bthd") arrays; the sequence dim shards over
+    `axis_name` of `mesh`; returns global output with the same sharding.
+    T that does not divide the axis is padded and the pad keys masked via
+    the ring-traveling key bias."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from .attention import _dims
     from .jax_compat import shard_map as _shard_map
 
     n = mesh.shape[axis_name]
-    b, h, t, d = q.shape
+    b, h, t, d = _dims(q, fmt)
+    tdim = 1 if fmt == "bthd" else 2
     pad = (-t) % n
     kbias = None
     if pad:
-        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        widths = [(0, 0)] * 4
+        widths[tdim] = (0, pad)
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
         pos = jnp.arange(t + pad)
         kbias = jnp.where(pos < t, 0.0, -1e30).astype(jnp.float32).reshape(
             1, 1, 1, t + pad)
 
-    spec = P(None, None, axis_name, None)
+    # batch stays data-parallel INSIDE the ring when the mesh has a data
+    # axis: declaring it in the shard_map specs keeps the incoming
+    # (data, sp)-sharded activations in place — leaving it out forces
+    # the partitioner to all-gather the batch dim at the boundary
+    # ("involuntary full rematerialization" in the dp x tp x sp dryrun)
+    baxis = "data" if "data" in getattr(mesh, "axis_names", ()) else None
+    spec = (P(baxis, axis_name, None, None) if fmt == "bthd"
+            else P(baxis, None, axis_name, None))
     if kbias is None:
         fn = _shard_map(
             functools.partial(ring_attention, axis_name=axis_name,
-                              scale=scale, causal=causal),
+                              scale=scale, causal=causal, fmt=fmt),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )
@@ -358,9 +410,9 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=1.0,
     kb_spec = P(None, None, None, axis_name)   # kbias seq dim is LAST
     fn = _shard_map(
         lambda q, k, v, kb: ring_attention(q, k, v, axis_name, scale,
-                                           causal, kbias=kb),
+                                           causal, kbias=kb, fmt=fmt),
         mesh=mesh, in_specs=(spec, spec, spec, kb_spec), out_specs=spec,
         check_vma=False,
     )
     out = fn(q, k, v, kbias)
-    return out[:, :, :t]
+    return out[:, :t] if fmt == "bthd" else out[:, :, :t]

@@ -56,11 +56,70 @@ def fused_attention(q, k, v, bias=None, scale=1.0, causal=False,
     return out
 
 
+def fused_qkv_attention(x, n_head, d_key, d_model, bias=None, scale=1.0,
+                        causal=False, dropout_rate=0.0, block_q=512,
+                        block_k=512, qkv_param_attr=None,
+                        out_param_attr=None, name=None):
+    """Self-attention layer with the q/k/v AND output projections fused
+    into the flash-attention kernels (ops/fused_ops.py
+    fused_qkv_attention; kernels/attention.py flash_qkv_attention).
+
+    Creates the SAME two parameters as the unfused fc + split +
+    fused_attention + fc composition — [d_model_in, 3*n_head*d_key]
+    packed qkv weight and [n_head*d_key, d_model] output weight, same
+    shapes, same default initializer — so checkpoints interop across
+    FLAGS_fused_qkv_attention (pass the unfused path's names via
+    qkv_param_attr/out_param_attr).  Weights-dropout semantics follow
+    fused_attention (reference dropout-on-softmax, mask never in HBM)."""
+    from ..core import framework as fw
+
+    dtype = x.dtype
+    # parameters ride the SAME LayerHelper("fc") name sequence as the
+    # unfused qkv-fc + output-fc pair (the conv2d_bn recipe): explicit
+    # attr names match trivially, and DEFAULT names — plus every later
+    # unnamed fc in the model — land on identical fc_N draws, so
+    # checkpoints interop across FLAGS_fused_qkv_attention (asserted in
+    # tests/test_fused_qkv_attention.py on the BERT builder, whose ffn/
+    # head fcs are unnamed)
+    qkv_helper = LayerHelper("fc", param_attr=qkv_param_attr)
+    w_qkv = qkv_helper.create_parameter(
+        qkv_helper.param_attr(), shape=[x.shape[-1], 3 * d_key * n_head],
+        dtype=dtype)
+    out_helper = LayerHelper("fc", param_attr=out_param_attr)
+    w_out = out_helper.create_parameter(
+        out_helper.param_attr(), shape=[d_key * n_head, d_model],
+        dtype=dtype)
+    helper = LayerHelper("fused_qkv_attention", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [x], "WQkv": [w_qkv], "WOut": [w_out]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op(
+        "fused_qkv_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={
+            "n_head": n_head,
+            "scale": float(scale),
+            "causal": causal,
+            "block_q": block_q,
+            "block_k": block_k,
+            "dropout_rate": float(dropout_rate),
+            "rng_id": fw.unique_rng_id() if dropout_rate else 0,
+        },
+    )
+    out.shape = tuple(x.shape[:-1]) + (d_model,)
+    return out
+
+
 def ring_attention(q, k, v, scale=1.0, causal=False, axis_name="sp",
-                   name=None):
-    """Context-parallel attention layer over [B,H,T,D] tensors: the T axis
-    shards over mesh axis `axis_name` (see ops/fused_ops.py ring_attention).
-    Use through a ShardingPlan whose mesh declares that axis."""
+                   fmt="bhtd", name=None):
+    """Context-parallel attention layer over [B,H,T,D] (fmt "bhtd") or
+    [B,T,H,D] (fmt "bthd" — the transpose-free convention; the ring path
+    reuses the single-device bthd block specs, so CP introduces no
+    split/merge-head transposes) tensors: the T axis shards over mesh
+    axis `axis_name` (see ops/fused_ops.py ring_attention).  Use through
+    a ShardingPlan whose mesh declares that axis."""
     helper = LayerHelper("ring_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     helper.append_op(
@@ -68,6 +127,7 @@ def ring_attention(q, k, v, scale=1.0, causal=False, axis_name="sp",
         inputs={"Q": [q], "K": [k], "V": [v]},
         outputs={"Out": [out]},
         attrs={"scale": float(scale), "causal": causal,
-               "axis_name": axis_name},
+               "axis_name": axis_name, "fmt": fmt},
     )
+    out.shape = q.shape
     return out

@@ -1,7 +1,16 @@
 """BERT-style encoder (BASELINE.md: "BERT-class (layer_norm/gelu/fused
 attention)"; built from the same primitives as the reference would be —
 layers/nn.py layer_norm:3030 + gelu + attention composed from matmul/softmax
-— but with the Pallas fused-attention path available via use_flash)."""
+— but with the Pallas fused-attention path available via use_flash).
+
+Under use_flash the self-attention sites ride transformer.py's
+multi_head_attention selection: with FLAGS_fused_qkv_attention (default
+on) each site lowers to ONE fused_qkv_attention op whose kernels compute
+the qkv/output projection dots in-VMEM (PERF.md round 9 — q/k/v never
+exist in HBM); flag off emits the fc+split+fused_attention+fc
+composition, with parameter names unchanged either way (the unnamed
+ffn/head fc parameters keep their fc_N draws — checkpoints interop,
+asserted in tests/test_fused_qkv_attention.py)."""
 
 from __future__ import annotations
 

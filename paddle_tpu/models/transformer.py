@@ -44,6 +44,30 @@ def multi_head_attention(
     # transformer_tp_rules) address these by regex
     from ..core.framework import unique_name
 
+    if is_self and d_key == d_value and use_flash and not use_ring:
+        from ..flags import FLAGS
+
+        if FLAGS.fused_qkv_attention:
+            # ONE op: the qkv AND output projection dots run inside the
+            # flash kernels (kernels/attention.py flash_qkv_attention) —
+            # q/k/v never exist in HBM, so the dot-preferred<->custom-call
+            # relayout copies at the projection boundaries (PERF.md r09
+            # lead 1, ~1.2 GB/step) have nothing to convert.  Parameter
+            # names and shapes are EXACTLY the flag-off path's (the same
+            # unique_name draws, the same packed [d_model, 3hd] /
+            # [hd, d_model] fc layouts), so checkpoints interop across
+            # the flag.
+            from ..layers.contrib import fused_qkv_attention
+            from ..param_attr import ParamAttr as _PA
+
+            return fused_qkv_attention(
+                queries, n_head=n_head, d_key=d_key, d_model=d_model,
+                bias=attn_bias, scale=d_key**-0.5,
+                dropout_rate=dropout_rate,
+                qkv_param_attr=_PA(name=unique_name("attn_qkv_w")),
+                out_param_attr=_PA(name=unique_name("attn_out_w")),
+            )
+
     if is_self and d_key == d_value:
         # ONE fused [d_model, 3*h*d] projection for self-attention: a
         # single dot (fewer custom-call-adjacent layout boundaries —
@@ -69,6 +93,19 @@ def multi_head_attention(
         r = layers.reshape(x, [b, t, n_head, d])
         return layers.transpose(r, [0, 2, 1, 3])
 
+    def to_bthd(x, d):
+        b, t, _ = x.shape
+        return layers.reshape(x, [b, t, n_head, d])
+
+    def merge_and_project(ctx):
+        """[b, t, h, d] context -> output projection (the shared tail of
+        the transpose-free bthd paths: the reshape is a bitcast)."""
+        b, t, h, d = ctx.shape
+        ctx = layers.reshape(ctx, [b, t, h * d])
+        return layers.fc(input=ctx, size=d_model, bias_attr=False,
+                         num_flatten_dims=2,
+                         param_attr=ParamAttr(name=unique_name("attn_out_w")))
+
     if use_flash and not use_ring:
         # transpose-free path: [b,t,h*d] -> [b,t,h,d] is a bitcast, the
         # kernel indexes heads via its grid, and the output reshapes
@@ -76,10 +113,6 @@ def multi_head_attention(
         # inserts no relayout copies at the custom-call boundary
         # (round-3 profile: ~5.5 GB/step of them on the [b,h,t,d] path)
         from ..layers.contrib import fused_attention
-
-        def to_bthd(x, d):
-            b, t, _ = x.shape
-            return layers.reshape(x, [b, t, n_head, d])
 
         # weights_dropout (in-kernel, reference semantics) is on at every
         # sequence length: the kernels draw mask bits from the TPU
@@ -91,32 +124,35 @@ def multi_head_attention(
             attn_bias, scale=d_key**-0.5, dropout_rate=dropout_rate,
             fmt="bthd",
         )
-        b, t, h, d = ctx.shape
-        ctx = layers.reshape(ctx, [b, t, h * d])
-        return layers.fc(input=ctx, size=d_model, bias_attr=False,
-                         num_flatten_dims=2,
-                         param_attr=ParamAttr(name=unique_name("attn_out_w")))
+        return merge_and_project(ctx)
+
+    if use_ring:
+        # context-parallel path on the same transpose-free convention:
+        # the ring chunks reuse the single-device bthd whole-head block
+        # specs (kernels/ring_attention.py) — CP re-introduces NO
+        # split/merge-head transposes
+        from ..layers.contrib import ring_attention
+
+        ctx = ring_attention(
+            to_bthd(q, d_key), to_bthd(k, d_key), to_bthd(v, d_value),
+            scale=d_key**-0.5, causal=ring_causal, axis_name=ring_axis,
+            fmt="bthd")
+        return merge_and_project(ctx)
 
     q = split_heads(q, d_key)
     k = split_heads(k, d_key)
     v = split_heads(v, d_value)
 
-    if use_ring:
-        from ..layers.contrib import ring_attention
-
-        ctx = ring_attention(q, k, v, scale=d_key**-0.5, causal=ring_causal,
-                             axis_name=ring_axis)
-    else:
-        product = layers.matmul(q, k, transpose_y=True, alpha=d_key**-0.5)
-        if attn_bias is not None:
-            product = layers.elementwise_add(product, attn_bias)
-        weights = layers.softmax(product)
-        if dropout_rate:
-            weights = layers.dropout(
-                weights, dropout_prob=dropout_rate,
-                dropout_implementation="upscale_in_train",
-            )
-        ctx = layers.matmul(weights, v)
+    product = layers.matmul(q, k, transpose_y=True, alpha=d_key**-0.5)
+    if attn_bias is not None:
+        product = layers.elementwise_add(product, attn_bias)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(
+            weights, dropout_prob=dropout_rate,
+            dropout_implementation="upscale_in_train",
+        )
+    ctx = layers.matmul(weights, v)
 
     b, h, t, d = ctx.shape
     ctx = layers.transpose(ctx, [0, 2, 1, 3])

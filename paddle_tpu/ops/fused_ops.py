@@ -7,6 +7,41 @@ from __future__ import annotations
 from ..core.registry import register
 
 
+def _attn_dropout_seed(ctx):
+    """(rate, seed) for an attention op's in-kernel weights dropout: 0 in
+    is_test, else the step-key-derived (1,) uint32 stream seed keyed by
+    the op's static rng_id — shared by fused_attention and
+    fused_qkv_attention so the two ops can never diverge in seeding."""
+    from ..kernels import hash_rng
+
+    rate = ctx.attr("dropout_rate", 0.0)
+    if ctx.attr("is_test", False) or ctx.is_test:
+        rate = 0.0
+    if not rate:
+        return 0.0, None
+    base = getattr(ctx.executor_ctx, "base_key", None)
+    if base is None:
+        base = ctx.executor_ctx._base_key  # eager session
+    return rate, hash_rng.seed_from_key(base, ctx.attr("rng_id", 1))
+
+
+def _bias_is_trainable(ctx, bias):
+    """Whether the op's Bias input needs a gradient.  Stop-gradient
+    biases (padding/causal masks — the usual case) keep the TPU
+    hardware-PRNG dropout fast path: their dbias recompute is
+    dead-code-eliminated, so its hash-mask mismatch is unobservable.  A
+    genuinely trainable bias forces the hash mask everywhere so the bias
+    cotangent sees the same mask the kernels applied."""
+    if bias is None:
+        return False
+    try:
+        bname = ctx.op.inputs.get("Bias", [None])[0]
+        bvar = ctx.block._find_var_recursive(bname) if bname else None
+        return bvar is None or not bvar.stop_gradient
+    except Exception:
+        return True  # unknown provenance: stay correct
+
+
 @register("fused_attention")
 def lower_fused_attention(ctx, ins):
     """Flash attention over [B,H,T,D] (fmt "bhtd") or [B,T,H,D] (fmt
@@ -20,33 +55,11 @@ def lower_fused_attention(ctx, ins):
     the identical mask in the backward and the [Tq,Tk] mask never exists
     in HBM (see kernels/hash_rng.py)."""
     from ..kernels.attention import flash_attention
-    from ..kernels import hash_rng
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     bias = ins.get("Bias", [None])[0]
-    rate = ctx.attr("dropout_rate", 0.0)
-    if ctx.attr("is_test", False) or ctx.is_test:
-        rate = 0.0
-    seed = None
-    if rate:
-        base = getattr(ctx.executor_ctx, "base_key", None)
-        if base is None:
-            base = ctx.executor_ctx._base_key  # eager session
-        seed = hash_rng.seed_from_key(base, ctx.attr("rng_id", 1))
-    # stop-gradient biases (padding/causal masks — the usual case) allow
-    # the TPU hardware-PRNG dropout fast path: their dbias recompute is
-    # dead-code-eliminated, so its hash-mask mismatch is unobservable.
-    # A genuinely trainable bias forces the hash mask everywhere so the
-    # bias cotangent sees the same mask the kernels applied.
-    trainable_bias = False
-    if bias is not None:
-        try:
-            bname = ctx.op.inputs.get("Bias", [None])[0]
-            bvar = (ctx.block._find_var_recursive(bname)
-                    if bname else None)
-            trainable_bias = bvar is None or not bvar.stop_gradient
-        except Exception:
-            trainable_bias = True  # unknown provenance: stay correct
+    rate, seed = _attn_dropout_seed(ctx)
+    trainable_bias = _bias_is_trainable(ctx, bias)
     out = flash_attention(
         q, k, v, bias,
         scale=ctx.attr("scale", 1.0),
@@ -54,6 +67,46 @@ def lower_fused_attention(ctx, ins):
         block_q=ctx.attr("block_q", 512),
         block_k=ctx.attr("block_k", 512),
         fmt=ctx.attr("fmt", "bhtd"),
+        dropout_rate=rate,
+        dropout_seed=seed,
+        trainable_bias=trainable_bias,
+    )
+    return {"Out": [out]}
+
+
+def _fused_qkv_infer(ctx):
+    xs = ctx.input_shape("X")
+    ws = ctx.input_shape("WOut")
+    if xs is not None and ws is not None:
+        ctx.set_output("Out", tuple(xs[:-1]) + (ws[1],),
+                       ctx.input_dtype("X"))
+
+
+@register("fused_qkv_attention", infer_shape=_fused_qkv_infer)
+def lower_fused_qkv_attention(ctx, ins):
+    """Self-attention with the qkv/output projections fused INTO the flash
+    kernels (kernels/attention.py flash_qkv_attention): X [b, t, d_model],
+    WQkv [d_model, 3*n_head*d_head] (the layers.fc packed layout), WOut
+    [n_head*d_head, d_model], optional additive Bias.  One op replaces the
+    flag-off mul + split + fused_attention + reshape + mul chain — q/k/v
+    never exist in HBM and the projection-boundary relayout copies
+    (PERF.md round 9 lead 1) go with them.  Dropout semantics/seeding
+    follow fused_attention (in-kernel weights dropout, step-key-derived
+    seed); shapes the kernel plan rejects run the numerically-identical
+    composed path."""
+    from ..kernels.attention import flash_qkv_attention
+
+    x, w_qkv, w_out = ins["X"][0], ins["WQkv"][0], ins["WOut"][0]
+    bias = ins.get("Bias", [None])[0]
+    rate, seed = _attn_dropout_seed(ctx)
+    trainable_bias = _bias_is_trainable(ctx, bias)
+    out = flash_qkv_attention(
+        x, w_qkv, w_out, bias,
+        n_head=ctx.attr("n_head", 1),
+        scale=ctx.attr("scale", 1.0),
+        causal=ctx.attr("causal", False),
+        block_q=ctx.attr("block_q", 512),
+        block_k=ctx.attr("block_k", 512),
         dropout_rate=rate,
         dropout_seed=seed,
         trainable_bias=trainable_bias,
@@ -102,20 +155,26 @@ def lower_ring_attention(ctx, ins):
     sharded entry pads and masks via the ring-traveling key bias);
     additive bias is not supported on the ring path (pad-free batches or
     pure-causal decoders)."""
-    from ..kernels.attention import reference_attention
+    from ..kernels.attention import _reference_bthd, reference_attention
     from ..kernels.ring_attention import ring_attention_sharded
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     scale = ctx.attr("scale", 1.0)
     causal = ctx.attr("causal", False)
     axis_name = ctx.attr("axis_name", "sp")
+    fmt = ctx.attr("fmt", "bhtd")
     mesh = getattr(ctx.executor_ctx, "mesh", None)
     if (
         mesh is None
         or axis_name not in getattr(mesh, "axis_names", ())
     ):
-        out = reference_attention(q, k, v, None, scale=scale, causal=causal)
+        if fmt == "bthd":
+            out = _reference_bthd(q, k, v, None, scale, causal)
+        else:
+            out = reference_attention(q, k, v, None, scale=scale,
+                                      causal=causal)
     else:
         out = ring_attention_sharded(
-            q, k, v, mesh, axis_name=axis_name, scale=scale, causal=causal)
+            q, k, v, mesh, axis_name=axis_name, scale=scale, causal=causal,
+            fmt=fmt)
     return {"Out": [out]}

@@ -221,3 +221,35 @@ def test_ring_attention_causal_skips_future_chunks():
 
     hlo = jax.jit(f).lower(q).as_text()
     assert "cond" in hlo or "conditional" in hlo
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_bthd_shape_parity(causal):
+    """fmt='bthd' (the transpose-free convention the fused-projection
+    kernels feed — PERF.md r09 satellite): the ring on [b, T, h, d]
+    shards must equal the bhtd ring transposed, including uneven T
+    (pad-and-mask via the traveling key bias), so context parallelism
+    composes with the bthd/fused-qkv model path without re-introducing
+    split-head transposes."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.ring_attention import ring_attention_sharded
+
+    mesh = _mesh(4)
+    with jax.default_matmul_precision("highest"):
+        rng = np.random.RandomState(9)
+        for t in (64, 56):  # even and axis-uneven sequence lengths
+            q = jnp.asarray(rng.randn(2, 2, t, 16).astype("float32"))
+            k = jnp.asarray(rng.randn(2, 2, t, 16).astype("float32"))
+            v = jnp.asarray(rng.randn(2, 2, t, 16).astype("float32"))
+            ref = ring_attention_sharded(q, k, v, mesh, "sp", scale=0.25,
+                                         causal=causal)
+            out = ring_attention_sharded(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), mesh, "sp", scale=0.25,
+                causal=causal, fmt="bthd")
+            assert out.shape == (2, t, 2, 16)
+            np.testing.assert_allclose(
+                np.asarray(out.transpose(0, 2, 1, 3)), np.asarray(ref),
+                atol=2e-5, rtol=2e-5, err_msg=f"t={t} causal={causal}")

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Minimal repro ladder for the donated-param ENTRY copies (PERF.md
+"Remaining copy inventory": ~0.9 GB of entry copies of donated rw params
+per call, "XLA copies donated params at entry despite may-alias, cause
+not yet found").
+
+The executor's train entry is jax.jit(scan_fn, donate_argnums=(1,)) with
+the rw params carried through ONE lax.scan and returned (executor.py
+run_steps).  This tool isolates that shape into a ladder of one-feature
+variants and, for each, reports from the compiled module:
+
+  aliases        input_output_alias arity (how many donated buffers
+                 actually aliased)
+  entry_copies   copy instructions in the ENTRY computation whose operand
+                 is a program parameter — THE copies in question
+  entry_copy_mb  their bytes
+
+Variants (all CPU-runnable; the last two only show the suspected
+mechanism on a real TPU, where layout assignment is non-trivial):
+
+  plain          p' = p + x, no scan              (control: must alias)
+  scan           p carried through lax.scan
+  scan_postread  + the ORIGINAL p read after the scan (interference)
+  scan_pallas    + a pallas_call consuming the carry in the body
+                 (custom-call operand layout constraints meet the
+                 while-carry layout)
+  scan_amp       + bf16 cast/matmul of the carry in the body (the amp
+                 shape: fp32 master weight, bf16 compute)
+  scan_dot_lhs   the carry is consumed as a DOT lhs inside the body (on
+                 TPU the dot may prefer a non-default layout for the
+                 carried buffer; entry params get default layouts, and
+                 aliasing requires identical layouts — the suspected
+                 cause)
+
+Finding so far (recorded in PERF.md round 9): on CPU every variant
+aliases cleanly with ZERO entry copies — the phenomenon is not
+reproducible where layouts are trivial, which localizes the cause to
+TPU layout assignment (entry-parameter default layout vs while-body
+compute-preferred layout; may-alias cannot bridge a layout change, so
+copy-insertion materializes the donated buffer once per call).  Run this
+on the driver's chip to confirm which rung introduces the copies; if it
+is scan_dot_lhs/scan_pallas, the fix is layout pinning of entry params
+(no JAX API today) or accepting the 1/steps-amortized cost (at scan 32:
+~28 MB/step — below measurement noise).
+
+Usage: python tools/donation_repro.py [out.json]
+"""
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?[\w.-]+\s*\(.*\)\s*->.*\{\s*$")
+_PARAM_RE = re.compile(r"^%?([\w.-]+)\s*=\s*\S+\s+parameter\(\d+\)")
+_COPY_RE = re.compile(
+    r"=\s*([a-z0-9]+)\[([\d,]*)\](\{[\d,]+\})?\s+copy\(%?([\w.-]+)")
+_DT_BYTES = {"bf16": 2, "f32": 4, "s32": 4, "u32": 4, "f16": 2}
+
+
+def entry_copy_report(txt):
+    in_entry = False
+    params = set()
+    n_copies = 0
+    copy_bytes = 0
+    for ln in txt.splitlines():
+        if _COMP_RE.match(ln):
+            in_entry = ln.lstrip().startswith("ENTRY")
+            continue
+        s = ln.strip()
+        if not in_entry:
+            continue
+        pm = _PARAM_RE.match(s)
+        if pm:
+            params.add(pm.group(1))
+            continue
+        cm = _COPY_RE.search(s)
+        if cm and cm.group(4) in params:
+            dt, dims = cm.group(1), cm.group(2)
+            n_copies += 1
+            copy_bytes += _DT_BYTES.get(dt, 4) * int(
+                np.prod([int(x) for x in dims.split(",") if x] or [1]))
+    aliases = len(re.findall(r"may-alias|must-alias", txt))
+    return {
+        "aliases": aliases,
+        "entry_copies": n_copies,
+        "entry_copy_mb": round(copy_bytes / 1e6, 3),
+    }
+
+
+def build_variants():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    n = 256
+    p = jnp.asarray(np.random.RandomState(0).randn(n, n).astype("float32"))
+    xs = jnp.asarray(
+        np.random.RandomState(1).randn(8, n, n).astype("float32"))
+    on_tpu = jax.default_backend() == "tpu"
+
+    def scan_plain(p, xs):
+        def body(c, x):
+            return c + 0.001 * x, (x * c).sum()
+        return jax.lax.scan(body, p, xs)
+
+    def scan_postread(p, xs):
+        c, ys = scan_plain(p, xs)
+        return c, ys, p.sum()
+
+    def pallas_double(c):
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+        return pl.pallas_call(
+            kern, out_shape=jax.ShapeDtypeStruct(c.shape, c.dtype),
+            interpret=not on_tpu)(c)
+
+    def scan_pallas(p, xs):
+        def body(c, x):
+            return c + 0.001 * x, pallas_double(c).sum()
+        return jax.lax.scan(body, p, xs)
+
+    def scan_amp(p, xs):
+        def body(c, x):
+            y = (c.astype(jnp.bfloat16) @ x.astype(jnp.bfloat16)).astype(
+                jnp.float32)
+            return c + 0.001 * y, y.sum()
+        return jax.lax.scan(body, p, xs)
+
+    def scan_dot_lhs(p, xs):
+        def body(c, x):
+            y = c @ x                       # carry as dot LHS
+            z = c.T @ x                     # ... and transposed (duals)
+            return c + 0.001 * y, z.sum()
+        return jax.lax.scan(body, p, xs)
+
+    return [
+        ("plain", lambda p, x: p + x[0], (p, xs)),
+        ("scan", scan_plain, (p, xs)),
+        ("scan_postread", scan_postread, (p, xs)),
+        ("scan_pallas", scan_pallas, (p, xs)),
+        ("scan_amp", scan_amp, (p, xs)),
+        ("scan_dot_lhs", scan_dot_lhs, (p, xs)),
+    ]
+
+
+def main():
+    import warnings
+
+    import jax
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/donation_repro.json"
+    report = {"backend": jax.default_backend(), "variants": {}}
+    for name, fn, args in build_variants():
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            txt = jax.jit(fn, donate_argnums=(0,)).lower(
+                *args).compile().as_text()
+        rep = entry_copy_report(txt)
+        # jax warns "Some donated buffers were not usable" when donation
+        # fails outright — a louder sibling of the silent entry copy
+        rep["donation_warnings"] = sum(
+            1 for w in caught if "donated" in str(w.message).lower())
+        report["variants"][name] = rep
+        print(f"{name:14s} {rep}")
+    culprits = [n for n, r in report["variants"].items()
+                if r["entry_copies"]]
+    report["finding"] = (
+        f"entry copies reproduced by: {culprits}" if culprits else
+        "no variant produces donated-param entry copies on this backend "
+        "(every donation aliases cleanly) — on CPU this localizes the "
+        "production observation to TPU layout assignment; re-run on the "
+        "driver's chip")
+    print(report["finding"])
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"[donation_repro] -> {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -4,8 +4,29 @@ step: counts copy/transpose/custom-call instructions by shape and locates
 them relative to the flash-attention custom-calls.  Perf tooling for
 PERF.md leads 1-2 (attention layout copies, scan-carry copies).
 
-Usage: python tools/hlo_diag.py [transformer|transformer_noflash|resnet50
-           |deepfm] [out.txt] [--bn-fusion] [--sparse]
+Usage: python tools/hlo_diag.py [transformer|transformer_smoke
+           |transformer_noflash|resnet50|deepfm] [out.txt]
+           [--bn-fusion] [--sparse] [--copy-census]
+
+--copy-census: the round-9 while-body copy-byte attribution, automated
+(PERF.md's hand-done "Remaining copy inventory").  Every HLO copy is
+attributed to a site class by its metadata + enclosing computation:
+  projection   copies whose source metadata points into ops/math_ops.py
+               (the mul lowering) — the dot-preferred<->custom-call
+               relayouts.  NOTE: this keys on the DOT TIER, so any FFN/
+               head mul relayouts land here too; the attention-projection
+               subset is isolated by the fused-vs-unfused DIFF (the FFN
+               dots are identical on both sides)
+  pallas       copies sourced from kernels/ (the pallas_call operand/
+               result relayouts into alternate memory)
+  entry        copies living in the ENTRY computation whose operand is a
+               program parameter — the donated-param entry copies ("XLA
+               copies donated params at entry despite may-alias")
+  other        everything else
+Run with FLAGS_fused_qkv_attention=0 vs =1 and diff: the fused path must
+drive the projection-site bytes to ~0 (asserted in
+tests/test_fused_qkv_attention.py; the JSON lands next to the dump as
+<out>.census.json so CI can archive it).
 
 --bn-fusion (resnet50): the round-7 BN-wall attribution report — counts
 the BN-statistics channel reductions (full passes over 3/4-D activations
@@ -45,6 +66,40 @@ def compile_transformer(scan_steps=8, batch_size=64, seq_len=256,
 
     cfg = dict(n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
                d_inner_hid=2048, vocab=32000)
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        avg_cost, _, feeds = T.transformer(
+            src_vocab_size=cfg["vocab"], trg_vocab_size=cfg["vocab"],
+            max_length=seq_len, n_layer=cfg["n_layer"], n_head=cfg["n_head"],
+            d_key=cfg["d_key"], d_value=cfg["d_value"], d_model=cfg["d_model"],
+            d_inner_hid=cfg["d_inner_hid"], dropout_rate=0.1,
+            src_seq_len=seq_len, trg_seq_len=seq_len, use_flash=use_flash,
+        )
+        pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    pt.amp.enable(prog)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    batches = [
+        T.make_batch(batch_size, seq_len, seq_len, cfg["n_head"],
+                     cfg["vocab"], cfg["vocab"], rng=np.random.RandomState(s))
+        for s in range(scan_steps)
+    ]
+    feed = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+    return exe, prog, feed, [avg_cost], scope
+
+
+def compile_transformer_smoke(scan_steps=2, batch_size=2, seq_len=64,
+                              use_flash=True):
+    """Tiny-but-representative transformer for the CI copy-census leg:
+    d_model/head shapes keep the fused-qkv kernel plan feasible
+    (d_head 64), everything else shrinks so a CPU box compiles it in
+    seconds."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as T
+
+    cfg = dict(n_layer=1, n_head=2, d_key=64, d_value=64, d_model=128,
+               d_inner_hid=256, vocab=512)
     prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(prog, startup):
         avg_cost, _, feeds = T.transformer(
@@ -284,6 +339,99 @@ def format_bn_fusion(rep):
     return "\n".join(out)
 
 
+# --copy-census: the round-9 copy-byte attribution by site ------------------
+
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?[\w.-]+\s*\(.*\)\s*->.*\{\s*$")
+_PARAM_RE = re.compile(r"^%?([\w.-]+)\s*=\s*\S+\s+parameter\(\d+\)")
+_COPY_OPND_RE = re.compile(
+    r"=\s*([a-z0-9]+)\[([\d,]*)\](\{[\d,]+\})?\s+copy\(%?([\w.-]+)")
+_KERNEL_FILES = ("attention.py", "conv_bn.py", "dropout_epilogue.py",
+                 "embedding.py", "ring_attention.py", "matmul_stats.py")
+
+
+def _census_site(src_file, op_name, in_entry, operand_is_param):
+    """Site class of one copy: 'projection' (the dot tier — the mul
+    lowering in ops/math_ops.py; dominated by the qkv/output projection
+    dots, but FFN/head muls land here too — diff fused vs unfused to
+    isolate the attention subset), 'pallas' (custom-call operand/result
+    relayout, sourced from kernels/), 'entry' (ENTRY-computation copies
+    of program parameters — the donated-param entry copies), 'other'."""
+    if in_entry and operand_is_param:
+        return "entry"
+    base = src_file.rsplit("/", 1)[-1] if src_file else ""
+    if base == "math_ops.py":
+        return "projection"
+    if base in _KERNEL_FILES or "/kernels/" in (src_file or ""):
+        return "pallas"
+    return "other"
+
+
+def analyze_copy_census(txt):
+    """Bytes-per-site copy census of one optimized-HLO dump (the
+    automated form of PERF.md's hand-done 'Remaining copy inventory').
+    Returns a JSON-able dict; diff a FLAGS_fused_qkv_attention=0 dump
+    against =1: the fused path must drive the 'projection' site to ~0
+    (there is no dot at the boundary left to relayout)."""
+    sites = {k: {"count": 0, "mb": 0.0}
+             for k in ("projection", "pallas", "entry", "other")}
+    top = collections.Counter()
+    entry_params = set()
+    in_entry = False
+    total = 0
+    total_bytes = 0
+    for ln in txt.splitlines():
+        s = ln.strip()
+        if _COMP_RE.match(ln):
+            in_entry = ln.lstrip().startswith("ENTRY")
+            continue
+        if in_entry:
+            pm = _PARAM_RE.match(s)
+            if pm:
+                entry_params.add(pm.group(1))
+                continue
+        m = _COPY_OPND_RE.search(s)
+        if not m:
+            continue
+        dt, dims, _, operand = m.groups()
+        nbytes = DT_BYTES.get(dt, 4) * int(
+            np.prod([int(x) for x in dims.split(",") if x] or [1]))
+        srcm = _SRC_RE.search(s)
+        src_file = srcm.group(1) if srcm else ""
+        src = (f"{src_file.rsplit('/', 1)[-1]}:{srcm.group(2)}"
+               if srcm else "?")
+        om = re.search(r'op_name="([^"]+)"', s)
+        op_name = om.group(1).split("/")[-1] if om else "?"
+        site = _census_site(src_file, op_name, in_entry,
+                            operand in entry_params)
+        sites[site]["count"] += 1
+        sites[site]["mb"] = round(sites[site]["mb"] + nbytes / 1e6, 3)
+        top[(site, op_name, src)] += nbytes
+        total += 1
+        total_bytes += nbytes
+    return {
+        "total_copies": total,
+        "total_mb": round(total_bytes / 1e6, 3),
+        "sites": sites,
+        "top": [
+            {"site": site, "op": op, "src": src, "mb": round(b / 1e6, 3)}
+            for (site, op, src), b in top.most_common(15)
+        ],
+    }
+
+
+def format_copy_census(rep):
+    out = ["== copy census by site (PERF.md r09 attribution) =="]
+    for site, d in rep["sites"].items():
+        out.append(f"  {site:11s} {d['count']:4d} copies  {d['mb']:10.3f} MB")
+    out.append(f"  {'TOTAL':11s} {rep['total_copies']:4d} copies  "
+               f"{rep['total_mb']:10.3f} MB")
+    out.append("  top attribution (site, op, source):")
+    for t in rep["top"]:
+        out.append(f"    {t['mb']:8.3f} MB  {t['site']:10s} {t['op']}  "
+                   f"{t['src']}")
+    return "\n".join(out)
+
+
 # --sparse: the round-8 dispatch/launch census of the sparse CTR tier ------
 
 _SPARSE_GRAPH_OPS = (
@@ -370,10 +518,13 @@ def main():
     argv = [a for a in sys.argv[1:] if not a.startswith("--")]
     bn_fusion = "--bn-fusion" in sys.argv[1:]
     sparse = "--sparse" in sys.argv[1:]
+    copy_census = "--copy-census" in sys.argv[1:]
     which = argv[0] if argv else "transformer"
     out_path = argv[1] if len(argv) > 1 else f"/tmp/hlo_{which}.txt"
     if which == "transformer":
         args = compile_transformer()
+    elif which == "transformer_smoke":
+        args = compile_transformer_smoke()
     elif which == "transformer_noflash":
         args = compile_transformer(use_flash=False)
     elif which == "resnet50":
@@ -391,6 +542,19 @@ def main():
         print(format_bn_fusion(analyze_bn_fusion(txt)))
     if sparse:
         print(format_sparse(analyze_sparse(txt, args[1])))
+    if copy_census:
+        import json
+
+        rep = analyze_copy_census(txt)
+        from paddle_tpu.flags import FLAGS as _FLAGS
+
+        rep["fused_qkv_attention"] = bool(_FLAGS.fused_qkv_attention)
+        rep["workload"] = which
+        census_path = out_path + ".census.json"
+        with open(census_path, "w") as f:
+            json.dump(rep, f, indent=1)
+        print(format_copy_census(rep))
+        print(f"[hlo_diag] copy census -> {census_path}")
 
 
 if __name__ == "__main__":

@@ -70,6 +70,47 @@ print("deepfm A/B records OK:", [(r["config"]["fused_embedding"],
                                   r["value"]) for r in recs])
 PY
   echo "-- deepfm A/B record artifact: ci_artifacts/bench_deepfm_smoke.json"
+  # Transformer fused-qkv-projection leg (PERF.md r09 A/B): the fused-
+  # projection record next to its FLAGS_fused_qkv_attention=0 unfused-
+  # composition baseline, both under the warnings gate (paired records,
+  # config carries the flag + runs[]/spread) — the projection-boundary
+  # A/B artifact for the driver's chip run
+  python -W error::UserWarning bench.py --model transformer --smoke \
+    | tee ci_artifacts/bench_transformer_smoke.json
+  FLAGS_fused_qkv_attention=0 python -W error::UserWarning bench.py \
+    --model transformer --smoke \
+    | tee -a ci_artifacts/bench_transformer_smoke.json
+  python - <<'PY'
+import json
+recs = [json.loads(l) for l in open(
+    "ci_artifacts/bench_transformer_smoke.json")
+    if l.strip().startswith("{")]
+recs = [r for r in recs if r.get("metric", "").startswith("transformer")]
+flags = {r["config"]["fused_qkv_attention"] for r in recs}
+assert flags == {True, False}, f"need a fused AND an unfused record: {flags}"
+print("transformer A/B records OK:", [(r["config"]["fused_qkv_attention"],
+                                       r["value"]) for r in recs])
+PY
+  echo "-- transformer A/B record artifact: ci_artifacts/bench_transformer_smoke.json"
+  # Copy census (PERF.md r09 attribution artifact): the automated
+  # while-body copy-byte attribution on the smoke transformer, fused vs
+  # unfused — tests assert the projection-site collapse; CI archives the
+  # paired JSON for the record
+  python tools/hlo_diag.py transformer_smoke \
+    ci_artifacts/hlo_transformer_smoke_fused.txt --copy-census \
+    | tail -20
+  FLAGS_fused_qkv_attention=0 python tools/hlo_diag.py transformer_smoke \
+    ci_artifacts/hlo_transformer_smoke_unfused.txt --copy-census \
+    | tail -20
+  rm -f ci_artifacts/hlo_transformer_smoke_*.txt  # keep the census JSONs
+  echo "-- copy-census artifacts:"
+  ls ci_artifacts/*.census.json
+  # Donated-param entry-copy repro ladder (PERF.md r09): archives the
+  # per-variant aliasing/entry-copy report — a CPU box documents the
+  # negative result; the driver's chip run pinpoints the culprit rung
+  JAX_PLATFORMS=cpu python tools/donation_repro.py \
+    ci_artifacts/donation_repro.json
+  echo "-- donation repro artifact: ci_artifacts/donation_repro.json"
   echo "-- metrics snapshot:"
   head -40 ci_artifacts/metrics.prom || true
   echo "-- flight record (black box of the smoke run):"
