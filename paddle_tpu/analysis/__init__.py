@@ -1,0 +1,42 @@
+"""Static-analysis tier: catch miscompiles BEFORE trace time.
+
+The reference framework's C++ runtime validates every ProgramDesc op
+against its registered shape/dtype/attr contract before execution
+(operator.cc RuntimeInferShape ENFORCE, framework.proto IR); this package
+is the TPU-first equivalent for the Python IR:
+
+  * verifier.py — walks a Program through the op registry: def-before-use
+    / SSA across blocks, static shape+dtype contract re-inference,
+    dead-var/dead-op detection, donation/fetch alias conflicts, and the
+    RNG-determinism lint (key-deriving ops the executor would not thread
+    the step key for — the PR-4 `dropout_add` bug class).
+  * kernel_lint.py — statically audits every Pallas kernel plan in
+    kernels/ (attention, fused-qkv, conv_bn, dropout_epilogue, embedding,
+    ring attention): VMEM budget vs the plan gate's estimate, (8,128)
+    sublane/lane tile alignment, grid/block divisibility,
+    input_output_aliases shape/dtype validity, and revisited-block
+    accumulation dtypes — the checks that previously lived only in
+    interpret-mode asserts until a chip run.
+
+Wiring: Executor._maybe_verify (FLAGS_verify_program) gates every compile;
+tools/graph_lint.py drives the full model matrix and emits the CI findings
+artifact (ci_artifacts/graph_lint.json).
+"""
+
+from __future__ import annotations
+
+from .verifier import (  # noqa: F401
+    Finding,
+    ProgramVerifyError,
+    verify_or_raise,
+    verify_program,
+)
+from .kernel_lint import lint_kernel_plans  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "ProgramVerifyError",
+    "verify_program",
+    "verify_or_raise",
+    "lint_kernel_plans",
+]

@@ -1,0 +1,523 @@
+"""Pallas plan linter: static audit of every kernel plan in kernels/.
+
+The kernel plan gates (_plan/_qkv_plan/_dot_plan/_auto_block_rows) decide
+per-shape whether a Pallas kernel launches or the XLA fallback runs.  On
+the CPU CI box those gates run in interpret mode, where Mosaic's real
+constraints (lane/sublane tile alignment, VMEM capacity, aliasing) are
+emulated away — PR 7/8 shipped kernels whose aliasing and revisited-block
+accumulation invariants were "asserted only in interpret until a chip
+run".  This linter closes that gap statically: it calls the REAL plan
+gates under a pretended-TPU backend over the canonical model shape
+matrix, then re-validates every accepted plan with independent
+arithmetic:
+
+  * grid/block divisibility (t % block == 0, rows % block_r == 0)
+  * (8,128)/dtype tile alignment: lane blocks % 128, sublane blocks % 8
+    (fp32) / % 16 (sub-4-byte dtypes); Mosaic dynamic-slice offsets on
+    the lane dim need 128-aligned blocks
+  * VMEM working set vs the 16 MB budget — recomputed here, NOT read
+    from the gate, so a gate that under-estimates is itself caught
+  * input_output_aliases validity (embedding applies: every aliased
+    table's shape/dtype must equal its output)
+  * revisited-block accumulation: outputs revisited across grid steps
+    (conv_bn stats tiles, qkv dW accumulators) must accumulate in f32
+
+Every check function takes the CONFIG + the PLAN as data, so the
+red-gate tests can feed a fabricated bad plan and assert the linter
+names it (tests/test_static_analysis.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from .verifier import Finding
+
+
+def _np_dtype(d) -> np.dtype:
+    """np.dtype that also resolves 'bfloat16'/'float8*' via ml_dtypes
+    (a jax dependency)."""
+    try:
+        return np.dtype(d)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, str(d)))
+
+# hardware model (TPU v4/v5 class): per-core VMEM and the alignment the
+# Mosaic lowering actually enforces
+_VMEM_BYTES = 16 * 1024 * 1024
+_LANE = 128
+
+
+def _sublane(dtype) -> int:
+    return 16 if _np_dtype(dtype).itemsize < 4 else 8
+
+
+@contextlib.contextmanager
+def _pretend_tpu():
+    """Run a plan gate as if jax.default_backend() were 'tpu', so the
+    compiled-mode branches (alignment snapping, VMEM gating) execute on
+    the CPU CI box.  The gates only read the backend NAME — no device is
+    touched."""
+    import jax
+
+    real = jax.default_backend
+
+    def fake(*a, **k):
+        return "tpu"
+
+    jax.default_backend = fake
+    try:
+        yield
+    finally:
+        jax.default_backend = real
+
+
+def _spec(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), _np_dtype(dtype))
+
+
+def _finding(check, msg, family, label):
+    return Finding(check, "error", f"[{family}:{label}] {msg}",
+                   op_type=family, var=label)
+
+
+# ---------------------------------------------------------------------------
+# per-family checks: (config, plan) -> findings.  Pure data in, data out —
+# the red-gate fabricates bad plans through these same functions.
+# ---------------------------------------------------------------------------
+
+
+def check_attention_plan(cfg: dict, ok, block_q, block_k, interpret,
+                         findings: List[Finding]):
+    """Validate an (accepted) flash-attention plan for compiled TPU mode."""
+    fam, label = "attention", cfg["label"]
+    b, h, t, d = cfg["b"], cfg["h"], cfg["t"], cfg["d"]
+    esize = _np_dtype(cfg["dtype"]).itemsize
+    if cfg.get("must_accept", True) and not ok:
+        findings.append(_finding(
+            "kernel-plan-reject",
+            f"plan gate rejects the canonical shape b={b} h={h} t={t} "
+            f"d={d} {cfg['dtype']} (fmt {cfg['fmt']}) — the model would "
+            f"silently run the XLA fallback", fam, label))
+        return
+    if not ok:
+        return
+    if t % block_q or t % block_k:
+        findings.append(_finding(
+            "kernel-grid-divisibility",
+            f"blocks ({block_q},{block_k}) do not divide t={t}", fam,
+            label))
+    if d % 64:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"head dim {d} is not a multiple of 64 (MXU lane occupancy)",
+            fam, label))
+    if not interpret and (block_q % _LANE or block_k % _LANE):
+        # backward kernels dynamic-slice lse/delta on the lane dim by
+        # block_q and kv tiles by block_k: Mosaic needs 128-aligned blocks
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"compiled-mode blocks ({block_q},{block_k}) are not "
+            f"128-lane aligned (Mosaic dynamic-slice constraint)", fam,
+            label))
+    if cfg["fmt"] == "bthd":
+        # whole-head kv tiles [block, h, d]: the plan gate caps blocks so
+        # the bwd working set fits; re-check with its own arithmetic
+        kv_tile = block_k * h * d * esize
+        if kv_tile > 256 * 1024:
+            findings.append(_finding(
+                "kernel-vmem-budget",
+                f"bthd kv tile block_k*h*d = {kv_tile} bytes exceeds the "
+                f"256 KB per-tile bound the bwd kernel compiles under",
+                fam, label))
+    else:
+        # working set per grid step: q/o/do blocks + streamed k/v blocks
+        # + [block_q, block_k] score plane in f32
+        resident = (3 * block_q * d + 2 * block_k * d) * esize \
+            + block_q * block_k * 4
+        if resident > _VMEM_BYTES:
+            findings.append(_finding(
+                "kernel-vmem-budget",
+                f"per-step working set {resident} bytes exceeds VMEM",
+                fam, label))
+
+
+def check_qkv_plan(cfg: dict, ok, block_q, block_k, interpret,
+                   findings: List[Finding]):
+    fam, label = "qkv_attention", cfg["label"]
+    t, dm, h, dh = cfg["t"], cfg["dm"], cfg["h"], cfg["dh"]
+    esize = _np_dtype(cfg["dtype"]).itemsize
+    if cfg.get("must_accept", True) and not ok:
+        findings.append(_finding(
+            "kernel-plan-reject",
+            f"plan gate rejects the canonical shape t={t} dm={dm} h={h} "
+            f"dh={dh} {cfg['dtype']}", fam, label))
+        return
+    if not ok:
+        return
+    if t % block_q or t % block_k:
+        findings.append(_finding(
+            "kernel-grid-divisibility",
+            f"blocks ({block_q},{block_k}) do not divide t={t}", fam,
+            label))
+    if dh % 64 or dm % _LANE:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"d_head {dh} %% 64 or d_model {dm} %% 128 misaligned", fam,
+            label))
+    if not interpret and (block_q % _LANE or block_k % _LANE):
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"compiled-mode blocks ({block_q},{block_k}) are not "
+            f"128-lane aligned", fam, label))
+    # independent VMEM re-estimate of the worst kernel (the dkv walk):
+    # x + g full-seq [t, dm], ctx residual [h, t, dh], both weight views
+    # (w3 [3h,dm,dh] + wo [h,dh,dm] = 4*h*dm*dh), and the TWO f32 dW grid
+    # accumulators (revisited-block outputs, hence the * 4)
+    resident = (2 * t * dm + h * t * dh + 4 * h * dm * dh) * esize \
+        + 2 * h * dm * dh * 4
+    if resident >= 14 * 1024 * 1024:
+        findings.append(_finding(
+            "kernel-vmem-budget",
+            f"dkv-walk resident set {resident} bytes >= the gate's 14 MB "
+            f"bound — the gate accepted a plan its own estimate should "
+            f"reject", fam, label))
+    # revisited-block accumulation: dW tiles are revisited once per
+    # (batch, q-block) grid step; accumulation dtype must be f32
+    if cfg.get("accum_dtype", "float32") != "float32":
+        findings.append(_finding(
+            "kernel-accum-dtype",
+            f"dW grid accumulator dtype {cfg.get('accum_dtype')} — "
+            f"revisited-block accumulation below f32 loses gradient mass "
+            f"across {t // max(block_q, 1)} revisits", fam, label))
+
+
+def check_conv_bn_plan(cfg: dict, plan, findings: List[Finding]):
+    """conv_bn channel_stats / scale_shift_act tiling plan (a _Plan
+    object or None), or the dot_col_stats (block_m, block_n, interp)
+    tuple when cfg['kind'] == 'dot'."""
+    fam, label = "conv_bn", cfg["label"]
+    sub = _sublane(cfg["dtype"])
+    if cfg.get("must_accept", True) and plan is None:
+        findings.append(_finding(
+            "kernel-plan-reject",
+            f"plan gate rejects the canonical shape rows={cfg['rows']} "
+            f"c={cfg['c']} {cfg['dtype']}", fam, label))
+        return
+    if plan is None:
+        return
+    if cfg.get("kind") == "dot":
+        block_m, block_n, _ = plan
+        m, oc = cfg["rows"], cfg["c"]
+        bad_div = m % block_m or oc % block_n
+        bad_align = block_m % sub or block_n % _LANE
+        rows, ncols, block_r, block_c = m, oc, block_m, block_n
+    else:
+        rows, ncols = plan.rows, plan.ncols
+        block_r, block_c = plan.block_r, plan.block_c
+        bad_div = rows % block_r or ncols % block_c
+        bad_align = block_r % sub or block_c % _LANE
+        if plan.fold > 1 and (_LANE % cfg["c"]
+                              or (cfg["rows"] * cfg["c"]) % _LANE):
+            findings.append(_finding(
+                "kernel-misaligned-block",
+                f"lane fold {plan.fold} is invalid for c={cfg['c']} "
+                f"(needs 128 %% c == 0 and rows*c %% 128 == 0)", fam,
+                label))
+    if bad_div:
+        findings.append(_finding(
+            "kernel-grid-divisibility",
+            f"blocks ({block_r},{block_c}) do not divide "
+            f"[{rows},{ncols}]", fam, label))
+    if bad_align:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"blocks ({block_r},{block_c}) violate ({sub},{_LANE}) "
+            f"sublane/lane tiling for {cfg['dtype']}", fam, label))
+    # stats tile is an (8, block_c) f32 output revisited on every M step
+    if cfg.get("stats_dtype", "float32") != "float32":
+        findings.append(_finding(
+            "kernel-accum-dtype",
+            f"revisited stats accumulator dtype "
+            f"{cfg.get('stats_dtype')} != float32", fam, label))
+    if (block_r * ncols + 8 * ncols) * _np_dtype(cfg["dtype"]).itemsize \
+            > _VMEM_BYTES:
+        findings.append(_finding(
+            "kernel-vmem-budget",
+            f"[{block_r},{ncols}] input block + stats tile exceeds VMEM",
+            fam, label))
+
+
+def check_dropout_plan(cfg: dict, ok, rows, ncols, block_r, interpret,
+                       hw_prng, findings: List[Finding]):
+    fam, label = "dropout_epilogue", cfg["label"]
+    sub = _sublane(cfg["dtype"])
+    if cfg.get("must_accept", True) and not ok:
+        findings.append(_finding(
+            "kernel-plan-reject",
+            f"plan gate rejects the canonical shape {cfg['shape']} "
+            f"{cfg['dtype']}", fam, label))
+        return
+    if not ok:
+        return
+    if ncols % _LANE or block_r % sub:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"[{block_r},{ncols}] violates ({sub},{_LANE}) tiling", fam,
+            label))
+    if rows % block_r:
+        findings.append(_finding(
+            "kernel-grid-divisibility",
+            f"block_r={block_r} does not divide rows={rows}", fam, label))
+    if rows * ncols >= 2 ** 32:
+        findings.append(_finding(
+            "kernel-rng-wrap",
+            f"mask plane {rows}x{ncols} wraps the uint32 hash index — "
+            f"mask bits repeat", fam, label))
+
+
+def check_embedding_group(cfg: dict, block_rows: int,
+                          findings: List[Finding]):
+    """Fused multi-table gather/apply group: alias validity + the 8 MB
+    VMEM block budget the gate sizes against."""
+    from ..kernels import embedding as emb
+
+    fam, label = "embedding", cfg["label"]
+    specs = cfg["tables"]  # list of (shape, dtype) per table
+    t0_shape, t0_dtype = specs[0]
+    # input_output_aliases maps table input i -> output i verbatim: every
+    # aliased pair must agree in shape AND dtype or the in-place HBM row
+    # DMA writes through a mis-sized buffer
+    for i, (shape, dtype) in enumerate(specs):
+        if tuple(shape) != tuple(t0_shape) or _np_dtype(dtype) != \
+                _np_dtype(t0_dtype):
+            findings.append(_finding(
+                "kernel-alias-mismatch",
+                f"table {i} ({shape}, {dtype}) differs from table 0 "
+                f"({t0_shape}, {t0_dtype}): input_output_aliases would "
+                f"alias mismatched buffers", fam, label))
+    if _np_dtype(t0_dtype).kind != "f":
+        findings.append(_finding(
+            "kernel-alias-mismatch",
+            f"non-float table dtype {t0_dtype} on the aliased kernel "
+            f"path (contract: float tables only)", fam, label))
+    if t0_shape[0] >= 2 ** 31 - 1:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"table height {t0_shape[0]} exceeds int32 row addressing",
+            fam, label))
+    s_n, d = len(specs), t0_shape[1]
+    lanes = max(d, _LANE)
+    tiers = cfg.get("tiers", 1)
+    per_step = tiers * s_n * block_rows * lanes * _np_dtype(t0_dtype).itemsize
+    if per_step > emb._VMEM_BUDGET_BYTES:
+        findings.append(_finding(
+            "kernel-vmem-budget",
+            f"{tiers} tier(s) x [{s_n},{block_rows},{lanes}] VMEM blocks "
+            f"= {per_step} bytes exceed the {emb._VMEM_BUDGET_BYTES}-byte "
+            f"gate budget (gate under-estimates for this group)", fam,
+            label))
+    if block_rows < 1:
+        findings.append(_finding(
+            "kernel-grid-divisibility",
+            f"degenerate block_rows={block_rows}", fam, label))
+
+
+# ---------------------------------------------------------------------------
+# canonical shape matrix: the shapes the bundled models/workloads actually
+# launch (models/, bench.py configs).  must_accept pins the plans the perf
+# story depends on — a gate regression that silently falls back FAILS CI.
+# ---------------------------------------------------------------------------
+
+_ATTENTION_MATRIX = [
+    # transformer-base self-attention (bench.py transformer config)
+    dict(label="transformer-base-f32", b=4, h=8, t=256, d=64,
+         dtype="float32", fmt="bhtd"),
+    dict(label="transformer-base-bf16", b=4, h=8, t=256, d=64,
+         dtype="bfloat16", fmt="bhtd"),
+    # BERT-base under amp
+    dict(label="bert-base-bf16", b=4, h=12, t=128, d=64,
+         dtype="bfloat16", fmt="bhtd"),
+    # the transpose-free convention (ring attention / CP chunks reuse it)
+    dict(label="transformer-base-bthd", b=4, h=8, t=256, d=64,
+         dtype="float32", fmt="bthd"),
+    dict(label="ring-cp-chunk-bthd", b=2, h=8, t=128, d=64,
+         dtype="float32", fmt="bthd"),
+    # long-sequence flash leg (BENCH flash-attn workload)
+    dict(label="flash-longseq", b=1, h=8, t=4096, d=64,
+         dtype="float32", fmt="bhtd"),
+    # h*d*esize > 2048: even a 128-block kv tile busts the 256 KB bound —
+    # compiled mode must REJECT to XLA (the cap-floor regression class);
+    # if the gate ever re-accepts this, the kv-tile check fires
+    dict(label="transformer-big-f32-bthd", b=2, h=16, t=256, d=64,
+         dtype="float32", fmt="bthd", must_accept=False),
+]
+
+_QKV_MATRIX = [
+    dict(label="transformer-base-f32", b=4, t=256, dm=512, h=8, dh=64,
+         dtype="float32"),
+    dict(label="bert-base-bf16", b=4, t=128, dm=768, h=12, dh=64,
+         dtype="bfloat16"),
+    # the CI smoke config: t=64 is NOT 128-divisible -> compiled TPU mode
+    # rejects to the composed fallback by design
+    dict(label="transformer-smoke", b=2, t=64, dm=128, h=2, dh=64,
+         dtype="float32", must_accept=False),
+    # dm*esize > 2048 (bert-base WITHOUT amp): a 128-row streamed tile
+    # already exceeds the 256 KB bound — compiled mode rejects by design
+    dict(label="bert-base-f32", b=4, t=128, dm=768, h=12, dh=64,
+         dtype="float32", must_accept=False),
+]
+
+_CONV_BN_MATRIX = [
+    # resnet-50 NHWC batch 32 stage shapes (models/resnet.py)
+    dict(label="stem-c64", rows=32 * 112 * 112, c=64, dtype="float32"),
+    dict(label="stage1-c256", rows=32 * 56 * 56, c=256, dtype="float32"),
+    dict(label="stage3-c1024", rows=32 * 14 * 14, c=1024,
+         dtype="bfloat16"),
+    dict(label="stage4-c2048", rows=32 * 7 * 7, c=2048, dtype="float32"),
+    # lane-folded narrow-channel case (c < 128)
+    dict(label="fold-c64-bf16", rows=32 * 56 * 56, c=64,
+         dtype="bfloat16"),
+    # 1x1-conv-as-dot epilogue
+    dict(label="dot-stage2-c512", kind="dot", rows=32 * 28 * 28, c=512,
+         dtype="bfloat16"),
+    # oc < 128 has no lane-fold on the dot path (unlike channel_stats):
+    # the stage-1 1x1/64 reduce convs run the XLA fallback by design —
+    # numerically identical, a perf (not correctness) gap
+    dict(label="dot-stage1-c64", kind="dot", rows=32 * 56 * 56, c=64,
+         dtype="float32", must_accept=False),
+]
+
+# ring attention: the sharded entry splits the sequence axis over the sp
+# mesh axis and each rank runs the single-device flash kernels on its
+# chunk via the SAME _plan gate (kernels/ring_attention.py _plan reuse) —
+# audit the per-rank CHUNK shapes the CP configs actually produce
+_RING_MATRIX = [
+    # long-context CP leg: t=4096 over sp=8 -> 512-token chunks
+    dict(label="cp8-longseq-chunk", b=1, h=8, t=512, d=64,
+         dtype="float32", fmt="bhtd"),
+    dict(label="cp8-longseq-chunk-bthd", b=1, h=8, t=512, d=64,
+         dtype="float32", fmt="bthd"),
+    # transformer CP over sp=2 (the dryrun_multichip shape)
+    dict(label="cp2-transformer-chunk-bthd", b=4, h=8, t=128, d=64,
+         dtype="float32", fmt="bthd"),
+]
+
+_DROPOUT_MATRIX = [
+    dict(label="transformer-residual", shape=(4, 256, 512),
+         dtype="float32"),
+    dict(label="bert-residual-bf16", shape=(4, 128, 768),
+         dtype="bfloat16"),
+]
+
+_EMBEDDING_MATRIX = [
+    # deepfm: 26 slots x [10001, 10] emb tables + [10001, 1] w1 tables
+    dict(label="deepfm-emb", tables=[((10001, 10), "float32")] * 26,
+         batch=256, tiers=1),
+    dict(label="deepfm-w1", tables=[((10001, 1), "float32")] * 26,
+         batch=256, tiers=1),
+    # lazy-adam apply: param + m1 + m2 tiers + the merged-rows block
+    dict(label="deepfm-adam-apply", tables=[((10001, 10), "float32")] * 26,
+         batch=256, tiers=4),
+]
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def lint_kernel_plans() -> Tuple[List[Finding], Dict[str, Any]]:
+    """Audit every Pallas plan family over the canonical matrix.  Returns
+    (findings, report); report maps family -> audited configs with the
+    plan each gate produced (the CI artifact payload)."""
+    from ..kernels import attention as att
+    from ..kernels import conv_bn as cbn
+    from ..kernels import dropout_epilogue as de
+    from ..kernels import embedding as emb
+
+    findings: List[Finding] = []
+    report: Dict[str, Any] = {}
+
+    def audit_attention_matrix(matrix):
+        """Shared by the attention and ring-attention families (ring
+        chunks run the single-device kernels through the SAME gate)."""
+        rows = []
+        for cfg in matrix:
+            shape = ((cfg["b"], cfg["t"], cfg["h"], cfg["d"])
+                     if cfg["fmt"] == "bthd"
+                     else (cfg["b"], cfg["h"], cfg["t"], cfg["d"]))
+            q = _spec(shape, cfg["dtype"])
+            with _pretend_tpu():
+                ok, bq, bk, interp = att._plan(q, q, 512, 512, None,
+                                               cfg["fmt"])
+            check_attention_plan(cfg, ok, bq, bk, interp, findings)
+            rows.append(dict(label=cfg["label"], fmt=cfg["fmt"],
+                             accepted=bool(ok), block_q=int(bq),
+                             block_k=int(bk)))
+        return rows
+
+    report["attention"] = audit_attention_matrix(_ATTENTION_MATRIX)
+
+    rows = []
+    for cfg in _QKV_MATRIX:
+        x = _spec((cfg["b"], cfg["t"], cfg["dm"]), cfg["dtype"])
+        with _pretend_tpu():
+            ok, bq, bk, interp = att._qkv_plan(x, cfg["h"], cfg["dh"],
+                                               512, 512, None)
+        check_qkv_plan(cfg, ok, bq, bk, interp, findings)
+        rows.append(dict(label=cfg["label"], accepted=bool(ok),
+                         block_q=int(bq), block_k=int(bk)))
+    report["qkv_attention"] = rows
+
+    rows = []
+    for cfg in _CONV_BN_MATRIX:
+        with _pretend_tpu():
+            if cfg.get("kind") == "dot":
+                plan = cbn._dot_plan(cfg["rows"], cfg["c"], cfg["dtype"],
+                                     None)
+            else:
+                plan = cbn._plan(cfg["rows"], cfg["c"], cfg["dtype"], None)
+        check_conv_bn_plan(cfg, plan, findings)
+        if plan is None:
+            rows.append(dict(label=cfg["label"], accepted=False))
+        elif cfg.get("kind") == "dot":
+            rows.append(dict(label=cfg["label"], accepted=True,
+                             block_m=plan[0], block_n=plan[1]))
+        else:
+            rows.append(dict(label=cfg["label"], accepted=True,
+                             block_r=plan.block_r, block_c=plan.block_c,
+                             fold=plan.fold))
+    report["conv_bn"] = rows
+
+    rows = []
+    for cfg in _DROPOUT_MATRIX:
+        with _pretend_tpu():
+            ok, r, nc, br, interp, hw = de._plan(cfg["shape"],
+                                                 cfg["dtype"], None)
+        check_dropout_plan(cfg, ok, r, nc, br, interp, hw, findings)
+        rows.append(dict(label=cfg["label"], accepted=bool(ok),
+                         block_r=int(br), hw_prng=bool(hw)))
+    report["dropout_epilogue"] = rows
+
+    rows = []
+    for cfg in _EMBEDDING_MATRIX:
+        (v, d), dtype = cfg["tables"][0]
+        block = emb._auto_block_rows(cfg["tiers"], len(cfg["tables"]), d,
+                                     dtype, cfg["batch"])
+        check_embedding_group(cfg, block, findings)
+        rows.append(dict(label=cfg["label"], tables=len(cfg["tables"]),
+                         block_rows=int(block), tiers=cfg["tiers"]))
+    report["embedding"] = rows
+
+    # ring attention reuses the attention _plan gate per sequence CHUNK
+    # (kernels/ring_attention.py); audit the real per-rank chunk shapes
+    report["ring_attention"] = audit_attention_matrix(_RING_MATRIX)
+    return findings, report

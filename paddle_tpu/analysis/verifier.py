@@ -1,0 +1,498 @@
+"""Program verifier: pre-compile contract checks over the Python IR.
+
+Capability parity with the reference's build/run-time op validation
+(reference: operator.cc RuntimeInferShape + ENFORCE macros,
+framework/op_desc.cc CheckAttrs, executor.cc:312 CheckTensorNANOrInf
+being the *runtime* tail of it), redesigned TPU-first: since execution
+lowers a whole block to ONE XLA computation, a contract violation that
+the reference would catch per-op at dispatch time here surfaces as an
+opaque trace error (or worse, silently wrong numerics — the PR-4
+unthreaded step key).  This verifier runs the same class of checks
+statically over the Program, BEFORE the trace, and names the op/var.
+
+Checks (Finding.check ids):
+  error severity — gate the executor compile (ProgramVerifyError):
+    unregistered-op    op type has no lowering and is not grad-resolvable
+    use-before-def     an op reads a name no prior op/feed/scope defines
+    shape-contract     a registered infer_shape raises with fully known
+                       input shapes (the reference ENFORCE class)
+    shape-mismatch     declared output shape/dtype differs from what the
+                       op's contract re-infers (stale/corrupt IR)
+    fetch-unreachable  a fetch target no op produces and no feed/scope
+                       var covers
+    rng-unthreaded     an op whose registered lowering derives PRNG bits
+                       (OpDef.derives_rng) is invisible to the executor's
+                       step-key threading (executor.op_threads_rng) — it
+                       would reuse the trace-constant base key every run
+  warning severity — reported (CI gate fails) but do not block compile:
+    dead-op            op contributes to no fetch target and writes no
+                       persistable/scope state
+    dead-var           declared var no op reads or writes, not data/fetch
+    donated-fetch      a var is both donated rw state (read+written
+                       persistable) and a fetch target — the aliasing
+                       class behind the PR-6 stateful-AOT corruption
+    double-write       a persistable/scope var written by 2+ stateful ops
+                       in one block (write-back order becomes load-bearing)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..core import framework as fw
+from ..core import registry
+
+# side-effectful op types that must survive dead-code analysis even when
+# nothing consumes their outputs
+_SIDE_EFFECT_OPS = frozenset({"print", "while", "conditional_block"})
+
+# per-check cap: a single corrupt var cascades through its consumers; the
+# first few findings name the root cause, the rest are noise
+_MAX_FINDINGS_PER_CHECK = 20
+
+
+class Finding:
+    """One named verifier/linter finding."""
+
+    __slots__ = ("check", "severity", "message", "block_idx", "op_index",
+                 "op_type", "var")
+
+    def __init__(self, check: str, severity: str, message: str,
+                 block_idx: Optional[int] = None,
+                 op_index: Optional[int] = None,
+                 op_type: Optional[str] = None,
+                 var: Optional[str] = None):
+        self.check = check
+        self.severity = severity
+        self.message = message
+        self.block_idx = block_idx
+        self.op_index = op_index
+        self.op_type = op_type
+        self.var = var
+
+    def to_dict(self) -> dict:
+        return {
+            "check": self.check,
+            "severity": self.severity,
+            "message": self.message,
+            "block": self.block_idx,
+            "op_index": self.op_index,
+            "op_type": self.op_type,
+            "var": self.var,
+        }
+
+    def __repr__(self):
+        where = ""
+        if self.op_type is not None:
+            where = f" [op {self.op_type}"
+            if self.block_idx is not None:
+                where += f" @ block {self.block_idx}:{self.op_index}"
+            where += "]"
+        return f"{self.severity}:{self.check}{where} {self.message}"
+
+    __str__ = __repr__
+
+
+class ProgramVerifyError(RuntimeError):
+    """Raised by verify_or_raise when error-severity findings exist.
+    Carries ALL findings (warnings included) on .findings."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = findings
+        errors = [f for f in findings if f.severity == "error"]
+        lines = [f"program verification failed ({len(errors)} error(s)):"]
+        lines += [f"  {f}" for f in findings]
+        super().__init__("\n".join(lines))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _initial_defined(program: fw.Program, feed_names, scope) -> set:
+    """Names defined before the first op runs: feeds, scope-resident vars,
+    and declared vars the startup program materializes (persistable /
+    data / initializer-carrying)."""
+    defined = set(feed_names)
+    for blk in program.blocks:
+        for name, v in blk.vars.items():
+            if (v.persistable or v.is_data
+                    or getattr(v, "initializer", None) is not None):
+                defined.add(name)
+            elif scope is not None and scope.has_var(name):
+                defined.add(name)
+    return defined
+
+
+def _sub_blocks(op: fw.Operator):
+    for a in op.attrs.values():
+        if isinstance(a, fw.Block):
+            yield a
+
+
+def _iter_ops_recursive(block: fw.Block):
+    for op in block.ops:
+        yield block, op
+        for sub in _sub_blocks(op):
+            yield from _iter_ops_recursive(sub)
+
+
+def _writes_recursive(op: fw.Operator) -> set:
+    """All names written by the op, including inside its sub-blocks."""
+    out = set(n for n in op.output_arg_names() if n)
+    for sub in _sub_blocks(op):
+        for sop in sub.ops:
+            out |= _writes_recursive(sop)
+    return out
+
+
+def _reads_recursive(op: fw.Operator) -> set:
+    out = set(n for n in op.input_arg_names() if n)
+    for sub in _sub_blocks(op):
+        for sop in sub.ops:
+            out |= _reads_recursive(sop)
+    return out
+
+
+class _Capped:
+    """Append findings with a per-check cap (cascades name their root in
+    the first few findings; the tail is noise)."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = findings
+        self._counts: Dict[str, int] = {}
+
+    def add(self, f: Finding):
+        n = self._counts.get(f.check, 0)
+        if n < _MAX_FINDINGS_PER_CHECK:
+            self.findings.append(f)
+        self._counts[f.check] = n + 1
+
+
+# ---------------------------------------------------------------------------
+# individual checks
+# ---------------------------------------------------------------------------
+
+
+def _check_def_before_use(program, defined0: set, cap: _Capped):
+    """Strict in-order def-before-use on the global block; sub-blocks get
+    the weaker defined-ANYWHERE rule (loop bodies legitimately read
+    loop-carried names written later in the body)."""
+    gb = program.global_block()
+    defined = set(defined0)
+    for i, op in enumerate(gb.ops):
+        for n in op.input_arg_names():
+            if n and n not in defined:
+                cap.add(Finding(
+                    "use-before-def", "error",
+                    f"op {op.type!r} (block 0, index {i}) reads {n!r} "
+                    f"before any feed, scope var, or prior op defines it",
+                    block_idx=0, op_index=i, op_type=op.type, var=n))
+        for sub in _sub_blocks(op):
+            _check_sub_block_uses(sub, defined | _writes_recursive(op), cap)
+        for n in op.output_arg_names():
+            if n:
+                defined.add(n)
+
+
+def _check_sub_block_uses(block: fw.Block, outer_defined: set, cap: _Capped):
+    available = set(outer_defined)
+    available.update(block.vars)
+    for op in block.ops:
+        available |= _writes_recursive(op)
+    for i, op in enumerate(block.ops):
+        for n in op.input_arg_names():
+            if n and n not in available:
+                cap.add(Finding(
+                    "use-before-def", "error",
+                    f"op {op.type!r} (block {block.idx}, index {i}) reads "
+                    f"{n!r}, which nothing in the block, its parents, or "
+                    f"the feed/scope defines",
+                    block_idx=block.idx, op_index=i, op_type=op.type,
+                    var=n))
+        for sub in _sub_blocks(op):
+            _check_sub_block_uses(sub, available, cap)
+
+
+def _check_shape_contracts(program, cap: _Capped):
+    """Re-run every registered infer_shape in program order and compare
+    against the declared output shapes/dtypes.  The program is restored
+    bit-exact afterwards (set_output mutates Variable.shape, which feeds
+    the fingerprint)."""
+    snapshot: List[Tuple[Any, Any, Any]] = []
+    for blk in program.blocks:
+        for v in blk.vars.values():
+            snapshot.append((v, v.shape, v.dtype))
+    try:
+        for blk, op in _iter_ops_recursive(program.global_block()):
+            opdef = registry.lookup(op.type)
+            if opdef is None or opdef.infer_shape is None:
+                continue
+            declared = {}
+            for n in op.output_arg_names():
+                if not n:
+                    continue
+                v = op.block._find_var_recursive(n)
+                if v is not None:
+                    declared[n] = (v.shape, v.dtype, v)
+            try:
+                opdef.infer_shape(fw.InferShapeContext(op))
+            except Exception as e:
+                # mirror Operator.__init__: a failure with fully known
+                # input shapes is a real contract violation
+                shapes = {}
+                all_known = True
+                for names in op.inputs.values():
+                    for n in names:
+                        if not n:
+                            continue
+                        v = op.block._find_var_recursive(n)
+                        s = v.shape if v is not None else None
+                        shapes[n] = s
+                        if s is None:
+                            all_known = False
+                if all_known and shapes:
+                    cap.add(Finding(
+                        "shape-contract", "error",
+                        f"infer_shape of op {op.type!r} failed with fully "
+                        f"known input shapes {shapes}: {e}",
+                        block_idx=blk.idx, op_type=op.type))
+                continue
+            for n, (shape0, dtype0, v) in declared.items():
+                if shape0 is not None and v.shape is not None \
+                        and tuple(shape0) != tuple(v.shape):
+                    cap.add(Finding(
+                        "shape-mismatch", "error",
+                        f"op {op.type!r} declares output {n!r} shape "
+                        f"{tuple(shape0)} but its contract infers "
+                        f"{tuple(v.shape)}",
+                        block_idx=blk.idx, op_type=op.type, var=n))
+                elif dtype0 != v.dtype:
+                    cap.add(Finding(
+                        "shape-mismatch", "error",
+                        f"op {op.type!r} declares output {n!r} dtype "
+                        f"{dtype0} but its contract infers {v.dtype}",
+                        block_idx=blk.idx, op_type=op.type, var=n))
+    finally:
+        for v, shape, dtype in snapshot:
+            v.shape = shape
+            v.dtype = dtype
+
+
+def _check_rng_threading(program, cap: _Capped):
+    """BIDIRECTIONAL cross-check of the two independent RNG declarations:
+    registry derives_rng metadata vs the executor's step-key threading
+    sets.  declared-but-unthreaded = the PR-4 frozen-mask class;
+    threaded-but-undeclared = the metadata contract is stale, so the NEXT
+    consumer of derives_rng (this verifier included) mis-models the op."""
+    from ..core import executor as ex
+
+    for blk, op in _iter_ops_recursive(program.global_block()):
+        opdef = registry.lookup(op.type)
+        if opdef is None:
+            continue
+        if not opdef.op_derives_rng(op):
+            if (not op.type.endswith("_grad")
+                    and ex.op_threads_rng(op)):
+                cap.add(Finding(
+                    "rng-undeclared", "error",
+                    f"op {op.type!r} is in the executor's step-key "
+                    f"threading sets (_RANDOM_OPS/_EXTRA_RANDOM_OPS) but "
+                    f"its registration carries no derives_rng metadata — "
+                    f"declare it via registry.register(..., derives_rng=...)"
+                    f" so the contract stays two-sided",
+                    block_idx=blk.idx, op_type=op.type))
+            continue
+        if not ex.op_threads_rng(op):
+            cap.add(Finding(
+                "rng-unthreaded", "error",
+                f"op {op.type!r} declares derives_rng (its lowering draws "
+                f"PRNG bits) but executor.op_threads_rng does not cover "
+                f"it: plain Executor.run would reuse the trace-constant "
+                f"base key on every step (the PR-4 dropout_add bug class)."
+                f" In-tree ops belong in executor._RANDOM_OPS / "
+                f"_COND_RANDOM_OPS; downstream ops call "
+                f"executor.register_random_op({op.type!r}).",
+                block_idx=blk.idx, op_type=op.type))
+
+
+def _check_fetch_reachable(program, defined0, fetch_names, cap: _Capped):
+    produced = set(defined0)
+    for op in program.global_block().ops:
+        produced |= set(n for n in op.output_arg_names() if n)
+    for n in fetch_names:
+        if n and n not in produced:
+            cap.add(Finding(
+                "fetch-unreachable", "error",
+                f"fetch target {n!r} is produced by no op and covered by "
+                f"no feed/scope/persistable var",
+                var=n))
+
+
+def _check_dead_code(program, feed_names, fetch_names, scope, cap: _Capped):
+    gb = program.global_block()
+
+    def _stateful_write(op) -> bool:
+        for n in op.output_arg_names():
+            if not n:
+                continue
+            v = op.block._find_var_recursive(n)
+            if v is not None and v.persistable:
+                return True
+            if scope is not None and scope.has_var(n):
+                return True
+        return False
+
+    # ---- dead ops: backward slice from fetches + stateful writes -------
+    if fetch_names:
+        needed = set(fetch_names)
+        keep_flags = [False] * len(gb.ops)
+        for i in range(len(gb.ops) - 1, -1, -1):
+            op = gb.ops[i]
+            keep = (
+                op.type in _SIDE_EFFECT_OPS
+                or any(o in needed for o in op.output_arg_names())
+                or _stateful_write(op)
+            )
+            if keep:
+                keep_flags[i] = True
+                needed |= _reads_recursive(op)
+        for i, op in enumerate(gb.ops):
+            if not keep_flags[i]:
+                cap.add(Finding(
+                    "dead-op", "warning",
+                    f"op {op.type!r} (block 0, index {i}, outputs "
+                    f"{[n for n in op.output_arg_names() if n][:4]}) "
+                    f"contributes to no fetch target and writes no "
+                    f"persistable/scope state",
+                    block_idx=0, op_index=i, op_type=op.type))
+
+    # ---- dead vars: declared but referenced by no op -------------------
+    referenced: set = set()
+    for _, op in _iter_ops_recursive(gb):
+        referenced |= set(op.input_arg_names())
+        referenced |= set(op.output_arg_names())
+    keep_names = set(feed_names) | set(fetch_names)
+    for blk in program.blocks:
+        for name, v in blk.vars.items():
+            if name in referenced or name in keep_names:
+                continue
+            if v.persistable or v.is_data:
+                continue
+            if v.type != fw.VarType.DENSE_TENSOR:
+                continue
+            cap.add(Finding(
+                "dead-var", "warning",
+                f"var {name!r} (block {blk.idx}) is declared but no op "
+                f"reads or writes it",
+                block_idx=blk.idx, var=name))
+
+
+def _check_alias_conflicts(program, feed_names, fetch_names, scope,
+                           cap: _Capped):
+    """Donation hazards, mirroring the executor's rw-state split
+    (analyze_block_io): a var read before written AND written among the
+    persistable/scope set gets its buffer DONATED to the executable."""
+    gb = program.global_block()
+
+    def _is_state(n: str) -> bool:
+        v = gb._find_var_recursive(n)
+        if v is not None and v.persistable:
+            return True
+        return scope is not None and scope.has_var(n)
+
+    defined = set(feed_names)
+    reads_before_write: set = set()
+    writers: Dict[str, List[str]] = {}
+    for op in gb.ops:
+        in_names = set(n for n in op.input_arg_names() if n)
+        for sub in _sub_blocks(op):
+            for _, sop in _iter_ops_recursive(sub):
+                in_names |= set(n for n in sop.input_arg_names() if n)
+        for n in in_names:
+            if n not in defined and _is_state(n):
+                reads_before_write.add(n)
+                defined.add(n)
+        for n in op.output_arg_names():
+            if not n:
+                continue
+            defined.add(n)
+            if _is_state(n):
+                writers.setdefault(n, []).append(op.type)
+
+    rw = reads_before_write & set(writers)
+    for n in sorted(rw & set(fetch_names)):
+        cap.add(Finding(
+            "donated-fetch", "warning",
+            f"var {n!r} is donated rw state (read+written persistable, "
+            f"updated in place in HBM) AND a fetch target — the aliasing "
+            f"class behind the v1 stateful-AOT corruption (PR 6); fetch a "
+            f"copy or drop the fetch",
+            var=n))
+    for n, ops in sorted(writers.items()):
+        if len(ops) > 1:
+            cap.add(Finding(
+                "double-write", "warning",
+                f"persistable/scope var {n!r} is written by {len(ops)} "
+                f"ops in one block ({ops[:4]}): the scope write-back "
+                f"order becomes load-bearing",
+                var=n))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def verify_program(
+    program: fw.Program,
+    feed_names: Sequence[str] = (),
+    fetch_names: Sequence[str] = (),
+    scope=None,
+    check_dead: bool = True,
+) -> List[Finding]:
+    """Run every static check over `program`; returns ALL findings
+    (errors first).  Never mutates the program (shape re-inference is
+    snapshot/restored)."""
+    findings: List[Finding] = []
+    cap = _Capped(findings)
+    fetch_names = [
+        v.name if isinstance(v, fw.Variable) else v for v in fetch_names
+    ]
+    defined0 = _initial_defined(program, feed_names, scope)
+
+    gb = program.global_block()
+    for blk, op in _iter_ops_recursive(gb):
+        if registry.lookup(op.type) is None \
+                and registry.get_grad_lowering(op.type) is None:
+            cap.add(Finding(
+                "unregistered-op", "error",
+                f"op type {op.type!r} has no registered lowering and no "
+                f"grad-resolvable forward op",
+                block_idx=blk.idx, op_type=op.type))
+    _check_def_before_use(program, defined0, cap)
+    _check_shape_contracts(program, cap)
+    _check_rng_threading(program, cap)
+    _check_fetch_reachable(program, defined0, fetch_names, cap)
+    if check_dead:
+        _check_dead_code(program, feed_names, fetch_names, scope, cap)
+    _check_alias_conflicts(program, feed_names, fetch_names, scope, cap)
+
+    findings.sort(key=lambda f: (f.severity != "error", f.check))
+    return findings
+
+
+def verify_or_raise(program, feed_names=(), fetch_names=(), scope=None,
+                    check_dead: bool = False):
+    """The executor's pre-compile gate: raise ProgramVerifyError when any
+    ERROR-severity finding exists.  Dead-code analysis is off by default
+    here — partially-fetched programs are legitimate at run time (the
+    executor prunes nothing); the CLI/CI path (tools/graph_lint.py) runs
+    it with check_dead=True and gates on warnings too."""
+    findings = verify_program(program, feed_names=feed_names,
+                              fetch_names=fetch_names, scope=scope,
+                              check_dead=check_dead)
+    if any(f.severity == "error" for f in findings):
+        raise ProgramVerifyError(findings)
+    return findings

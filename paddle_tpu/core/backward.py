@@ -121,10 +121,46 @@ def append_backward(
 
     processed_grad_names: Set[str] = {loss_grad}
 
+    # No-grad branch pruning (reference: backward.py:204
+    # _remove_no_grad_branch_): a var can carry gradient only if it chains
+    # down to a trainable leaf (a param, a stop_gradient=False data var,
+    # or an explicitly requested grad).  Without this, a subgraph rooted
+    # ONLY at stop-gradient vars (e.g. reshapes of a label-weight feed
+    # used by two consumers) still gets grad + sum ops appended — dead
+    # weight XLA DCEs at trace time but the IR carries forever (surfaced
+    # by the static verifier's dead-op check on transformer/BERT).
+    produced_in_slice: Set[str] = set()
+    for i in fwd_idx:
+        produced_in_slice.update(block.ops[i].output_arg_names())
+    useful: Set[str] = set(_want_grads or ())
+    for i in fwd_idx:
+        for n in block.ops[i].input_arg_names():
+            if n and n not in produced_in_slice and n not in no_grad:
+                useful.add(n)  # leaf the slice reads: param / trainable data
+    for i in fwd_idx:
+        op = block.ops[i]
+        opdef = registry.lookup(op.type)
+        if opdef is not None and opdef.no_grad:
+            continue
+        if any(n in useful for n in op.input_arg_names()):
+            useful.update(
+                n for n in op.output_arg_names() if n and n not in no_grad
+            )
+
     for i in reversed(fwd_idx):
         op = block.ops[i]
         opdef = registry.lookup(op.type)
         if opdef is not None and opdef.no_grad:
+            continue
+        # any inputs needing grads?  (checked BEFORE materializing output
+        # grads: an op whose inputs all sit on pruned/no-grad branches
+        # must not leave orphaned assign/sum combines behind)
+        wants = [
+            n
+            for n in op.input_arg_names()
+            if n and n not in no_grad and n in useful
+        ]
+        if not wants:
             continue
         # materialize output grads; skip op if no output contributes
         out_grads_exist = False
@@ -133,21 +169,17 @@ def append_backward(
                 out_grads_exist = True
         if not out_grads_exist:
             continue
-        # any inputs needing grads?
-        wants = [
-            n
-            for n in op.input_arg_names()
-            if n and n not in no_grad
-        ]
-        if not wants:
-            continue
 
         maker = (
             opdef.grad_maker
             if (opdef is not None and opdef.grad_maker is not None)
             else registry.default_grad_maker
         )
-        grad_op_descs = maker(op, no_grad)
+        # inputs on pruned branches get grad holes, like no_grad members
+        hole_set = no_grad | {
+            n for n in op.input_arg_names() if n and n not in useful
+        }
+        grad_op_descs = maker(op, hole_set)
         for desc in grad_op_descs:
             # rewrite grad outputs that already have contributions (another
             # consumer already produced grad for the same var): rename + defer

@@ -314,6 +314,40 @@ _RANDOM_OPS = frozenset(
 )
 
 
+# Ops whose randomness is attr-gated: they draw from the step key only
+# when their in-kernel weights dropout is armed.
+_COND_RANDOM_OPS = frozenset({"fused_attention", "fused_qkv_attention"})
+
+# Extension point for ops registered OUTSIDE the core tree: a downstream
+# registry.register(..., derives_rng=True) op must also call this so the
+# executor threads the step key for it — the static verifier's
+# rng-unthreaded check enforces the pairing.  (In-tree ops use the
+# hand-maintained sets above: keeping them independent of the registry
+# metadata is deliberate defense-in-depth — the verifier cross-checks the
+# two, so a random op missing from EITHER side is a named pre-compile
+# error instead of a frozen-mask bug.)
+_EXTRA_RANDOM_OPS: set = set()
+
+
+def register_random_op(op_type: str) -> None:
+    """Declare that `op_type`'s lowering draws PRNG bits from the step
+    key.  Pairs with registry.register(..., derives_rng=...); the
+    verifier (paddle_tpu/analysis) rejects programs whose derives_rng
+    ops are not known here."""
+    _EXTRA_RANDOM_OPS.add(op_type)
+
+
+# ONE process-wide mutex for program verification: the verifier's shape
+# re-inference temporarily mutates Variable.shape on the Program being
+# verified (snapshot/restored), and a Program can be shared across
+# Executor instances (train + eval executors, per-thread executors over
+# default_main_program) — a per-executor lock would let two executors'
+# verifies interleave on the same IR.
+import threading as _threading
+
+_VERIFY_MUTEX = _threading.Lock()
+
+
 def _iter_ops_recursive(block: fw.Block):
     """Yield the block's ops, descending into sub_block attrs (while /
     conditional_block bodies)."""
@@ -324,18 +358,31 @@ def _iter_ops_recursive(block: fw.Block):
             yield from _iter_ops_recursive(sub)
 
 
+def op_threads_rng(op) -> bool:
+    """Whether the executor threads the step key on account of THIS op.
+
+    The single source of truth for step-key threading: program_uses_random
+    folds it over the block, and the static verifier
+    (paddle_tpu/analysis/verifier.py) cross-checks it against the
+    registry's derives_rng contract metadata — an op whose lowering draws
+    PRNG bits but is invisible here would reuse the trace-constant base
+    key on every plain run (the PR-4 dropout_add bug class), so the
+    verifier turns that mismatch into a pre-compile error."""
+    return bool(
+        op.type in _RANDOM_OPS
+        or op.type in _EXTRA_RANDOM_OPS
+        or op.type.endswith("_grad")
+        or (op.type in _COND_RANDOM_OPS
+            and op.attrs.get("dropout_rate", 0.0))
+    )
+
+
 def program_uses_random(block: fw.Block) -> bool:
     """Whether lowering may draw PRNG bits (then the compiled fn takes a key
     argument).  Grad ops count: the generic vjp re-traces forward lowerings.
     fused_attention / fused_qkv_attention count only when their in-kernel
     weights dropout is on (the mask seed derives from the step key)."""
-    return any(
-        op.type in _RANDOM_OPS
-        or op.type.endswith("_grad")
-        or (op.type in ("fused_attention", "fused_qkv_attention")
-            and op.attrs.get("dropout_rate", 0.0))
-        for op in _iter_ops_recursive(block)
-    )
+    return any(op_threads_rng(op) for op in _iter_ops_recursive(block))
 
 
 def analyze_block_io(
@@ -462,6 +509,13 @@ class Executor:
         self._cache: Dict[Any, _CompiledEntry] = {}
         self._ref_names_cache: Dict[Any, tuple] = {}
         self._run_counter = 0
+        # pre-compile static-verification memo: (program fingerprint,
+        # scope signature, feeds, fetches) already verified by this
+        # executor — verification runs at most once per signature, so a
+        # warm serving process never re-walks a program
+        # (paddle_tpu/analysis).  Mutation safety rides the module-level
+        # _VERIFY_MUTEX (a Program can be shared across executors).
+        self._verified = set()
         # Serving threads (paddle_tpu/serving dynamic batcher, user thread
         # pools over Predictor) hammer run() concurrently: the compile
         # cache uses per-key locks so N threads x M signatures compile
@@ -931,6 +985,7 @@ class Executor:
         import jax
         import jax.numpy as jnp
 
+        self._maybe_verify(program, feed_names, fetch_names, scope)
         block = program.global_block()
         opt_bit = fw.OpRole.Optimize
         prefix_ops = [
@@ -1069,6 +1124,7 @@ class Executor:
         import jax
         import jax.numpy as jnp
 
+        self._maybe_verify(program, feed_names, fetch_names, scope)
         block = program.global_block()
         state_reads, state_writes = analyze_block_io(block, feed_names, scope)
         write_set = set(state_writes)
@@ -1304,6 +1360,42 @@ class Executor:
                 sum(int(getattr(o, "nbytes", 0) or 0) for o in np_outs))
 
     # -- internals -------------------------------------------------------
+    def _maybe_verify(self, program, feed_names, fetch_names, scope):
+        """Pre-compile static verification gate (FLAGS_verify_program).
+
+        Runs the paddle_tpu.analysis program verifier BEFORE tracing so
+        contract violations (use-before-def, shape mismatches, donation/
+        fetch aliasing, unthreaded RNG ops) surface as named findings
+        instead of late XLA trace errors — the TPU-side analogue of the
+        reference's per-op RuntimeInferShape ENFORCE (operator.cc).
+
+        Cost model: one flag read when off (zero hot-path cost); when on,
+        one O(program) walk per (fingerprint, feeds, fetches) signature —
+        compile-time only, memoized, so warm serving paths never pay it."""
+        from ..flags import FLAGS
+
+        if not FLAGS.verify_program:
+            return
+        # the scope signature is part of the key for the same reason it
+        # is part of the compile-cache key: use-before-def / alias / dead
+        # checks read the scope, so a recompile forced by a differently-
+        # populated scope must re-verify, not hit the memo
+        vkey = (program.fingerprint(),
+                self._scope_signature(program, feed_names, scope),
+                tuple(feed_names), tuple(fetch_names))
+        if vkey in self._verified:
+            return
+        from ..analysis import verify_or_raise
+
+        # serialized process-wide: the verifier's shape re-inference
+        # mutates (then restores) the shared Program's Variable shapes
+        with _VERIFY_MUTEX:
+            if vkey in self._verified:
+                return
+            verify_or_raise(program, feed_names=feed_names,
+                            fetch_names=fetch_names, scope=scope)
+            self._verified.add(vkey)
+
     def _next_run_id(self) -> int:
         """Draw the next run-counter value under a lock: key-deriving
         programs fold this into their PRNG key, and concurrent serving
@@ -1362,6 +1454,7 @@ class Executor:
     def _compile(self, program, feed, feed_names, fetch_names, scope):
         import jax
 
+        self._maybe_verify(program, feed_names, fetch_names, scope)
         block = program.global_block()
         state_reads, state_writes = analyze_block_io(block, feed_names, scope)
 

@@ -59,6 +59,7 @@ class OpDef:
         grad_maker: Optional[Callable] = None,
         no_grad: bool = False,
         inplace_outputs: Optional[Dict[str, str]] = None,
+        derives_rng=False,
         doc: str = "",
     ):
         self.type = type
@@ -68,7 +69,22 @@ class OpDef:
         self.no_grad = no_grad
         # output slot -> input slot aliases (optimizer in-place updates)
         self.inplace_outputs = inplace_outputs or {}
+        # RNG contract metadata: whether the LOWERING may call
+        # ctx.next_rng_key() (draw from the step key).  Either a bool or a
+        # predicate `fn(op) -> bool` for ops whose randomness is attr-gated
+        # (fused attention weights-dropout).  The executor's step-key
+        # threading (executor.op_threads_rng) must cover every op for which
+        # this is true — the static verifier (paddle_tpu/analysis) checks
+        # that, turning the PR-4 "random op missing from _RANDOM_OPS" bug
+        # class into a pre-compile error.
+        self.derives_rng = derives_rng
         self.doc = doc
+
+    def op_derives_rng(self, op) -> bool:
+        """Whether THIS op instance may draw PRNG bits when lowered."""
+        if callable(self.derives_rng):
+            return bool(self.derives_rng(op))
+        return bool(self.derives_rng)
 
 
 _registry: Dict[str, OpDef] = {}
@@ -80,12 +96,16 @@ def register(
     grad_maker=None,
     no_grad=False,
     inplace_outputs=None,
+    derives_rng=False,
     doc="",
 ):
     """Decorator registering `fn` as the lowering for op `type`.
 
     The lowering signature is `fn(ctx, ins) -> {out_slot: [values]}` where
-    `ins` maps input slot -> list of traced jax values.
+    `ins` maps input slot -> list of traced jax values.  Lowerings that
+    call ctx.next_rng_key() MUST declare derives_rng (bool or
+    `fn(op) -> bool`); the static verifier cross-checks the declaration
+    against the executor's step-key threading.
     """
 
     def deco(fn):
@@ -98,6 +118,7 @@ def register(
             grad_maker=grad_maker,
             no_grad=no_grad,
             inplace_outputs=inplace_outputs,
+            derives_rng=derives_rng,
             doc=doc or (fn.__doc__ or ""),
         )
         return fn

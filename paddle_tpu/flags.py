@@ -181,6 +181,18 @@ FLAGS.define(
     "from scalar seeds in the backward, no mask or random-bits tensor in "
     "HBM; off = the separate graph-level hash dropout + add ops")
 FLAGS.define(
+    "verify_program", bool, True,
+    "run the static program verifier (paddle_tpu/analysis) before every "
+    "executor compile: def-before-use/SSA across blocks, shape+dtype "
+    "contract re-inference, donation/fetch alias conflicts, and the "
+    "RNG-determinism lint (key-deriving ops the executor would not "
+    "thread the step key for) all raise ProgramVerifyError with named "
+    "findings instead of surfacing as late XLA trace errors.  Verified "
+    "signatures are memoized per executor, so the cost is one O(program) "
+    "walk per compile — zero hot-path cost; the inference server flips "
+    "it off once all models are warm (serving/server.py _warmup_verified) "
+    "so cold-signature stragglers skip straight to the trace")
+FLAGS.define(
     "vlog", int, 0,
     "verbose logging level, like glog's VLOG(n) (reference init.cc "
     "InitGLOG); see paddle_tpu.log")

@@ -393,9 +393,24 @@ def _plan(q, k, block_q, block_k, interpret, fmt="bhtd"):
     if fmt == "bthd":
         # whole-head blocks: each kv tile is [block, h, d] — cap the block
         # so the bwd kernel's working set fits vmem (block=512 with
-        # h*d=512 fails to compile; 256 is the measured safe bound:
-        # block * h * d * 2B = 256 KB per kv tile)
-        cap = max(128, (256 * 1024) // max(h * d * 2, 1))
+        # h*d=512 bf16 fails to compile; 256 is the measured safe bound:
+        # 256 KB per kv tile).  The bound is in BYTES, so the cap scales
+        # with the dtype: the original hardcoded 2-byte element size let
+        # f32 tiles reach 512 KB (caught by the kernel plan linter,
+        # analysis/kernel_lint.py).  When even the smallest Mosaic-
+        # alignable block (128 lanes) busts the bound, compiled TPU mode
+        # must REJECT to the XLA fallback — flooring to 128 would re-admit
+        # the exact oversized-tile compile failure the cap exists for
+        # (interpret mode has no tile bound; keep the floor there so CPU
+        # tests still exercise the kernels).
+        import numpy as np
+
+        esize = np.dtype(q.dtype).itemsize
+        cap = (256 * 1024) // max(h * d * esize, 1)
+        if cap < 128:
+            if on_tpu and not interpret:
+                return False, 0, 0, interpret
+            cap = 128
         block_q = min(block_q, cap)
         block_k = min(block_k, cap)
     if on_tpu and not interpret:
@@ -1666,7 +1681,15 @@ def _qkv_plan(x, n_head, d_head, block_q, block_k, interpret, bias=None):
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     esize = 2 if x.dtype.itemsize == 2 else 4
-    cap = max(128, (256 * 1024) // max(dm * esize, 1))
+    # same byte-bound cap discipline as the bthd plan: streamed x/g tiles
+    # are [block, dm]; when a 128-row tile already exceeds the 256 KB
+    # bound, compiled mode rejects to the composed fallback instead of
+    # flooring the cap back up to 128 (kernel-lint catch)
+    cap = (256 * 1024) // max(dm * esize, 1)
+    if cap < 128:
+        if on_tpu and not interpret:
+            return False, 0, 0, interpret
+        cap = 128
     block_q = min(block_q, cap)
     block_k = min(block_k, cap)
     if on_tpu and not interpret:

@@ -42,7 +42,15 @@ def _bias_is_trainable(ctx, bias):
         return True  # unknown provenance: stay correct
 
 
-@register("fused_attention")
+# attr-gated randomness: in-kernel weights dropout draws its mask seed from
+# the step key only when dropout_rate is armed — the SAME predicate the
+# executor's step-key threading uses (executor._COND_RANDOM_OPS), and what
+# the static verifier cross-checks (paddle_tpu/analysis/verifier.py)
+def _attn_derives_rng(op) -> bool:
+    return bool(op.attrs.get("dropout_rate", 0.0))
+
+
+@register("fused_attention", derives_rng=_attn_derives_rng)
 def lower_fused_attention(ctx, ins):
     """Flash attention over [B,H,T,D] (fmt "bhtd") or [B,T,H,D] (fmt
     "bthd") q/k/v with optional additive bias.  "bthd" is the
@@ -82,7 +90,8 @@ def _fused_qkv_infer(ctx):
                        ctx.input_dtype("X"))
 
 
-@register("fused_qkv_attention", infer_shape=_fused_qkv_infer)
+@register("fused_qkv_attention", infer_shape=_fused_qkv_infer,
+          derives_rng=_attn_derives_rng)
 def lower_fused_qkv_attention(ctx, ins):
     """Self-attention with the qkv/output projections fused INTO the flash
     kernels (kernels/attention.py flash_qkv_attention): X [b, t, d_model],

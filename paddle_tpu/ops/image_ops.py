@@ -89,7 +89,8 @@ def _crop_infer(ctx):
                        ctx.input_dtype("X"))
 
 
-@register("random_crop", no_grad=True, infer_shape=_crop_infer)
+@register("random_crop", no_grad=True, infer_shape=_crop_infer,
+          derives_rng=True)
 def lower_random_crop(ctx, ins):
     """Crop a random window of attr `shape` from each instance's trailing
     dims (reference random_crop_op.cc/.h RandomCropFunctor; the Seed

@@ -928,7 +928,8 @@ def _dropout_grad_maker(op, no_grad_set, grad_sub_block_map=None):
     ]
 
 
-@register("dropout", infer_shape=_out_infer, grad_maker=_dropout_grad_maker)
+@register("dropout", infer_shape=_out_infer, grad_maker=_dropout_grad_maker,
+          derives_rng=True)
 def lower_dropout(ctx, ins):
     import jax
 
@@ -988,7 +989,7 @@ def _dropout_keep_mask(ctx, jax, shape, p):
     return jax.random.bernoulli(key, 1.0 - p, shape)
 
 
-@register("dropout_add", infer_shape=_out_infer)
+@register("dropout_add", infer_shape=_out_infer, derives_rng=True)
 def lower_dropout_add(ctx, ins):
     """Fused dropout(X) + Residual epilogue (kernels/dropout_epilogue.py):
     one Pallas kernel whose keep-mask is regenerated in-kernel from scalar
@@ -1104,7 +1105,7 @@ def lower_nearest_interp(ctx, ins):
     return {"Out": [out]}
 
 
-@register("nce", no_grad=False)
+@register("nce", no_grad=False, derives_rng=True)
 def lower_nce(ctx, ins):
     """Noise-contrastive estimation loss (reference: operators/nce_op.cc:1,
     nce_op.h ComputeCost).

@@ -35,7 +35,8 @@ def _rand_infer(ctx):
     ctx.set_output("Out", ctx.attr("shape", [1]), ctx.attr("dtype", "float32"))
 
 
-@register("uniform_random", infer_shape=_rand_infer, no_grad=True)
+@register("uniform_random", infer_shape=_rand_infer, no_grad=True,
+          derives_rng=True)
 def lower_uniform_random(ctx, ins):
     import jax
 
@@ -45,7 +46,8 @@ def lower_uniform_random(ctx, ins):
     return {"Out": [out.astype(dtype)]}
 
 
-@register("gaussian_random", infer_shape=_rand_infer, no_grad=True)
+@register("gaussian_random", infer_shape=_rand_infer, no_grad=True,
+          derives_rng=True)
 def lower_gaussian_random(ctx, ins):
     import jax
 
@@ -55,7 +57,8 @@ def lower_gaussian_random(ctx, ins):
     return {"Out": [out.astype(dtype)]}
 
 
-@register("truncated_gaussian_random", infer_shape=_rand_infer, no_grad=True)
+@register("truncated_gaussian_random", infer_shape=_rand_infer, no_grad=True,
+          derives_rng=True)
 def lower_truncated_gaussian_random(ctx, ins):
     import jax
 
@@ -65,7 +68,7 @@ def lower_truncated_gaussian_random(ctx, ins):
     return {"Out": [out.astype(dtype)]}
 
 
-@register("sampling_id", no_grad=True)
+@register("sampling_id", no_grad=True, derives_rng=True)
 def lower_sampling_id(ctx, ins):
     import jax
 
@@ -74,7 +77,7 @@ def lower_sampling_id(ctx, ins):
     return {"Out": [out.astype("int64")]}
 
 
-@register("shuffle_batch", no_grad=True)
+@register("shuffle_batch", no_grad=True, derives_rng=True)
 def lower_shuffle_batch(ctx, ins):
     import jax
 

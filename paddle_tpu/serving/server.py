@@ -257,6 +257,50 @@ def enable_compilation_cache() -> bool:
         return False
 
 
+# Hot-serving policy for the static verifier (FLAGS_verify_program):
+# planned warmup compiles ALWAYS verify; once any warmup in this process
+# completes, the gate drops so cold-signature stragglers (already
+# flight-tagged unplanned compiles) reach the trace as fast as possible.
+# The flag is process-global, so the did-WE-drop-it bookkeeping is too —
+# per-server (or per-model) state would let a second server's warmup, or
+# a late add_model, compile unverified while believing the gate was never
+# touched.  [0] = a warmup in this process dropped the gate.  The lock
+# serializes whole restore->warm->drop sequences: a concurrent add_model
+# finishing mid-way through another warmup's ladder would otherwise drop
+# the gate under the first warmup's remaining planned compiles.
+_VERIFY_DROPPED = [False]
+_WARMUP_LOCK = threading.Lock()
+
+
+def _warmup_verified(warm_fn) -> int:
+    """Run warmup compiles with the verify gate restored (if a prior
+    warmup dropped it), then drop the gate again once warm.  A warmup
+    that warms zero signatures leaves an untouched gate alone — those
+    signatures compile (and verify) on first request instead.  The drop
+    runs in a finally: a warmup that RAISES after the gate was restored
+    must not leave the whole process re-verifying (the hot-serving
+    contract) — a first-warmup failure leaves the untouched gate on, as
+    the process never got warm."""
+    from ..flags import FLAGS
+
+    with _WARMUP_LOCK:
+        if _VERIFY_DROPPED[0] and not FLAGS.verify_program:
+            FLAGS.verify_program = True
+        warmed = 0
+        try:
+            warmed = warm_fn()
+        finally:
+            if (warmed or _VERIFY_DROPPED[0]) and FLAGS.verify_program:
+                FLAGS.verify_program = False
+                if not _VERIFY_DROPPED[0]:
+                    _VERIFY_DROPPED[0] = True
+                    from ..log import vlog
+
+                    vlog(1, "serving: FLAGS_verify_program off after "
+                            "warmup (%d signatures verified)", warmed)
+        return warmed
+
+
 class InferenceServer:
     """Load-many, serve-many: the multi-model production server."""
 
@@ -290,7 +334,8 @@ class InferenceServer:
         self._batchers[config.name] = batcher
         if self._started:
             batcher.start()
-            model.warmup()
+            # a late-added model's planned compiles verify like any other
+            _warmup_verified(model.warmup)
         return model
 
     def model(self, name: str) -> Optional[ServingModel]:
@@ -342,7 +387,8 @@ class InferenceServer:
         """Pre-compile every model's (precision x bucket) ladder; with
         FLAGS.serving_cache_dir set the compiles persist across
         restarts.  Returns total signatures warmed."""
-        return sum(m.warmup() for m in self._models.values())
+        return _warmup_verified(
+            lambda: sum(m.warmup() for m in self._models.values()))
 
     def stop(self) -> None:
         for b in self._batchers.values():

@@ -70,3 +70,14 @@ def _fresh_programs():
     fw.switch_main_program(old_main)
     fw.switch_startup_program(old_startup)
     ex._global_scope = old_scope
+    # serving warmup legitimately flips the verify gate off for its
+    # process ("off in hot serving paths after warmup"); don't let that —
+    # or its process-global did-we-drop-it bookkeeping — leak across tests
+    import sys as _sys
+
+    from paddle_tpu.flags import FLAGS
+
+    FLAGS.reset("verify_program")
+    _sv = _sys.modules.get("paddle_tpu.serving.server")
+    if _sv is not None:
+        _sv._VERIFY_DROPPED[0] = False

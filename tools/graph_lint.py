@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""graph_lint: run the static-analysis tier over the full model matrix.
+
+Builds every bundled model's train graph (and the serving/AOT inference
+programs with their bucket ladder), runs the program verifier
+(paddle_tpu/analysis/verifier.py) over each — def-before-use, shape/dtype
+contract re-inference, dead code, donation/fetch aliasing, RNG threading
+— plus the Pallas plan linter over every kernel family
+(analysis/kernel_lint.py), and emits one JSON findings artifact.
+
+Exit code is non-zero when ANY finding (error OR warning) exists: the CI
+gate (tools/run_ci.sh) archives ci_artifacts/graph_lint.json and fails
+the build on findings.
+
+Usage:
+  python tools/graph_lint.py [--out ci_artifacts/graph_lint.json]
+                             [--models mnist,deepfm,...] [--skip-kernels]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _fresh():
+    """(main, startup) fresh programs under guards; caller enters both."""
+    import paddle_tpu as pt
+    from paddle_tpu.core import framework as fw
+
+    return pt.Program(), pt.Program(), fw.guard_unique_name()
+
+
+def build_mnist():
+    import paddle_tpu as pt
+    from paddle_tpu.models import mnist as M
+
+    prog, startup, guard = _fresh()
+    with guard, pt.program_guard(prog, startup):
+        img, label, avg_cost, acc, _ = M.build_train_net()
+        pt.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+        fetch = [avg_cost.name, acc.name]
+    return [("mnist", prog, ["pixel", "label"], fetch, startup)]
+
+
+def build_resnet():
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet as R
+
+    prog, startup, guard = _fresh()
+    with guard, pt.program_guard(prog, startup):
+        img, label, avg_cost, acc, _ = R.build_train_net(
+            class_dim=1000, image_shape=(3, 224, 224), depth=50, lr=0.1,
+            data_format="NHWC")
+        fetch = [avg_cost.name, acc.name]
+    pt.amp.enable(prog)
+    return [("resnet50", prog, ["image", "label"], fetch, startup)]
+
+
+def build_transformer():
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as T
+
+    out = []
+    prog, startup, guard = _fresh()
+    with guard, pt.program_guard(prog, startup):
+        avg_cost, _, feeds = T.transformer(
+            src_vocab_size=32000, trg_vocab_size=32000, max_length=256,
+            n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+            d_inner_hid=2048, dropout_rate=0.1, src_seq_len=256,
+            trg_seq_len=256, use_flash=True)
+        pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+        fetch = [avg_cost.name]
+    pt.amp.enable(prog)
+    out.append(("transformer-base", prog, list(feeds), fetch, startup))
+
+    # beam-search decoder: While sub-blocks exercise the cross-block walk
+    prog, startup, guard = _fresh()
+    with guard, pt.program_guard(prog, startup):
+        ids, scores, feeds = T.build_decoder(
+            src_vocab_size=1000, trg_vocab_size=1000, max_length=64,
+            n_layer=2, n_head=4, d_key=32, d_value=32, d_model=128,
+            d_inner_hid=256, batch_size=4, src_seq_len=32, max_out_len=8,
+            beam_size=4, use_flash=False)
+        fetch = [ids.name, scores.name]
+    out.append(("transformer-decoder", prog, list(feeds), fetch, startup))
+    return out
+
+
+def build_bert():
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert as B
+
+    prog, startup, guard = _fresh()
+    with guard, pt.program_guard(prog, startup):
+        avg_loss, _ = B.build_pretrain_net(
+            vocab_size=30522, seq_len=128, n_layer=12, n_head=12,
+            d_model=768, d_ff=3072, dropout_rate=0.1, use_flash=True)
+        fetch = [avg_loss.name]
+    pt.amp.enable(prog)
+    feeds = ["src_ids", "pos_ids", "sent_ids", "input_mask",
+             "mask_labels", "mask_weights"]
+    return [("bert-base", prog, feeds, fetch, startup)]
+
+
+def build_deepfm():
+    import paddle_tpu as pt
+    from paddle_tpu.models import deepfm as D
+
+    prog, startup, guard = _fresh()
+    with guard, pt.program_guard(prog, startup):
+        avg_cost, auc_var, _, feeds = D.build_train_net()
+        fetch = [avg_cost.name, auc_var.name]
+    return [("deepfm", prog, list(feeds), fetch, startup)]
+
+
+def build_seq2seq():
+    import paddle_tpu as pt
+    from paddle_tpu.models import seq2seq as S
+
+    prog, startup, guard = _fresh()
+    with guard, pt.program_guard(prog, startup):
+        avg_cost = S.build_train_net()
+        pt.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        fetch = [avg_cost.name]
+    feeds = ["src_word", "trg_word", "trg_next"]
+    return [("seq2seq", prog, feeds, fetch, startup)]
+
+
+def build_serving():
+    """The serving demo inference program (tools/serving_smoke.py's fc
+    stack), pruned test-mode — the graph the AOT bundles serialize and
+    the bucket ladder re-feeds at every batch signature."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.serving.model import parse_buckets
+
+    prog, startup, guard = _fresh()
+    with guard, pt.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[32], dtype="float32")
+        h = x
+        for _ in range(8):
+            h = layers.fc(h, size=256, act="relu")
+        out = layers.fc(h, size=4)
+    pruned = prog.clone(for_test=True).prune([out.name])
+    pruned.feed_var_names = ["x"]
+    pruned.fetch_var_names = [out.name]
+    # ONE inference-program entry: the bucket ladder pads the batch dim of
+    # the SAME program/feeds/fetches (batch is -1 in the IR), so per-rung
+    # re-verification would be byte-identical work; the rung list rides
+    # the entry label so the artifact still names the ladder it covers
+    buckets = parse_buckets(FLAGS.serving_buckets)
+    label = "serving/aot-inference[b" + ",".join(map(str, buckets)) + "]"
+    return [("serving/train", prog, ["x"], [out.name], startup),
+            (label, pruned, ["x"], [out.name], None)]
+
+
+BUILDERS = {
+    "mnist": build_mnist,
+    "resnet": build_resnet,
+    "transformer": build_transformer,
+    "bert": build_bert,
+    "deepfm": build_deepfm,
+    "seq2seq": build_seq2seq,
+    "serving": build_serving,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="ci_artifacts/graph_lint.json",
+                    help="JSON findings artifact path")
+    ap.add_argument("--models", default=",".join(BUILDERS),
+                    help="comma-separated subset of: " + ",".join(BUILDERS))
+    ap.add_argument("--skip-kernels", action="store_true",
+                    help="skip the Pallas plan linter")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis import lint_kernel_plans, verify_program
+
+    report = {"programs": [], "kernel_lint": None}
+    n_findings = 0
+
+    for name in args.models.split(","):
+        builder = BUILDERS.get(name.strip())
+        if builder is None:
+            ap.error(f"unknown model {name!r}")
+        for prog_name, prog, feeds, fetch, startup in builder():
+            findings = verify_program(prog, feed_names=feeds,
+                                      fetch_names=fetch, check_dead=True)
+            if startup is not None:
+                findings += verify_program(startup, check_dead=True)
+            entry = {
+                "name": prog_name,
+                "blocks": len(prog.blocks),
+                "ops": sum(len(b.ops) for b in prog.blocks),
+                "vars": sum(len(b.vars) for b in prog.blocks),
+                "findings": [f.to_dict() for f in findings],
+            }
+            report["programs"].append(entry)
+            n_findings += len(findings)
+            status = "clean" if not findings else f"{len(findings)} finding(s)"
+            print(f"graph_lint: {prog_name:<28} {entry['ops']:>5} ops  "
+                  f"{status}")
+            for f in findings:
+                print(f"  {f}")
+
+    if not args.skip_kernels:
+        kfindings, kreport = lint_kernel_plans()
+        report["kernel_lint"] = {
+            "findings": [f.to_dict() for f in kfindings],
+            "families": kreport,
+        }
+        n_findings += len(kfindings)
+        n_cfg = sum(len(v) for v in kreport.values())
+        status = "clean" if not kfindings else f"{len(kfindings)} finding(s)"
+        print(f"graph_lint: kernel plans              {n_cfg:>5} cfgs "
+              f"{status}")
+        for f in kfindings:
+            print(f"  {f}")
+
+    report["total_findings"] = n_findings
+    out_dir = os.path.dirname(args.out)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"graph_lint: artifact -> {args.out} ({n_findings} finding(s))")
+    return 1 if n_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,33 +3,47 @@
 # run_test:408).  Runs the full validation ladder on a plain CPU host:
 #   1. lint/format gate (ruff or pyflakes when available, else a
 #      compile-all syntax sweep — the gate must exist on a bare image)
-#   2. full test suite on the virtual 8-device CPU mesh
-#   3. bench smoke (real chip if present, else CPU) with telemetry,
+#      + repo-specific AST rules (tools/lint_rules.py: every FLAGS_* read
+#      declared in flags.py, no host clock reads inside kernels/)
+#   2. graph-lint gate: the static-analysis tier (tools/graph_lint.py)
+#      over the FULL model matrix incl. the serving bucket-ladder/AOT
+#      programs + the Pallas kernel plan linter; fails on ANY finding and
+#      archives ci_artifacts/graph_lint.json
+#   3. full test suite on the virtual 8-device CPU mesh
+#   4. bench smoke (real chip if present, else CPU) with telemetry,
 #      flight recorder, and metrics-snapshot artifacts
-#   4. compile-check + multichip dryrun (the driver's graft contract)
-#   5. serving smoke gate: export a model, boot the inference server,
+#   5. chaos kill-and-resume fault-tolerance gate
+#   6. serving smoke gate: export a model, boot the inference server,
 #      drive tools/loadgen.py — p99/batch-fill histograms on /metrics,
 #      zero recompiles across a shape-varying stream, and the dynamic-
 #      batching A/B (batched >= 2x batch-size-1 QPS)
+#   7. compile-check + multichip dryrun (the driver's graft contract)
 # Usage: tools/run_ci.sh [fast]   — "fast" skips the bench smoke.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/6] lint gate"
+echo "== [1/7] lint gate"
 if command -v ruff >/dev/null 2>&1; then
-  ruff check paddle_tpu tools bench.py __graft_entry__.py
+  ruff check paddle_tpu tools tests bench.py __graft_entry__.py
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
-  python -m pyflakes paddle_tpu tools bench.py __graft_entry__.py
+  python -m pyflakes paddle_tpu tools tests bench.py __graft_entry__.py
 else
   echo "-- no ruff/pyflakes in image; falling back to compileall"
-  python -m compileall -q paddle_tpu tools bench.py __graft_entry__.py
+  python -m compileall -q paddle_tpu tools tests bench.py __graft_entry__.py
 fi
+python tools/lint_rules.py
 
-echo "== [2/6] test suite (virtual 8-device CPU mesh)"
+echo "== [2/7] graph-lint gate (static analysis over the model matrix)"
+mkdir -p ci_artifacts
+JAX_PLATFORMS=cpu python tools/graph_lint.py \
+  --out ci_artifacts/graph_lint.json
+echo "-- graph-lint findings artifact: ci_artifacts/graph_lint.json"
+
+echo "== [3/7] test suite (virtual 8-device CPU mesh)"
 python -m pytest tests/ -q
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [3/6] bench smoke (telemetry on; snapshot + flight artifacts)"
+  echo "== [4/7] bench smoke (telemetry on; snapshot + flight artifacts)"
   mkdir -p ci_artifacts
   rm -f ci_artifacts/bench_steps.jsonl  # StepMonitor appends; keep one run
   rm -rf ci_artifacts/flight && mkdir -p ci_artifacts/flight
@@ -119,7 +133,7 @@ PY
 fi
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [4/6] chaos smoke: kill-and-resume fault-tolerance gate"
+  echo "== [5/7] chaos smoke: kill-and-resume fault-tolerance gate"
   # A training subprocess is SIGKILLed mid-run by the chaos harness, then
   # resumed from the latest verifiable checkpoint; the gate passes when the
   # resumed run reports a non-zero start step and finishes.  Artifacts: the
@@ -154,7 +168,7 @@ PY
 fi
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [5/6] serving smoke: dynamic-batching inference gate"
+  echo "== [6/7] serving smoke: dynamic-batching inference gate"
   # Exports a demo model, boots two inference servers (batched + forced
   # --max-batch 1), and drives tools/loadgen.py through both:
   #   * a shape-varying stream must finish with the executor compile
@@ -171,7 +185,7 @@ if [[ "${1:-}" != "fast" ]]; then
   ls ci_artifacts/serving/
 fi
 
-echo "== [6/6] entry compile-check + multichip dryrun"
+echo "== [7/7] entry compile-check + multichip dryrun"
 python __graft_entry__.py
 
 echo "CI OK"
