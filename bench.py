@@ -418,6 +418,104 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
     return [toks / d for d in dt], flops_tok, first_loss, last_loss
 
 
+def bench_decode(batch_size=1, max_tokens=64, tiny=False, repeats=1,
+                 use_flash=True):
+    """Autoregressive decode tokens/sec (ROADMAP item 2's named metric:
+    decode at batch 1 and 64).  One compiled prefill + ONE compiled
+    per-token decode program stepped by the host — the serving-shaped
+    loop (token fetched to host every step).  Route follows
+    FLAGS.kv_cache (the A/B knob: cached O(T) vs full-prefix-recompute
+    O(T²)); FLAGS.flash_decode picks the Pallas decode kernel on TPU.
+
+    Returns (tokens/sec per repeat, prefill_seconds, compile_flat,
+    compile_count): compile_flat asserts the executor compile cache did
+    NOT grow between the end of warmup and the last generated token —
+    the length-independent-compile-key acceptance criterion."""
+    import paddle_tpu as pt
+    from paddle_tpu.generation import GenerationSession
+    from paddle_tpu.models import transformer as T
+
+    cfg = dict(n_layer=2, n_head=4, d_key=32, d_value=32, d_model=128,
+               d_inner_hid=256, vocab=1000, src_len=32,
+               max_out=max(max_tokens, 16)) if tiny else dict(
+        n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+        d_inner_hid=2048, vocab=32000, src_len=256,
+        max_out=max(max_tokens, 64))
+    max_tokens = min(max_tokens, cfg["max_out"])
+    progs = T.build_generation_programs(
+        src_vocab_size=cfg["vocab"], trg_vocab_size=cfg["vocab"],
+        max_length=max(cfg["src_len"], cfg["max_out"]) + 2,
+        n_layer=cfg["n_layer"], n_head=cfg["n_head"], d_key=cfg["d_key"],
+        d_value=cfg["d_value"], d_model=cfg["d_model"],
+        d_inner_hid=cfg["d_inner_hid"], batch_size=batch_size,
+        src_seq_len=cfg["src_len"], max_out_len=cfg["max_out"],
+        # eos outside the sampled range: every run generates exactly
+        # max_tokens tokens (fixed work for the timed region)
+        bos_id=0, eos_id=-1, use_flash=use_flash, strategy="greedy")
+    sess = GenerationSession(progs)
+    sess.init_params()
+    rng = np.random.RandomState(0)
+    src = rng.randint(2, cfg["vocab"],
+                      (batch_size, cfg["src_len"], 1)).astype(np.int64)
+
+    def one_pass(n_tokens):
+        t0 = time.perf_counter()
+        sess.prefill(src)
+        t_prefill = time.perf_counter() - t0
+        tokens = np.full((batch_size,), progs.bos_id, np.int64)
+        prefix = np.full((batch_size, progs.t_buf), progs.bos_id,
+                         np.int64)
+        t1 = time.perf_counter()
+        for t in range(n_tokens):
+            if progs.kv_cache:
+                tokens = sess.decode_step(tokens)
+            else:
+                tokens = sess.decode_step(None, prefix=prefix, t=t)
+                if t + 1 < progs.t_buf:
+                    prefix[:, t + 1] = tokens
+        return t_prefill, time.perf_counter() - t1
+
+    one_pass(2)  # warmup: compiles prefill + decode
+    compiles = sess.compile_count
+    runs, prefill_s = [], None
+    for _ in range(max(repeats, 1)):
+        prefill_s, dt = one_pass(max_tokens)
+        runs.append(batch_size * max_tokens / dt)
+    compile_flat = sess.compile_count == compiles
+    return runs, prefill_s, compile_flat, sess.compile_count
+
+
+def run_decode(args, peak):
+    """Emit decode_tokens_per_sec at the ROADMAP batch pair (1 and 64;
+    tiny shapes under --smoke).  config records the kv_cache /
+    flash_decode flags — tools/run_ci.sh pairs a FLAGS_kv_cache=0
+    recompute record next to the cached one for the A/B — and
+    compile_flat, which run_ci asserts True."""
+    from paddle_tpu.flags import FLAGS
+
+    repeats = _repeats(args)
+    max_tokens = 16 if args.smoke else 64
+    batches = ([1, 8] if args.smoke else [1, 64])
+    if args.batch_size:
+        batches = [args.batch_size]
+    for bs in batches:
+        runs, prefill_s, flat, n_compiles = bench_decode(
+            batch_size=bs, max_tokens=max_tokens, tiny=args.smoke,
+            repeats=repeats)
+        tps, spread, run_list = _mean_spread(runs)
+        emit_metric(
+            f"decode_tokens_per_sec_b{bs}", tps, "tokens/sec",
+            None, None, 0.0,
+            {"batch": bs, "max_tokens": max_tokens, "tiny": args.smoke,
+             "kv_cache": bool(FLAGS.kv_cache),
+             "flash_decode": bool(FLAGS.flash_decode),
+             "prefill_ms": round(prefill_s * 1e3, 2),
+             "compile_flat": bool(flat),
+             "compiled_signatures": n_compiles,
+             "runs": [round(r, 1) for r in run_list],
+             "spread": round(spread, 1)})
+
+
 def bench_ringattn(seq_len=8192, n_head=8, d_head=64, iters=8, warmup=2):
     """Long-context attention kernel line (VERDICT r4 item 3): fwd+bwd
     tokens/sec of the Pallas flash path vs the unfused reference at 8k+
@@ -862,7 +960,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="all",
                    choices=["all", "resnet50", "transformer", "bert",
-                            "deepfm", "mnist", "ringattn", "convbn"])
+                            "deepfm", "mnist", "ringattn", "convbn",
+                            "decode"])
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for a fast correctness pass")
     p.add_argument("--no-amp", dest="amp", action="store_false")
@@ -919,6 +1018,13 @@ def main():
         # full-bench content and the resnet50-last line stay unchanged —
         # the driver runs it explicitly: python bench.py --model convbn
         ran.append(run_guarded("convbn", run_convbn, args, peak))
+    if args.model == "decode":
+        # generation workload (PERF.md r10): tokens/sec decode at batch
+        # 1 and 64 with the kv_cache/flash_decode flags in the record;
+        # explicit-only for the same resnet50-last reason —
+        # python bench.py --model decode (run_ci.sh pairs the
+        # FLAGS_kv_cache=0 recompute baseline next to it)
+        ran.append(run_guarded("decode", run_decode, args, peak))
     if args.model in ("all", "ringattn"):
         ran.append(run_guarded("ringattn", run_ringattn, args, peak))
     if args.model in ("all", "bert"):

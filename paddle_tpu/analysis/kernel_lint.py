@@ -283,6 +283,52 @@ def check_dropout_plan(cfg: dict, ok, rows, ncols, block_r, interpret,
             f"mask bits repeat", fam, label))
 
 
+def check_decode_plan(cfg: dict, ok, block_t, interpret,
+                      findings: List[Finding]):
+    """Flash-decode plan (kernels/decode_attention.py _decode_plan):
+    single-query attention over the [b, max_t, h, dh] cache with
+    scalar-prefetched lengths."""
+    fam, label = "decode_attention", cfg["label"]
+    b, h, dh, max_t = cfg["b"], cfg["h"], cfg["dh"], cfg["max_t"]
+    esize = _np_dtype(cfg["dtype"]).itemsize
+    sub = _sublane(cfg["dtype"])
+    if cfg.get("must_accept", True) and not ok:
+        findings.append(_finding(
+            "kernel-plan-reject",
+            f"plan gate rejects the canonical cache shape b={b} h={h} "
+            f"dh={dh} max_t={max_t} {cfg['dtype']} — decode would "
+            f"silently run the XLA fallback and read the whole cache "
+            f"instead of length-bounded blocks", fam, label))
+        return
+    if not ok:
+        return
+    if max_t % block_t:
+        findings.append(_finding(
+            "kernel-grid-divisibility",
+            f"block_t={block_t} does not divide max_t={max_t} (the "
+            f"length-masked tail must be the only partial block)", fam,
+            label))
+    if dh % 64:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"head dim {dh} is not a multiple of 64 (dh is the lane dim "
+            f"of every decode tile)", fam, label))
+    if h % sub:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"n_head {h} violates the {sub}-sublane tiling of the "
+            f"in-register [h, t, d] view for {cfg['dtype']}", fam, label))
+    # independent working-set re-estimate: k+v scratch blocks, their f32
+    # promotions, and the [h, block_t] score plane vs the gate's own 4 MB
+    # budget — a gate that under-estimates is itself caught
+    resident = 2 * block_t * h * dh * (esize + 4) + h * block_t * 4
+    if resident > 4 * 1024 * 1024:
+        findings.append(_finding(
+            "kernel-vmem-budget",
+            f"decode working set {resident} bytes exceeds the 4 MB "
+            f"budget the gate claims to enforce", fam, label))
+
+
 def check_embedding_group(cfg: dict, block_rows: int,
                           findings: List[Finding]):
     """Fused multi-table gather/apply group: alias validity + the 8 MB
@@ -417,6 +463,28 @@ _DROPOUT_MATRIX = [
          dtype="bfloat16"),
 ]
 
+# flash-decode: the generation-tier cache shapes bench.py --model decode
+# actually launches (transformer-base geometry; max_t is the ring-buffer
+# row count, rounded to the 128-row block quantum by the model builders)
+_DECODE_MATRIX = [
+    # the ROADMAP metric pair: tokens/sec decode at batch 1 and 64
+    dict(label="decode-base-b1", b=1, h=8, dh=64, max_t=128,
+         dtype="float32"),
+    dict(label="decode-base-b64", b=64, h=8, dh=64, max_t=128,
+         dtype="float32"),
+    # cross-attention reads during decode (src_seq_len=256 cache)
+    dict(label="decode-cross-b64", b=64, h=8, dh=64, max_t=256,
+         dtype="float32"),
+    # bf16 cache with h=8: 16-sublane tiling rejects by design (the
+    # in-register [h, t, d] view would violate Mosaic tiling) -> XLA
+    # fallback, numerically identical
+    dict(label="decode-base-bf16-h8", b=8, h=8, dh=64, max_t=128,
+         dtype="bfloat16", must_accept=False),
+    # dh not 64-aligned rejects by design
+    dict(label="decode-dh48-reject", b=4, h=8, dh=48, max_t=128,
+         dtype="float32", must_accept=False),
+]
+
 _EMBEDDING_MATRIX = [
     # deepfm: 26 slots x [10001, 10] emb tables + [10001, 1] w1 tables
     dict(label="deepfm-emb", tables=[((10001, 10), "float32")] * 26,
@@ -440,6 +508,7 @@ def lint_kernel_plans() -> Tuple[List[Finding], Dict[str, Any]]:
     plan each gate produced (the CI artifact payload)."""
     from ..kernels import attention as att
     from ..kernels import conv_bn as cbn
+    from ..kernels import decode_attention as kda
     from ..kernels import dropout_epilogue as de
     from ..kernels import embedding as emb
 
@@ -516,6 +585,18 @@ def lint_kernel_plans() -> Tuple[List[Finding], Dict[str, Any]]:
         rows.append(dict(label=cfg["label"], tables=len(cfg["tables"]),
                          block_rows=int(block), tiers=cfg["tiers"]))
     report["embedding"] = rows
+
+    rows = []
+    for cfg in _DECODE_MATRIX:
+        q = _spec((cfg["b"], cfg["h"], cfg["dh"]), cfg["dtype"])
+        kc = _spec((cfg["b"], cfg["max_t"], cfg["h"], cfg["dh"]),
+                   cfg["dtype"])
+        with _pretend_tpu():
+            ok, bt, interp = kda._decode_plan(q, kc, 256, None)
+        check_decode_plan(cfg, ok, bt, interp, findings)
+        rows.append(dict(label=cfg["label"], accepted=bool(ok),
+                         block_t=int(bt)))
+    report["decode_attention"] = rows
 
     # ring attention reuses the attention _plan gate per sequence CHUNK
     # (kernels/ring_attention.py); audit the real per-rank chunk shapes

@@ -414,12 +414,19 @@ def _check_alias_conflicts(program, feed_names, fetch_names, scope,
             if n not in defined and _is_state(n):
                 reads_before_write.add(n)
                 defined.add(n)
+        own_reads = set(n for n in op.input_arg_names() if n)
         for n in op.output_arg_names():
             if not n:
                 continue
             defined.add(n)
             if _is_state(n):
-                writers.setdefault(n, []).append(op.type)
+                # rmw: the op also READS the var it writes (optimizer
+                # in-place updates, the generation tier's per-layer
+                # kv_cache_update chain) — ordered by data flow, so it
+                # counts for donation (rw state) but is NOT the
+                # independent-writer hazard double-write warns about
+                writers.setdefault(n, []).append(
+                    (op.type, n in own_reads))
 
     rw = reads_before_write & set(writers)
     for n in sorted(rw & set(fetch_names)):
@@ -431,12 +438,13 @@ def _check_alias_conflicts(program, feed_names, fetch_names, scope,
             f"copy or drop the fetch",
             var=n))
     for n, ops in sorted(writers.items()):
-        if len(ops) > 1:
+        indep = [t for t, rmw in ops if not rmw]
+        if len(ops) > 1 and indep:
             cap.add(Finding(
                 "double-write", "warning",
                 f"persistable/scope var {n!r} is written by {len(ops)} "
-                f"ops in one block ({ops[:4]}): the scope write-back "
-                f"order becomes load-bearing",
+                f"ops in one block ({[t for t, _ in ops][:4]}): the "
+                f"scope write-back order becomes load-bearing",
                 var=n))
 
 

@@ -314,9 +314,22 @@ _RANDOM_OPS = frozenset(
 )
 
 
-# Ops whose randomness is attr-gated: they draw from the step key only
-# when their in-kernel weights dropout is armed.
-_COND_RANDOM_OPS = frozenset({"fused_attention", "fused_qkv_attention"})
+# Ops whose randomness is attr-gated: op type -> predicate over the op.
+# fused attention draws from the step key only when its in-kernel weights
+# dropout is armed; sample_token only for the stochastic strategies
+# (greedy decode programs stay key-free and bit-deterministic).  Each
+# predicate mirrors the op's registry derives_rng declaration — the
+# static verifier cross-checks the two sides per op instance.
+def _dropout_armed(op) -> bool:
+    return bool(op.attrs.get("dropout_rate", 0.0))
+
+
+_COND_RANDOM_OPS = {
+    "fused_attention": _dropout_armed,
+    "fused_qkv_attention": _dropout_armed,
+    "sample_token":
+        lambda op: op.attrs.get("strategy", "greedy") != "greedy",
+}
 
 # Extension point for ops registered OUTSIDE the core tree: a downstream
 # registry.register(..., derives_rng=True) op must also call this so the
@@ -368,12 +381,12 @@ def op_threads_rng(op) -> bool:
     PRNG bits but is invisible here would reuse the trace-constant base
     key on every plain run (the PR-4 dropout_add bug class), so the
     verifier turns that mismatch into a pre-compile error."""
+    cond = _COND_RANDOM_OPS.get(op.type)
     return bool(
         op.type in _RANDOM_OPS
         or op.type in _EXTRA_RANDOM_OPS
         or op.type.endswith("_grad")
-        or (op.type in _COND_RANDOM_OPS
-            and op.attrs.get("dropout_rate", 0.0))
+        or (cond is not None and cond(op))
     )
 
 

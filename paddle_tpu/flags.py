@@ -168,6 +168,31 @@ FLAGS.define(
     "fc composition, graphs op-for-op identical to the pre-fusion ones and "
     "parameter names unchanged (checkpoints interop)")
 FLAGS.define(
+    "kv_cache", bool, True,
+    "autoregressive generation rides the KV-cache decode path "
+    "(paddle_tpu/generation): prefill writes per-layer K/V into "
+    "ring-buffer scope state [L, b, max_t, h, dh] threaded through the "
+    "executor's donated rw-state machinery, and every generated token "
+    "runs ONE compiled single-query decode program (dynamic-slice cache "
+    "writes, length-independent compile key); models/transformer.py "
+    "build_decoder carries the same cache through its beam-search While "
+    "loop; off = the per-step full-prefix recompute route, output-"
+    "identical (parity asserted in tests/test_generation.py)")
+FLAGS.define(
+    "flash_decode", bool, True,
+    "the decode_attention op lowers to the Pallas single-query flash-"
+    "decode kernel (kernels/decode_attention.py: one q row against the "
+    "HBM-resident growing cache, online softmax over DMA'd k/v blocks, "
+    "per-sequence lengths scalar-prefetched so masked tail blocks are "
+    "never read) when the plan gate accepts; off or off-contract = the "
+    "numerically-identical XLA fallback")
+FLAGS.define(
+    "serving_decode_slots", int, 4,
+    "default cache-slot count (the decode batch dimension) of a "
+    "generation serving model (paddle_tpu/serving/generation.py): the "
+    "continuous batcher coalesces decode steps across up to this many "
+    "in-flight sequences; per-model override via GenerationConfig.slots")
+FLAGS.define(
     "pipelined_feed", bool, True,
     "AsyncExecutor.run_from_files overlaps host ingest with device "
     "compute: batch N+1's feed arrays are device_put while step N "

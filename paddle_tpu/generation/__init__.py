@@ -1,0 +1,18 @@
+"""Autoregressive generation tier (ROADMAP item 2: KV-cache +
+flash-decode + executor-driven per-token programs).
+
+  kv_cache.KVCache       ring-buffer cache contract on the executor's
+                         donated rw-state machinery
+  sampler.GenerationSession
+                         host drivers: greedy / temperature / top-k /
+                         beam, one compiled decode program per token
+  models/transformer.py build_generation_programs
+                         the prefill+decode program pair
+  serving/generation.py  continuous token-level batching of decode steps
+"""
+
+from .kv_cache import KVCache  # noqa: F401
+from .sampler import (  # noqa: F401
+    GenerationSession,
+    build_transformer_session,
+)

@@ -1,0 +1,139 @@
+"""KVCache: the generation tier's device-resident attention cache.
+
+Ring-buffer layout, ONE buffer per cache side across all layers:
+
+    <prefix>_k / <prefix>_v : [num_layers, batch, max_t, n_head, d_head]
+    <prefix>_len            : [batch] int32 valid-row counters
+
+The buffers are persistable scope vars every decode program reads before
+writing, so the executor's analyze_block_io classifies them rw-state and
+DONATES them to the compiled executable (core/executor.py): cache updates
+are in-place HBM writes across steps, the scope write-back is the same
+buffer, and nothing about a step depends on how long the sequences have
+grown — the compile-cache key is length-independent (fixed max_t shapes,
+dynamic-slice writes at the runtime counters).
+
+A KVCache object owns the NAMES and shapes; programs reference the vars
+via `vars_in(program)` (declared on demand per program) and the host owns
+allocation via `allocate(scope)`.  Graph-side helpers (`write`, `attend`,
+`reorder`, `advance`) append the generation ops (ops/generation_ops.py)
+against those vars.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+
+class KVCache:
+    """Names + shapes of one ring-buffer cache (self- or cross-attention).
+
+    For cross-attention the "cache" is filled once at prefill (the
+    encoder's projected K/V, lengths = true source lengths) and only read
+    during decode — same contract, the write just never recurs.
+    """
+
+    def __init__(self, prefix: str, num_layers: int, batch: int,
+                 max_t: int, n_head: int, d_head: int,
+                 dtype: str = "float32"):
+        self.prefix = prefix
+        self.num_layers = num_layers
+        self.batch = batch
+        self.max_t = max_t
+        self.n_head = n_head
+        self.d_head = d_head
+        self.dtype = dtype
+        self.k_name = f"{prefix}_k"
+        self.v_name = f"{prefix}_v"
+        self.len_name = f"{prefix}_len"
+
+    @property
+    def shape(self):
+        return (self.num_layers, self.batch, self.max_t, self.n_head,
+                self.d_head)
+
+    # -- program side ----------------------------------------------------
+    def vars_in(self, program=None, persistable=True):
+        """(k_var, v_var, len_var) declared in `program`'s global block
+        (default main program), creating the declarations on first
+        reference — the same var names in every program that touches
+        this cache, so they all resolve to ONE scope buffer.
+
+        persistable=False builds a PROGRAM-LOCAL cache (the build_decoder
+        While route: the buffers are zero-filled in-program and carried
+        through the loop, never scope-resident — a scope-signature-stable
+        single program)."""
+        from ..core import framework as fw
+
+        block = (program or fw.default_main_program()).global_block()
+
+        def declare(name, shape, dtype):
+            v = block._find_var_recursive(name)
+            if v is None:
+                v = block.create_var(name=name, shape=list(shape),
+                                     dtype=dtype, persistable=persistable,
+                                     stop_gradient=True)
+            return v
+
+        return (declare(self.k_name, self.shape, self.dtype),
+                declare(self.v_name, self.shape, self.dtype),
+                # program-local caches derive lengths from the loop
+                # counter; declaring an unreferenced counter var would
+                # only feed the verifier's dead-var sweep
+                declare(self.len_name, (self.batch,), "int32")
+                if persistable else None)
+
+    def write(self, k, v, pos, layer: int, active=None):
+        """Append a kv_cache_update op: K/V [b, t, h, dh] land at row
+        `pos` [b] of cache layer `layer` (rows of inactive sequences are
+        kept when `active` [b] is given)."""
+        ck, cv, _ = self.vars_in()
+        helper = LayerHelper("kv_cache_update")
+        ins = {"K": [k], "V": [v], "CacheK": [ck], "CacheV": [cv],
+               "Pos": [pos]}
+        if active is not None:
+            ins["Active"] = [active]
+        helper.append_op(
+            "kv_cache_update", inputs=ins,
+            outputs={"CacheKOut": [ck], "CacheVOut": [cv]},
+            attrs={"layer": layer})
+
+    def attend(self, q, lengths, layer: int, scale: float = 1.0):
+        """Append a decode_attention op: Q [b, 1, h, dh] against the
+        first `lengths` [b] rows of cache layer `layer` -> [b, 1, h, dh]."""
+        ck, cv, _ = self.vars_in()
+        helper = LayerHelper("decode_attention")
+        out = helper.create_variable_for_type_inference(q.dtype)
+        helper.append_op(
+            "decode_attention",
+            inputs={"Q": [q], "CacheK": [ck], "CacheV": [cv],
+                    "Lengths": [lengths]},
+            outputs={"Out": [out]},
+            attrs={"layer": layer, "scale": float(scale)})
+        return out
+
+    def reorder(self, parents):
+        """Append a kv_cache_reorder op: gather batch slots by the flat
+        beam-parent indices `parents` [b] (all layers, both sides)."""
+        ck, cv, _ = self.vars_in()
+        helper = LayerHelper("kv_cache_reorder")
+        helper.append_op(
+            "kv_cache_reorder",
+            inputs={"CacheK": [ck], "CacheV": [cv], "Parents": [parents]},
+            outputs={"CacheKOut": [ck], "CacheVOut": [cv]})
+
+    # -- host side -------------------------------------------------------
+    def allocate(self, scope) -> None:
+        """Zero-fill the cache buffers + counters into `scope` (device
+        arrays; the first donated run takes ownership in HBM)."""
+        import jax.numpy as jnp
+
+        target = jnp.bfloat16 if self.dtype == "bfloat16" else self.dtype
+        scope.set_var(self.k_name, jnp.zeros(self.shape, target))
+        scope.set_var(self.v_name, jnp.zeros(self.shape, target))
+        scope.set_var(self.len_name, jnp.zeros((self.batch,), jnp.int32))
+
+    def lengths(self, scope):
+        import numpy as np
+
+        return np.asarray(scope.find_var(self.len_name))

@@ -1,0 +1,217 @@
+"""Generation drivers: host loops stepping the compiled program pair.
+
+A GenerationSession owns (prefill, decode[, hyps]) programs built by
+models/transformer.py build_generation_programs, the cache scope state,
+and one Executor.  Every generated token is ONE Executor.run of the
+decode program with FIXED feed shapes — after prefill + the first decode
+step the executor's compile cache never grows (asserted in
+tests/test_generation.py and recorded by bench.py --model decode).
+
+Strategies: greedy / temperature / top-k ride the sample_token op inside
+the decode program (greedy programs compile key-free and are
+bit-deterministic); beam search rides the existing beam_search op
+semantics — the per-token program runs one cached decoder step, the
+dense top-k beam step, and the kv_cache_reorder parent gather, and the
+final hypotheses backtrack through beam_search_decode.
+
+FLAGS.kv_cache off swaps the decode program for the full-prefix
+recompute oracle (token-identical outputs, O(T²) per token) — the A/B
+baseline bench.py records.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _pos_ids(batch, seq_len):
+    return np.tile(np.arange(seq_len, dtype=np.int64)[None, :, None],
+                   (batch, 1, 1))
+
+
+class GenerationSession:
+    """Host driver for one generation program set.
+
+    scope/executor default to fresh private instances; pass a trained
+    scope (parameter names match `transformer(...)`) to generate from a
+    trained model.  `init_params()` runs the startup program for
+    randomly-initialized smoke use."""
+
+    def __init__(self, programs, scope=None, place=None, executor=None):
+        from ..core import executor as ex
+
+        self.p = programs
+        self.scope = scope if scope is not None else ex.Scope()
+        self.exe = executor or ex.Executor(place or ex.default_place())
+        self._allocate()
+
+    # -- state -----------------------------------------------------------
+    def _allocate(self):
+        """Zero-fill the cache / aux scope state so the scope signature
+        (part of the executor compile key) is stable from run one."""
+        import jax.numpy as jnp
+
+        p = self.p
+        if p.kv_cache:
+            p.self_cache.allocate(self.scope)
+            p.cross_cache.allocate(self.scope)
+        else:
+            self.scope.set_var(
+                p.enc_out_name,
+                jnp.zeros((p.lanes, p.src_seq_len, p.d_model),
+                          jnp.float32))
+            self.scope.set_var(
+                p.src_bias_name,
+                jnp.zeros((p.lanes, 1, 1, p.src_seq_len), jnp.float32))
+
+    def init_params(self):
+        self.exe.run(self.p.startup, scope=self.scope)
+
+    @property
+    def compile_count(self) -> int:
+        """Compiled-signature count (the flat-across-tokens invariant)."""
+        return len(self.exe._cache)
+
+    # -- steps -----------------------------------------------------------
+    def prefill(self, src_word, src_pos=None, active=None):
+        """Run the prefill program: encoder -> cross cache (or enc_out
+        aux state).  src_word [b, Ts, 1] int64; active [b] 0/1 selects
+        which cache slots (re)join — continuous batching's late-join
+        mask; default all.  Returns per-sequence source lengths."""
+        p = self.p
+        src_word = np.asarray(src_word, np.int64)
+        b = src_word.shape[0]
+        if src_word.ndim == 2:
+            src_word = src_word[:, :, None]
+        if src_pos is None:
+            src_pos = _pos_ids(b, p.src_seq_len)
+        feed = {"src_word": src_word, "src_pos": np.asarray(src_pos)}
+        if p.kv_cache:
+            a = (np.ones((b, 1), np.float32) if active is None
+                 else np.asarray(active, np.float32).reshape(b, 1))
+            feed["gen_active"] = a
+        (lens,) = self.exe.run(p.prefill, feed=feed,
+                               fetch_list=p.prefill_fetch,
+                               scope=self.scope)
+        return np.asarray(lens)
+
+    def decode_step(self, tokens, active=None, prefix=None, t=None):
+        """One decode step -> next token per lane [lanes, 1] int64.
+
+        Cached route: feed the last token (+ active mask).  Recompute
+        route: feed the full host-maintained prefix buffer and the step
+        index instead (tokens/active are ignored)."""
+        p = self.p
+        if p.kv_cache:
+            a = (np.ones((p.lanes, 1), np.float32) if active is None
+                 else np.asarray(active, np.float32).reshape(p.lanes, 1))
+            feed = {"gen_token":
+                    np.asarray(tokens, np.int64).reshape(p.lanes, 1),
+                    "gen_active": a}
+        else:
+            feed = {"gen_prefix":
+                    np.asarray(prefix, np.int64).reshape(
+                        p.lanes, p.t_buf, 1),
+                    "gen_t": np.asarray([t], np.int64)}
+        (nxt,) = self.exe.run(p.decode, feed=feed,
+                              fetch_list=p.decode_fetch, scope=self.scope)
+        return np.asarray(nxt).reshape(p.lanes)
+
+    # -- drivers ---------------------------------------------------------
+    def generate(self, src_word, src_pos=None,
+                 max_tokens: Optional[int] = None):
+        """Greedy/sampled generation: returns (tokens [b, n] int64 —
+        eos-padded past each sequence's end — , n_steps run).  Host loop:
+        prefill once, then one decode-program run per token with early
+        exit once every sequence has emitted eos."""
+        p = self.p
+        assert p.beam_size is None, "use generate_beam for beam programs"
+        max_tokens = min(max_tokens or p.max_out_len, p.max_out_len)
+        src_word = np.asarray(src_word, np.int64)
+        b = src_word.shape[0]
+        if b != p.batch_size:
+            raise ValueError(
+                f"generate: got {b} rows, programs are compiled for "
+                f"batch {p.batch_size}")
+        self.prefill(src_word, src_pos)
+        tokens = np.full((b,), p.bos_id, np.int64)
+        finished = np.zeros((b,), bool)
+        if not p.kv_cache:
+            prefix = np.full((b, p.t_buf), p.bos_id, np.int64)
+        out = []
+        steps = 0
+        for t in range(max_tokens):
+            if p.kv_cache:
+                nxt = self.decode_step(tokens)
+            else:
+                nxt = self.decode_step(None, prefix=prefix, t=t)
+            # sequences already finished keep emitting eos (and keep
+            # feeding eos — both routes see identical token streams, so
+            # the flag A/B stays token-identical by construction)
+            nxt = np.where(finished, p.eos_id, nxt)
+            out.append(nxt.copy())
+            finished |= nxt == p.eos_id
+            steps += 1
+            if finished.all():
+                break
+            tokens = nxt
+            if not p.kv_cache and t + 1 < p.t_buf:
+                prefix[:, t + 1] = nxt
+        return np.stack(out, axis=1), steps
+
+    def generate_beam(self, src_word, src_pos=None,
+                      max_tokens: Optional[int] = None):
+        """Beam generation: returns (sentence_ids [b, beam, T] int64
+        eos-padded, sentence_scores [b, beam]).  Output-parity with the
+        build_decoder While program is asserted in tests."""
+        p = self.p
+        assert p.beam_size is not None, "programs were built without beams"
+        b, k = p.batch_size, p.beam_size
+        max_tokens = min(max_tokens or p.max_out_len, p.max_out_len)
+        self.prefill(np.asarray(src_word, np.int64), src_pos)
+        pre_ids = np.full((b, k), p.bos_id, np.int64)
+        pre_scores = np.full((b, k), -1e9, np.float32)
+        pre_scores[:, 0] = 0.0
+        parents_flat = np.arange(b * k, dtype=np.int64)
+        ids_steps, parent_steps = [], []
+        for _ in range(max_tokens):
+            (sel_ids, sel_scores, next_flat) = self.exe.run(
+                p.decode,
+                feed={"gen_pre_ids": pre_ids,
+                      "gen_pre_scores": pre_scores,
+                      "gen_parents":
+                      parents_flat.reshape(b * k, 1)},
+                fetch_list=p.decode_fetch, scope=self.scope)
+            sel_ids = np.asarray(sel_ids)
+            sel_scores = np.asarray(sel_scores).astype(np.float32)
+            next_flat = np.asarray(next_flat).reshape(b * k)
+            ids_steps.append(sel_ids)
+            parent_steps.append((next_flat % k).reshape(b, k))
+            pre_ids, pre_scores = sel_ids, sel_scores
+            parents_flat = next_flat
+            if (sel_ids == p.eos_id).all():
+                break
+        # pad to the compiled [max_out_len] hyps shape: eos continuations
+        # under identity parents backtrack exactly like NumSteps masking
+        identity = np.broadcast_to(np.arange(k, dtype=np.int64), (b, k))
+        while len(ids_steps) < p.max_out_len:
+            ids_steps.append(np.full((b, k), p.eos_id, np.int64))
+            parent_steps.append(identity.copy())
+        sent, scores = self.exe.run(
+            p.hyps,
+            feed={"gen_steps_ids": np.stack(ids_steps, axis=0),
+                  "gen_steps_parents": np.stack(parent_steps, axis=0),
+                  "gen_final_scores": pre_scores},
+            fetch_list=p.hyps_fetch, scope=self.scope)
+        return np.asarray(sent), np.asarray(scores)
+
+
+def build_transformer_session(scope=None, place=None, executor=None,
+                              **model_kw) -> GenerationSession:
+    """Convenience: build_generation_programs + GenerationSession."""
+    from ..models.transformer import build_generation_programs
+
+    return GenerationSession(build_generation_programs(**model_kw),
+                            scope=scope, place=place, executor=executor)

@@ -1,0 +1,215 @@
+"""Flash-decode: Pallas single-query attention over a growing KV cache.
+
+The decode half of autoregressive generation (paddle_tpu/generation): at
+every generated token each sequence attends ONE query row against its
+cache prefix.  The training flash kernels (kernels/attention.py) are the
+wrong shape for this — their grid tiles the query axis, which here has
+length 1, and they stream the FULL key buffer even though a sequence of
+length L only owns L valid cache rows out of max_t.
+
+Design (per pallas_guide.md, embedding.py DMA idiom):
+  * grid (batch,): one grid step per sequence, whole-head — q is a
+    [h, dh] tile, the online-softmax state is per-head ([h] running
+    max/sum, [h, dh] f32 accumulator).
+  * the cache stays HBM-resident (memory_space=ANY, [b, max_t, h, dh]);
+    k/v blocks of shape [block_t, h, dh] (contiguous rows) are DMA'd
+    into VMEM scratch per iteration via make_async_copy.
+  * per-sequence lengths ride scalar prefetch
+    (pltpu.PrefetchScalarGridSpec): the kv-block loop bound is
+    ceil(len/block_t) — a sequence of length L reads ceil(L/block_t)
+    blocks, NOT max_t/block_t, and the mid-block tail is masked by
+    position.  This is what makes the compiled program length-
+    INDEPENDENT: lengths are runtime data, never shapes.
+  * forward-only by contract: generation never differentiates through
+    the cache (the op is registered no_grad); there is no backward
+    kernel and no residual.
+
+Falls back to a pure-XLA implementation off-TPU or off-contract
+(_decode_plan), numerically identical.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def reference_decode(q, k, v, lengths, scale=1.0):
+    """Pure-XLA fallback (and numerics oracle for the kernel tests).
+
+    q [b, h, dh]; k/v [b, max_t, h, dh]; lengths [b] int — number of
+    valid cache rows per sequence (positions >= length are masked out of
+    the softmax).  Returns [b, h, dh] in q.dtype; softmax statistics and
+    the value accumulation are f32 like the Pallas kernel.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    max_t = k.shape[1]
+    logits = jnp.einsum(
+        "bhd,bthd->bht", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    valid = (
+        jnp.arange(max_t, dtype=jnp.int32)[None, :]
+        < lengths.astype(jnp.int32)[:, None]
+    )  # [b, t]
+    logits = jnp.where(valid[:, None, :], logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)  # [b, h, t]
+    out = jnp.einsum("bht,bthd->bhd", w, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, k_scr, v_scr,
+                   sem_k, sem_v, *, scale, block_t, max_t, n_head, d_head):
+    """One grid step = one sequence: stream ceil(len/block_t) cache
+    blocks through VMEM scratch, online softmax per head."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+    length = lens_ref[i]
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [h, dh]
+    m0 = jnp.full((n_head,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((n_head,), jnp.float32)
+    acc0 = jnp.zeros((n_head, d_head), jnp.float32)
+
+    n_blk = jax.lax.div(length + (block_t - 1), block_t)
+
+    def body(t, carry):
+        m, l, acc = carry
+        # contiguous [block_t, h, dh] row window of THIS sequence's cache
+        ck = pltpu.make_async_copy(
+            k_ref.at[i, pl.ds(t * block_t, block_t)], k_scr, sem_k)
+        cv = pltpu.make_async_copy(
+            v_ref.at[i, pl.ds(t * block_t, block_t)], v_scr, sem_v)
+        ck.start()
+        cv.start()
+        ck.wait()
+        cv.wait()
+        # in-register [t, h, d] -> [h, t, d] relayout (the bthd-kernel
+        # idiom): every dot below is then a plain batched matmul with h
+        # as the leading batch dim
+        kb = jnp.transpose(k_scr[...].astype(jnp.float32), (1, 0, 2))
+        vb = jnp.transpose(v_scr[...].astype(jnp.float32), (1, 0, 2))
+        # s[h, t] = q[h, :] . k[h, t, :]
+        s = jax.lax.dot_general(
+            q[:, None, :], kb,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )[:, 0, :]
+        k_pos = t * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, (n_head, block_t), 1)
+        s = jnp.where(k_pos < length, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=1)
+        # acc[h, d] += p[h, t] @ v[h, t, d]
+        pv = jax.lax.dot_general(
+            p[:, None, :], vb,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )[:, 0, :]
+        acc_new = acc * alpha[:, None] + pv
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_blk, body, (m0, l0, acc0))
+    # length == 0 cannot happen in the generation drivers (prefill always
+    # writes >= 1 row) but keep the division safe anyway
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _decode_plan(q, k, block_t, interpret):
+    """Static feasibility gate; returns (ok, block_t, interpret).
+
+    Contract (mirrors the attention-kernel discipline; audited statically
+    by analysis/kernel_lint.py):
+      * d_head % 64 == 0 (MXU lane occupancy; dh is the lane dim of
+        every tile) and n_head % 8 == 0 for f32 / % 16 for narrower
+        dtypes (h is the sublane dim of the in-register [h, t, d] view);
+      * max_t % block_t == 0 (the length-masked tail block is the ONLY
+        partial block) and block_t % 8 == 0;
+      * the two [block_t, h, dh] scratch blocks + f32 compute tiles fit
+        a conservative 4 MB slice of VMEM (the kernel shares the core
+        with the surrounding program).
+    Off-contract shapes return ok=False and the caller runs the XLA
+    fallback — numerically identical, just without the length-bounded
+    block streaming.
+    """
+    import jax
+    import numpy as np
+
+    b, h, dh = q.shape
+    max_t = k.shape[1]
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+    esize = np.dtype(q.dtype).itemsize
+    block_t = min(block_t, max_t)
+    # snap the block down to a divisor of max_t (max_t is a power-of-two
+    # buffer in the generation tier, so this terminates at a sane size)
+    while block_t > 8 and max_t % block_t:
+        block_t //= 2
+    sublane = 8 if esize >= 4 else 16
+    ok = (
+        dh % 64 == 0
+        and h % sublane == 0
+        and max_t % block_t == 0
+        and block_t % 8 == 0
+        # scratch k+v blocks, f32 promoted copies, and the [h, block_t]
+        # score plane must fit the 4 MB working-set budget
+        and (2 * block_t * h * dh * (esize + 4) + h * block_t * 4)
+        <= 4 * 1024 * 1024
+    )
+    return ok, block_t, interpret
+
+
+def flash_decode(q, k, v, lengths, scale=1.0, block_t=256, interpret=None):
+    """Single-query attention against a length-masked cache.
+
+    q [b, h, dh]; k/v [b, max_t, h, dh] (HBM-resident, the generation
+    tier's per-layer cache slice); lengths [b] int32.  Returns
+    [b, h, dh].  Off-contract shapes (or off-TPU without an explicit
+    interpret=True) run reference_decode instead.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ok, block_t, interp = _decode_plan(q, k, block_t, interpret)
+    if not ok or (interp and interpret is None):
+        # off-TPU the XLA fallback beats interpret-mode emulation; tests
+        # drive the kernel explicitly with interpret=True
+        return reference_decode(q, k, v, lengths, scale)
+
+    b, h, dh = q.shape
+    max_t = k.shape[1]
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block_t=block_t, max_t=max_t,
+        n_head=h, d_head=dh)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, h, dh), lambda i, lens: (i, 0, 0)),  # q
+            pl.BlockSpec(memory_space=pltpu.ANY),  # k cache (HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),  # v cache (HBM)
+        ],
+        out_specs=pl.BlockSpec((1, h, dh), lambda i, lens: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_t, h, dh), k.dtype),
+            pltpu.VMEM((block_t, h, dh), v.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
+        interpret=bool(interp),
+    )(lengths.astype(jnp.int32), q, k, v)

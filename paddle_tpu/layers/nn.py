@@ -1040,6 +1040,29 @@ def beam_search_decode(ids, scores, beam_size, end_id, parents=None,
     return sent_ids, sent_scores
 
 
+def sample_token(logits, strategy="greedy", temperature=1.0, top_k=0,
+                 name=None):
+    """Next-token selection from [batch, vocab] logits (the generation
+    tier's sampling op, ops/generation_ops.py): "greedy" argmax (no PRNG
+    — the decode program compiles key-free), or "sample" for a
+    temperature-scaled categorical draw optionally truncated to the
+    top_k logits.  Returns [batch, 1] int64."""
+    if strategy not in ("greedy", "sample"):
+        raise ValueError(
+            f"sample_token: strategy must be 'greedy' or 'sample', "
+            f"got {strategy!r}")
+    helper = LayerHelper("sample_token", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "sample_token",
+        inputs={"Logits": [logits]},
+        outputs={"Out": [out]},
+        attrs={"strategy": strategy, "temperature": float(temperature),
+               "top_k": int(top_k)},
+    )
+    return out
+
+
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
         custom_dist=None, seed=0, is_sparse=False):

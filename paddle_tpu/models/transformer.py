@@ -475,6 +475,129 @@ def _log_softmax(x, axis_dim):
     return layers.elementwise_sub(shifted, lse)
 
 
+# ---------------------------------------------------------------------------
+# KV-cached decoding (paddle_tpu/generation): the single-token decoder step
+# and the prefill/decode program pair.  Parameter names are drawn through
+# the SAME unique_name sequences as transformer()/build_decoder, so a scope
+# trained with the train net decodes through the cache directly.
+# ---------------------------------------------------------------------------
+
+
+def _cache_rows(n):
+    """Ring-buffer row count rounded up to the flash-decode block quantum:
+    the plan gate (kernels/decode_attention.py _decode_plan) wants
+    max_t % block == 0 with 128 the smallest compiled block, so cache
+    buffers are allocated in 128-row steps (the tail rows are dead weight
+    the length mask never reads)."""
+    return ((int(n) + 127) // 128) * 128
+
+
+def _src_token_lengths(src_word, src_seq_len):
+    """[b, Ts, 1] int64 ids -> [b] int32 length = 1 + LAST non-pad
+    position (pad id 0).  Length-masking the cross cache to this value
+    is equivalent to the reference's -1e9 pad bias for TRAILING padding
+    (the framework's sequence contract); computing the trailing run —
+    rather than counting zeros — means an out-of-contract mid-sequence 0
+    can never truncate real tokens off the tail (it is attended like any
+    token, where the bias route would mask that one position)."""
+    zero = layers.fill_constant([1], "int64", 0)
+    nonpad = layers.cast(layers.not_equal(src_word, zero), "float32")
+    ones_t = layers.fill_constant([src_seq_len, 1], "float32", 1.0)
+    pos1 = layers.reshape(layers.cumsum(ones_t, axis=0),
+                          [1, src_seq_len, 1])  # 1..Ts
+    last = layers.reduce_max(layers.elementwise_mul(nonpad, pos1),
+                             dim=[1, 2])  # [b] = 1 + last non-pad pos
+    return layers.cast(last, "int32")
+
+
+def _flat_beam_parents(parent_idx, b, k):
+    """[b, k] within-group beam parents -> [b, k] int64 FLAT lane indices
+    (group offset b_idx*k + parent) — the kv_cache_reorder gather
+    contract shared by the build_decoder While route and the per-token
+    beam decode program."""
+    ones_b = layers.fill_constant([b, 1], "float32", 1.0)
+    offs = layers.scale(
+        layers.elementwise_sub(layers.cumsum(ones_b, axis=0), ones_b),
+        scale=float(k))
+    return layers.cast(
+        layers.elementwise_add(layers.cast(parent_idx, "float32"),
+                               layers.expand(offs, [1, k])),
+        "int64")
+
+
+def _prefill_cross_cache(enc_output, cross_cache, n_layer, n_head, d_key,
+                         d_value, active=None):
+    """Project the (possibly beam-tiled) encoder output into per-layer
+    cross-attention K/V and write them at row 0 of every sequence's cache
+    slot.  Draws attn_k_w/attn_v_w in layer order — the same per-key
+    unique_name sequence the in-loop recompute route draws."""
+    from ..core.framework import unique_name
+
+    # ts is the SOURCE length (what the encoder produced); the cache may
+    # hold more rows (128-row allocation quantum) — the tail stays zero
+    # and the cross length mask never reads it
+    b, ts = cross_cache.batch, int(enc_output.shape[1])
+    zero_pos = layers.fill_constant([b], "int32", 0)
+    for i in range(n_layer):
+        k = layers.fc(input=enc_output, size=d_key * n_head,
+                      bias_attr=False, num_flatten_dims=2,
+                      param_attr=ParamAttr(name=unique_name("attn_k_w")))
+        v = layers.fc(input=enc_output, size=d_value * n_head,
+                      bias_attr=False, num_flatten_dims=2,
+                      param_attr=ParamAttr(name=unique_name("attn_v_w")))
+        k4 = layers.reshape(k, [b, ts, n_head, d_key])
+        v4 = layers.reshape(v, [b, ts, n_head, d_value])
+        cross_cache.write(k4, v4, zero_pos, layer=i, active=active)
+
+
+def cached_decoder_step(dec_input, self_cache, cross_cache, write_pos,
+                        self_lens, cross_lens, n_layer, n_head, d_key,
+                        d_value, d_model, d_inner_hid, active=None,
+                        dropout_rate=0.0):
+    """ONE decoder step over a single embedded token [b, 1, d_model]:
+    per layer, project q/k/v, append k/v to the self cache at write_pos,
+    single-query attention over the first self_lens rows, then cached
+    cross-attention over cross_lens rows of the prefilled cross cache,
+    then the feed-forward — the op-for-op cached counterpart of
+    decoder_layer (same post-process "dan" chain, same parameter-name
+    draws), minus the O(T²) full-prefix recompute."""
+    from ..core.framework import unique_name
+
+    x = dec_input
+    b = x.shape[0]
+    for i in range(n_layer):
+        # self-attention against the growing cache
+        qkv = layers.fc(input=x, size=3 * d_key * n_head, bias_attr=False,
+                        num_flatten_dims=2,
+                        param_attr=ParamAttr(name=unique_name("attn_qkv_w")))
+        q, k, v = layers.split(qkv, 3, dim=-1)
+        q4 = layers.reshape(q, [b, 1, n_head, d_key])
+        k4 = layers.reshape(k, [b, 1, n_head, d_key])
+        v4 = layers.reshape(v, [b, 1, n_head, d_value])
+        self_cache.write(k4, v4, write_pos, layer=i, active=active)
+        ctx = self_cache.attend(q4, self_lens, layer=i, scale=d_key**-0.5)
+        attn_out = layers.fc(
+            input=layers.reshape(ctx, [b, 1, n_head * d_value]),
+            size=d_model, bias_attr=False, num_flatten_dims=2,
+            param_attr=ParamAttr(name=unique_name("attn_out_w")))
+        x = pre_post_process_layer(x, attn_out, "dan", dropout_rate)
+        # cross-attention against the prefilled encoder K/V
+        cq = layers.fc(input=x, size=d_key * n_head, bias_attr=False,
+                       num_flatten_dims=2,
+                       param_attr=ParamAttr(name=unique_name("attn_q_w")))
+        cq4 = layers.reshape(cq, [b, 1, n_head, d_key])
+        cctx = cross_cache.attend(cq4, cross_lens, layer=i,
+                                  scale=d_key**-0.5)
+        cross_out = layers.fc(
+            input=layers.reshape(cctx, [b, 1, n_head * d_value]),
+            size=d_model, bias_attr=False, num_flatten_dims=2,
+            param_attr=ParamAttr(name=unique_name("attn_out_w")))
+        x = pre_post_process_layer(x, cross_out, "dan", dropout_rate)
+        ffd = positionwise_feed_forward(x, d_inner_hid, d_model)
+        x = pre_post_process_layer(x, ffd, "dan", dropout_rate)
+    return x
+
+
 def build_decoder(
     src_vocab_size=10000,
     trg_vocab_size=10000,
@@ -499,15 +622,34 @@ def build_decoder(
     trained with the train net decodes directly.
 
     TPU-first shape: beams are a static [batch, beam] lane; the While loop
-    compiles to one XLA while_loop; each step re-runs the causal decoder
-    over the static [T+1]-padded prefix (no KV cache — at book-test scale
-    recompute is cheaper than carrying cache state through the loop; the
-    serving path amortizes via Predictor AOT caching).
+    compiles to one XLA while_loop.  The decode step inside the loop is
+    chosen by FLAGS.kv_cache:
+
+      * on (default): per-layer K/V ring buffers ride the loop carry
+        (cached_decoder_step + ops/generation_ops.py) — each step embeds
+        ONE token, appends its K/V at position t, reorders the cache by
+        the beam parents, and attends the single query row over the
+        t+1-row prefix (O(T) per token; kernels/decode_attention.py).
+      * off: the legacy full-prefix recompute — every step re-runs the
+        causal decoder over the static [T+1]-padded prefix (O(T²)
+        recompute per token).  Kept as the parity oracle: both routes are
+        output-identical (asserted in tests/test_generation.py).
+
+    The While-free per-token generation drivers (one Executor.run per
+    token, serving-grade) live in paddle_tpu/generation — this builder is
+    the single-program book-test/batch path.
 
     Returns (sentence_ids [b, beam, T], sentence_scores [b, beam],
     feed_names).
     """
+    from ..flags import FLAGS
     src_seq_len = src_seq_len or max_length
+    if max_length < max_out_len + 1 or max_length < src_seq_len:
+        # same position-table NaN footgun as build_generation_programs
+        raise ValueError(
+            f"max_length={max_length} position table is smaller than the "
+            f"decode buffer (max_out_len+1={max_out_len + 1}) or the "
+            f"source length ({src_seq_len})")
     t_buf = max_out_len + 1  # position 0 is BOS
     b, k = batch_size, beam_size
     bk = b * k
@@ -541,12 +683,112 @@ def build_decoder(
         ),
         [bk, src_seq_len, d_model],
     )
-    src_bias_bk = layers.reshape(
-        layers.expand(layers.reshape(src_bias, [b, 1, 1, 1, src_seq_len]),
-                      [1, k, 1, 1, 1]),
-        [bk, 1, 1, src_seq_len],
-    )
+    # ---- loop state -----------------------------------------------------
+    t = layers.fill_constant([1], "int64", 0)
+    limit = layers.fill_constant([1], "int64", max_out_len)
+    cond = layers.less_than(t, limit)
 
+    pre_ids = layers.fill_constant([b, k], "int64", bos_id)
+    beam0 = layers.one_hot(layers.fill_constant([1], "int64", 0), k)  # [k]
+    pre_scores = layers.expand(
+        layers.reshape(layers.scale(beam0, scale=1e9, bias=neg_inf),
+                       [1, k]),
+        [b, 1],
+    )  # beam 0 -> 0, others -> -1e9
+
+    ids_arr = layers.create_array("int64", element_shape=[b, k],
+                                  capacity=max_out_len)
+    parents_arr = layers.create_array("int64", element_shape=[b, k],
+                                      capacity=max_out_len)
+
+    if FLAGS.kv_cache:
+        # ---- KV-cached route (default): the caches ride the loop carry
+        from ..core import framework as fw
+        from ..generation.kv_cache import KVCache
+
+        def _zeroed_cache(prefix_name, max_t):
+            cache = KVCache(prefix_name, n_layer, bk, max_t, n_head, d_key)
+            kv_vars = cache.vars_in(persistable=False)
+            for var in kv_vars[:2]:
+                zeros = layers.fill_constant(list(cache.shape), "float32",
+                                             0.0)
+                layers.assign(zeros, output=var)
+            return cache
+
+        uid = fw.unique_name("dec_cache")
+        self_cache = _zeroed_cache(f"{uid}_self", _cache_rows(t_buf))
+        cross_cache = _zeroed_cache(f"{uid}_cross",
+                                    _cache_rows(src_seq_len))
+        _prefill_cross_cache(enc_output, cross_cache, n_layer, n_head,
+                             d_key, d_value)
+        # cross length = true (untiled-then-tiled) source token count
+        src_lens = _src_token_lengths(src_word, src_seq_len)  # [b] int32
+        cross_lens = layers.reshape(
+            layers.expand(layers.reshape(src_lens, [b, 1]), [1, k]), [bk])
+        # flat beam-parent carry, identity at step 0 (slot i -> slot i)
+        ones_bk = layers.fill_constant([bk, 1], "float32", 1.0)
+        identity = layers.cast(
+            layers.reshape(
+                layers.elementwise_sub(layers.cumsum(ones_bk, axis=0),
+                                       ones_bk),
+                [bk]),
+            "int64")
+        pre_parents = layers.fill_constant([bk], "int64", 0)
+        layers.assign(identity, output=pre_parents)
+
+        w = layers.While(cond)
+        with w.block():
+            # continue from the parent beam's prefix: gather the cache
+            # slots the selected tokens actually extended
+            self_cache.reorder(pre_parents)
+            write_pos = layers.cast(
+                layers.reshape(layers.expand(layers.reshape(t, [1, 1]),
+                                             [bk, 1]), [bk]),
+                "int32")
+            att_len = layers.elementwise_add(
+                write_pos, layers.fill_constant([bk], "int32", 1))
+            tpos_ids = layers.expand(layers.reshape(t, [1, 1, 1]),
+                                     [bk, 1, 1])
+            dec_input = prepare_encoder(
+                layers.reshape(pre_ids, [bk, 1, 1]), tpos_ids,
+                trg_vocab_size, d_model, max_length,
+                word_emb_param_name="trg_word_emb_table",
+                pos_enc_param_name="trg_pos_enc_table",
+            )
+            dec_output = cached_decoder_step(
+                dec_input, self_cache, cross_cache, write_pos, att_len,
+                cross_lens, n_layer, n_head, d_key, d_value, d_model,
+                d_inner_hid)
+            logits = layers.fc(input=dec_output, size=trg_vocab_size,
+                               num_flatten_dims=2,
+                               param_attr=ParamAttr(name="predict_w"),
+                               bias_attr=ParamAttr(name="predict_b"))
+            step_logits = layers.reshape(logits, [b, k, trg_vocab_size])
+            log_probs = _log_softmax(step_logits, axis_dim=2)
+
+            sel_ids, sel_scores, parent_idx = layers.beam_search(
+                pre_ids, pre_scores, None, log_probs, beam_size=k,
+                end_id=eos_id)
+
+            # flat parents for the NEXT step's cache gather
+            layers.assign(
+                layers.reshape(_flat_beam_parents(parent_idx, b, k),
+                               [bk]),
+                output=pre_parents)
+
+            layers.array_write(sel_ids, t, array=ids_arr)
+            layers.array_write(parent_idx, t, array=parents_arr)
+            layers.assign(sel_ids, output=pre_ids)
+            layers.assign(sel_scores, output=pre_scores)
+            layers.increment(t, value=1.0, in_place=True)
+            layers.less_than(t, limit, cond=cond)
+
+        sent_ids, sent_scores = layers.beam_search_decode(
+            ids_arr, pre_scores, beam_size=k, end_id=eos_id,
+            parents=parents_arr)
+        return sent_ids, sent_scores, ["src_word", "src_pos"]
+
+    # ---- flag-off route: full-prefix recompute (the parity oracle) ------
     # causal self-attention bias over the prefix buffer: [1, 1, T, T]
     ones_t = layers.fill_constant([t_buf, 1], "float32", 1.0)
     arange_t = layers.elementwise_sub(
@@ -562,24 +804,15 @@ def build_decoder(
         layers.expand(layers.reshape(arange_t, [1, t_buf, 1]), [bk, 1, 1]),
         "int64")
 
-    # ---- loop state -----------------------------------------------------
-    t = layers.fill_constant([1], "int64", 0)
-    limit = layers.fill_constant([1], "int64", max_out_len)
-    cond = layers.less_than(t, limit)
+    # beam-tiled source pad bias (the cached route masks the cross cache
+    # by true source lengths instead)
+    src_bias_bk = layers.reshape(
+        layers.expand(layers.reshape(src_bias, [b, 1, 1, 1, src_seq_len]),
+                      [1, k, 1, 1, 1]),
+        [bk, 1, 1, src_seq_len],
+    )
 
-    pre_ids = layers.fill_constant([b, k], "int64", bos_id)
-    beam0 = layers.one_hot(layers.fill_constant([1], "int64", 0), k)  # [k]
-    pre_scores = layers.expand(
-        layers.reshape(layers.scale(beam0, scale=1e9, bias=neg_inf),
-                       [1, k]),
-        [b, 1],
-    )  # beam 0 -> 0, others -> -1e9
     prefix = layers.fill_constant([b, k, t_buf], "int64", bos_id)
-
-    ids_arr = layers.create_array("int64", element_shape=[b, k],
-                                  capacity=max_out_len)
-    parents_arr = layers.create_array("int64", element_shape=[b, k],
-                                      capacity=max_out_len)
 
     w = layers.While(cond)
     with w.block():
@@ -637,3 +870,347 @@ def build_decoder(
         ids_arr, pre_scores, beam_size=k, end_id=eos_id,
         parents=parents_arr)
     return sent_ids, sent_scores, ["src_word", "src_pos"]
+
+
+# ---------------------------------------------------------------------------
+# Generation program pair: the While-FREE serving path.  One compiled
+# prefill program (encoder -> cross cache) + ONE compiled per-token decode
+# program stepped by the host (paddle_tpu/generation/sampler.py drives it,
+# paddle_tpu/serving/generation.py continuous-batches it).
+# ---------------------------------------------------------------------------
+
+
+class GenerationPrograms:
+    """Program pair + cache contract handed to the generation drivers."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def build_generation_programs(
+    src_vocab_size=10000,
+    trg_vocab_size=10000,
+    max_length=256,
+    n_layer=6,
+    n_head=8,
+    d_key=64,
+    d_value=64,
+    d_model=512,
+    d_inner_hid=2048,
+    batch_size=4,
+    src_seq_len=None,
+    max_out_len=16,
+    bos_id=0,
+    eos_id=1,
+    use_flash=False,
+    beam_size=None,
+    strategy="greedy",
+    temperature=1.0,
+    top_k=0,
+    cache_prefix="gen",
+    kv_cache=None,
+):
+    """Build the (prefill, decode[, hyps]) program set for autoregressive
+    generation.  Parameter names are drawn through the same unique_name
+    sequences as `transformer(...)` (fresh generator inside), so a scope
+    trained with the train net generates directly.
+
+    kv_cache=None follows FLAGS.kv_cache:
+      * cached (default): prefill runs the encoder once and writes the
+        per-layer cross-attention K/V into the `<prefix>_cross` ring
+        buffer; the decode program embeds ONE token, appends its K/V to
+        the `<prefix>_self` cache at the per-sequence length counters,
+        and attends a single query row (decode_attention) — O(T) per
+        token, all cache state scope-resident + donated, compile key
+        length-independent.
+      * recompute (flag-off parity oracle, non-beam only): prefill
+        stores enc_output + the source pad bias; the decode program
+        re-runs the full causal decoder over the host-maintained
+        [max_out_len+1]-token prefix and samples at position t — O(T²)
+        per token, token-identical outputs.
+
+    Both decode programs feed fixed shapes every step, so the executor
+    compiles each exactly once (asserted in tests/test_generation.py and
+    bench.py --model decode).
+
+    beam_size=None builds the sampling pair ("greedy"/"sample" via
+    sample_token); an int builds the beam pair: the decode program runs
+    one cached step + a beam_search op + the kv_cache_reorder parent
+    gather, and `hyps` backtracks the stacked steps via
+    beam_search_decode.
+    """
+    from ..core import framework as fw
+    from ..flags import FLAGS
+    from ..generation.kv_cache import KVCache
+
+    if kv_cache is None:
+        kv_cache = FLAGS.kv_cache
+    src_seq_len = src_seq_len or max_length
+    if max_length < max_out_len + 1 or max_length < src_seq_len:
+        # position-table rows gate BOTH streams; an out-of-range lookup
+        # NaN-fills (jnp.take) and one NaN poisons every softmax row
+        # through the additive masks — fail loudly at build time instead
+        raise ValueError(
+            f"max_length={max_length} position table is smaller than the "
+            f"decode buffer (max_out_len+1={max_out_len + 1}) or the "
+            f"source length ({src_seq_len})")
+    b = batch_size
+    k = beam_size or 1
+    lanes = b * k
+    if beam_size is not None and not kv_cache:
+        raise ValueError(
+            "build_generation_programs: the beam pair requires the "
+            "KV-cache route (FLAGS_kv_cache); the flag-off recompute "
+            "oracle for beams is models/transformer.py build_decoder")
+
+    t_buf = max_out_len + 1  # position 0 is BOS
+    prefill = fw.Program()
+    decode = fw.Program()
+    hyps = fw.Program() if beam_size is not None else None
+    startup = fw.Program()
+
+    self_cache = KVCache(f"{cache_prefix}_self", n_layer, lanes,
+                         _cache_rows(t_buf), n_head, d_key)
+    cross_cache = KVCache(f"{cache_prefix}_cross", n_layer, lanes,
+                          _cache_rows(src_seq_len), n_head, d_key)
+    enc_out_name = f"{cache_prefix}_enc_out"
+    src_bias_name = f"{cache_prefix}_src_bias"
+
+    def aux_var(name, shape):
+        return fw.default_main_program().global_block().create_var(
+            name=name, shape=list(shape), dtype="float32",
+            persistable=True, stop_gradient=True)
+
+    with fw.guard_unique_name():
+        # ---- prefill ----------------------------------------------------
+        with fw.program_guard(prefill, startup):
+            src_word = layers.data(name="src_word",
+                                   shape=[src_seq_len, 1], dtype="int64")
+            src_pos = layers.data(name="src_pos", shape=[src_seq_len, 1],
+                                  dtype="int64")
+            active = (layers.data(name="gen_active", shape=[1],
+                                  dtype="float32") if kv_cache else None)
+            neg_inf = -1e9
+            zero = layers.fill_constant([1], "int64", 0)
+            is_pad = layers.cast(layers.equal(src_word, zero), "float32")
+            src_bias = layers.reshape(
+                layers.scale(is_pad, scale=neg_inf),
+                [-1, 1, 1, src_seq_len])
+            src_bias.stop_gradient = True
+            enc_input = prepare_encoder(
+                src_word, src_pos, src_vocab_size, d_model, max_length,
+                word_emb_param_name="src_word_emb_table",
+                pos_enc_param_name="src_pos_enc_table",
+            )
+            enc_output = encoder(
+                enc_input, src_bias, n_layer, n_head, d_key, d_value,
+                d_model, d_inner_hid, use_flash=use_flash,
+            )
+            src_lens = _src_token_lengths(src_word, src_seq_len)  # [b]
+            if k > 1:  # tile per beam (beam-major within batch)
+                enc_output = layers.reshape(
+                    layers.expand(
+                        layers.reshape(enc_output,
+                                       [b, 1, src_seq_len, d_model]),
+                        [1, k, 1, 1]),
+                    [lanes, src_seq_len, d_model])
+                src_lens = layers.reshape(
+                    layers.expand(layers.reshape(src_lens, [b, 1]),
+                                  [1, k]), [lanes])
+            if kv_cache:
+                if k > 1:
+                    active_l = layers.reshape(
+                        layers.expand(layers.reshape(active, [b, 1]),
+                                      [1, k]), [lanes])
+                else:
+                    active_l = layers.reshape(active, [lanes])
+                a32 = layers.cast(active_l, "int32")
+                inv = layers.elementwise_sub(
+                    layers.fill_constant([lanes], "int32", 1), a32)
+                _prefill_cross_cache(enc_output, cross_cache, n_layer,
+                                     n_head, d_key, d_value, active=a32)
+                _, _, cross_len = cross_cache.vars_in()
+                _, _, self_len = self_cache.vars_in()
+                # joined sequences: cross len = true source length,
+                # self len resets to 0; others keep their counters
+                layers.assign(
+                    layers.elementwise_add(
+                        layers.elementwise_mul(a32, src_lens),
+                        layers.elementwise_mul(inv, cross_len)),
+                    output=cross_len)
+                layers.assign(layers.elementwise_mul(inv, self_len),
+                              output=self_len)
+            else:
+                layers.assign(enc_output,
+                              output=aux_var(enc_out_name,
+                                             (lanes, src_seq_len,
+                                              d_model)))
+                layers.assign(src_bias,
+                              output=aux_var(src_bias_name,
+                                             (lanes, 1, 1, src_seq_len)))
+            prefill_fetch = [src_lens.name]
+
+        # ---- decode -----------------------------------------------------
+        with fw.program_guard(decode, startup):
+            if beam_size is None:
+                token = layers.data(name="gen_token", shape=[1],
+                                    dtype="int64")
+                dactive = layers.data(name="gen_active", shape=[1],
+                                      dtype="float32")
+                if kv_cache:
+                    _, _, self_len = self_cache.vars_in()
+                    _, _, cross_len = cross_cache.vars_in()
+                    da32 = layers.cast(layers.reshape(dactive, [lanes]),
+                                       "int32")
+                    att_len = layers.elementwise_add(self_len, da32)
+                    pos_ids = layers.cast(
+                        layers.reshape(self_len, [lanes, 1, 1]), "int64")
+                    dec_input = prepare_encoder(
+                        layers.reshape(token, [lanes, 1, 1]), pos_ids,
+                        trg_vocab_size, d_model, max_length,
+                        word_emb_param_name="trg_word_emb_table",
+                        pos_enc_param_name="trg_pos_enc_table",
+                    )
+                    dec_output = cached_decoder_step(
+                        dec_input, self_cache, cross_cache,
+                        write_pos=self_len, self_lens=att_len,
+                        cross_lens=cross_len, n_layer=n_layer,
+                        n_head=n_head, d_key=d_key, d_value=d_value,
+                        d_model=d_model, d_inner_hid=d_inner_hid,
+                        active=da32)
+                    logits = layers.fc(
+                        input=dec_output, size=trg_vocab_size,
+                        num_flatten_dims=2,
+                        param_attr=ParamAttr(name="predict_w"),
+                        bias_attr=ParamAttr(name="predict_b"))
+                    next_tok = layers.sample_token(
+                        layers.reshape(logits, [lanes, trg_vocab_size]),
+                        strategy=strategy, temperature=temperature,
+                        top_k=top_k)
+                    # advance the counters of the stepped sequences LAST
+                    # (every read above wants the pre-step lengths)
+                    layers.assign(att_len, output=self_len)
+                else:
+                    # full-prefix recompute oracle: the host maintains
+                    # the [t_buf] prefix and feeds the step index
+                    prefix = layers.data(name="gen_prefix",
+                                         shape=[t_buf, 1], dtype="int64")
+                    t_step = layers.data(name="gen_t", shape=[1],
+                                         dtype="int64")
+                    neg_inf = -1e9
+                    ones_t = layers.fill_constant([t_buf, 1], "float32",
+                                                  1.0)
+                    arange_t = layers.elementwise_sub(
+                        layers.cumsum(ones_t, axis=0), ones_t)
+                    qpos = layers.reshape(arange_t, [1, t_buf, 1])
+                    kpos = layers.reshape(arange_t, [1, 1, t_buf])
+                    future = layers.cast(layers.less_than(qpos, kpos),
+                                         "float32")
+                    causal_bias = layers.reshape(
+                        layers.scale(future, scale=neg_inf),
+                        [1, 1, t_buf, t_buf])
+                    causal_bias.stop_gradient = True
+                    trg_pos_ids = layers.cast(
+                        layers.expand(
+                            layers.reshape(arange_t, [1, t_buf, 1]),
+                            [lanes, 1, 1]),
+                        "int64")
+                    dec_input = prepare_encoder(
+                        prefix, trg_pos_ids, trg_vocab_size, d_model,
+                        max_length,
+                        word_emb_param_name="trg_word_emb_table",
+                        pos_enc_param_name="trg_pos_enc_table",
+                    )
+                    enc_out_v = aux_var(enc_out_name,
+                                        (lanes, src_seq_len, d_model))
+                    src_bias_v = aux_var(src_bias_name,
+                                         (lanes, 1, 1, src_seq_len))
+                    dec_output = decoder(
+                        dec_input, enc_out_v, causal_bias, src_bias_v,
+                        n_layer, n_head, d_key, d_value, d_model,
+                        d_inner_hid, use_flash=use_flash,
+                    )
+                    logits = layers.fc(
+                        input=dec_output, size=trg_vocab_size,
+                        num_flatten_dims=2,
+                        param_attr=ParamAttr(name="predict_w"),
+                        bias_attr=ParamAttr(name="predict_b"))
+                    t_idx = layers.cast(
+                        layers.expand(layers.reshape(t_step, [1, 1, 1]),
+                                      [lanes, 1, trg_vocab_size]),
+                        "int64")
+                    step_logits = layers.reshape(
+                        layers.take_along_axis(logits, t_idx, axis=1),
+                        [lanes, trg_vocab_size])
+                    next_tok = layers.sample_token(
+                        step_logits, strategy=strategy,
+                        temperature=temperature, top_k=top_k)
+                decode_fetch = [next_tok.name]
+            else:
+                pre_ids = layers.data(name="gen_pre_ids", shape=[k],
+                                      dtype="int64")
+                pre_scores = layers.data(name="gen_pre_scores",
+                                         shape=[k], dtype="float32")
+                parents = layers.data(name="gen_parents", shape=[1],
+                                      dtype="int64")
+                _, _, self_len = self_cache.vars_in()
+                _, _, cross_len = cross_cache.vars_in()
+                flat_parents = layers.reshape(parents, [lanes])
+                self_cache.reorder(flat_parents)
+                ones_l = layers.fill_constant([lanes], "int32", 1)
+                att_len = layers.elementwise_add(self_len, ones_l)
+                pos_ids = layers.cast(
+                    layers.reshape(self_len, [lanes, 1, 1]), "int64")
+                dec_input = prepare_encoder(
+                    layers.reshape(pre_ids, [lanes, 1, 1]), pos_ids,
+                    trg_vocab_size, d_model, max_length,
+                    word_emb_param_name="trg_word_emb_table",
+                    pos_enc_param_name="trg_pos_enc_table",
+                )
+                dec_output = cached_decoder_step(
+                    dec_input, self_cache, cross_cache,
+                    write_pos=self_len, self_lens=att_len,
+                    cross_lens=cross_len, n_layer=n_layer, n_head=n_head,
+                    d_key=d_key, d_value=d_value, d_model=d_model,
+                    d_inner_hid=d_inner_hid)
+                logits = layers.fc(
+                    input=dec_output, size=trg_vocab_size,
+                    num_flatten_dims=2,
+                    param_attr=ParamAttr(name="predict_w"),
+                    bias_attr=ParamAttr(name="predict_b"))
+                log_probs = _log_softmax(
+                    layers.reshape(logits, [b, k, trg_vocab_size]),
+                    axis_dim=2)
+                sel_ids, sel_scores, parent_idx = layers.beam_search(
+                    pre_ids, pre_scores, None, log_probs, beam_size=k,
+                    end_id=eos_id)
+                next_parents = _flat_beam_parents(parent_idx, b, k)
+                layers.assign(att_len, output=self_len)
+                decode_fetch = [sel_ids.name, sel_scores.name,
+                                next_parents.name]
+
+        # ---- hyps (beam backtrack) --------------------------------------
+        if hyps is not None:
+            with fw.program_guard(hyps, startup):
+                ids_steps = layers.data(name="gen_steps_ids",
+                                        shape=[b, k], dtype="int64")
+                parent_steps = layers.data(name="gen_steps_parents",
+                                           shape=[b, k], dtype="int64")
+                final_scores = layers.data(name="gen_final_scores",
+                                           shape=[k], dtype="float32")
+                sent_ids, sent_scores = layers.beam_search_decode(
+                    ids_steps, final_scores, beam_size=k, end_id=eos_id,
+                    parents=parent_steps)
+                hyps_fetch = [sent_ids.name, sent_scores.name]
+
+    return GenerationPrograms(
+        prefill=prefill, decode=decode, hyps=hyps, startup=startup,
+        self_cache=self_cache, cross_cache=cross_cache,
+        enc_out_name=enc_out_name, src_bias_name=src_bias_name,
+        prefill_fetch=prefill_fetch, decode_fetch=decode_fetch,
+        hyps_fetch=hyps_fetch if hyps is not None else None,
+        batch_size=b, beam_size=beam_size, lanes=lanes,
+        src_seq_len=src_seq_len, max_out_len=max_out_len, t_buf=t_buf,
+        bos_id=bos_id, eos_id=eos_id, kv_cache=kv_cache,
+        src_vocab_size=src_vocab_size, trg_vocab_size=trg_vocab_size,
+        d_model=d_model, strategy=strategy)

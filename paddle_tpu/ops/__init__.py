@@ -18,4 +18,5 @@ from . import (  # noqa: F401
     ctc_ops,
     image_ops,
     rcnn_ops,
+    generation_ops,
 )

@@ -131,10 +131,13 @@ def lower_conditional_block(ctx, ins):
 def lower_create_array(ctx, ins):
     import jax.numpy as jnp
 
+    from .tensor_ops import _requested_dtype
+
     capacity = ctx.attr("capacity")
     shape = tuple(ctx.attr("element_shape"))
-    dtype = ctx.attr("dtype", "float32")
-    target = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    # int64 arrays clamp through the canonical-dtype helper (the repo's
+    # no-truncate-warning convention) instead of warning on every trace
+    target = _requested_dtype(ctx.attr("dtype", "float32"))
     return {"Out": [jnp.zeros((capacity,) + shape, target)]}
 
 
@@ -202,9 +205,12 @@ def lower_beam_search(ctx, ins):
         -1e30,
     )
     cand = jnp.where(finished[:, :, None], frozen, cand)
+    import numpy as np
+
+    i64 = jax.dtypes.canonicalize_dtype(np.int64)  # no-truncate-warning
     top_scores, top_idx = jax.lax.top_k(cand.reshape(b, k * v), beam_size)
-    parent = (top_idx // v).astype(jnp.int64)
-    token = (top_idx % v).astype(jnp.int64)
+    parent = (top_idx // v).astype(i64)
+    token = (top_idx % v).astype(i64)
     return {
         "SelectedIds": [token],
         "SelectedScores": [top_scores],
@@ -254,7 +260,10 @@ def lower_beam_search_decode(ctx, ins):
     _, toks = jax.lax.scan(
         step, init, (ids[::-1], parents[::-1], ts)
     )
-    sent = jnp.flip(toks, axis=0).transpose(1, 2, 0).astype(jnp.int64)
+    import numpy as np
+
+    sent = jnp.flip(toks, axis=0).transpose(1, 2, 0).astype(
+        jax.dtypes.canonicalize_dtype(np.int64))
     return {
         "SentenceIds": [sent],
         "SentenceScores": [scores],

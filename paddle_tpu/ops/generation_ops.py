@@ -1,0 +1,162 @@
+"""Autoregressive-generation ops: the KV-cache contract on the op surface.
+
+The reference serves decoding through host-side fast_decode loops
+(tests/book machine_translation + the C++ predictor); here the cache is
+DEVICE state threaded through the executor's donated rw-state machinery
+(core/executor.py analyze_block_io): the cache vars are persistable scope
+residents every decode step reads-then-writes, so the compiled per-token
+program updates them in place in HBM with a length-INDEPENDENT compile
+key (fixed [L, b, max_t, h, dh] buffers, dynamic-slice writes at the
+runtime length counters — never a shape change, never a retrace).
+
+Ops (all no_grad — generation never differentiates through the cache):
+  kv_cache_update   write K/V rows at per-sequence positions (layer attr)
+  decode_attention  one query row against the length-masked cache
+                    (kernels/decode_attention.py flash-decode kernel or
+                    its XLA fallback, FLAGS_flash_decode)
+  kv_cache_reorder  gather cache slots along batch (beam-search parent
+                    reordering; all layers in one op)
+  sample_token      greedy / temperature / top-k next-token selection;
+                    derives_rng is attr-gated on the strategy (greedy is
+                    deterministic and draws no step key)
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+
+def _cache_infer(ctx):
+    for slot, out in (("CacheK", "CacheKOut"), ("CacheV", "CacheVOut")):
+        s = ctx.input_shape(slot)
+        if s is not None:
+            ctx.set_output(out, tuple(s), ctx.input_dtype(slot))
+
+
+@register("kv_cache_update", no_grad=True, infer_shape=_cache_infer,
+          inplace_outputs={"CacheKOut": "CacheK", "CacheVOut": "CacheV"})
+def lower_kv_cache_update(ctx, ins):
+    """Write K/V [b, t, h, dh] into cache layer `layer` at per-sequence
+    start positions Pos [b] (ring-buffer semantics: writes clamp at
+    max_t).  Optional Active [b] keeps inactive sequences' rows
+    untouched (the continuous batcher's late-join mask).  Outputs carry
+    the SAME var names as CacheK/CacheV — a persistable read-then-write,
+    so the executor donates the buffers and the update is in place."""
+    import jax
+    import jax.numpy as jnp
+
+    k_new, v_new = ins["K"][0], ins["V"][0]
+    cache_k, cache_v = ins["CacheK"][0], ins["CacheV"][0]
+    pos = ins["Pos"][0].reshape(-1).astype(jnp.int32)
+    active = ins.get("Active", [None])[0]
+    layer = int(ctx.attr("layer", 0))
+
+    def write(cache, new):
+        def upd(c, n, p):  # [max_t, h, dh], [t, h, dh], scalar
+            return jax.lax.dynamic_update_slice(
+                c, n.astype(c.dtype), (p, 0, 0))
+
+        updated = jax.vmap(upd)(cache[layer], new, pos)
+        if active is not None:
+            keep = active.reshape(-1).astype(jnp.bool_)
+            updated = jnp.where(keep[:, None, None, None], updated,
+                                cache[layer])
+        return cache.at[layer].set(updated)
+
+    return {"CacheKOut": [write(cache_k, k_new)],
+            "CacheVOut": [write(cache_v, v_new)]}
+
+
+def _decode_attn_infer(ctx):
+    qs = ctx.input_shape("Q")
+    if qs is not None:
+        ctx.set_output("Out", tuple(qs), ctx.input_dtype("Q"))
+
+
+@register("decode_attention", no_grad=True, infer_shape=_decode_attn_infer)
+def lower_decode_attention(ctx, ins):
+    """Single-query attention: Q [b, 1, h, dh] against cache layer
+    `layer` ([L, b, max_t, h, dh]), masked to the first Lengths[b] rows.
+    FLAGS_flash_decode routes to the Pallas flash-decode kernel when the
+    plan gate accepts (kernels/decode_attention.py); otherwise — and
+    always off-TPU — the numerically-identical XLA fallback runs."""
+    import jax.numpy as jnp
+
+    from ..flags import FLAGS
+    from ..kernels import decode_attention as kda
+
+    q = ins["Q"][0]
+    cache_k, cache_v = ins["CacheK"][0], ins["CacheV"][0]
+    lengths = ins["Lengths"][0].reshape(-1).astype(jnp.int32)
+    layer = int(ctx.attr("layer", 0))
+    scale = float(ctx.attr("scale", 1.0))
+
+    b, one, h, dh = q.shape
+    q3 = q.reshape(b, h, dh)
+    k_l, v_l = cache_k[layer], cache_v[layer]
+    if FLAGS.flash_decode:
+        out = kda.flash_decode(q3, k_l, v_l, lengths, scale=scale)
+    else:
+        out = kda.reference_decode(q3, k_l, v_l, lengths, scale=scale)
+    return {"Out": [out.reshape(b, 1, h, dh)]}
+
+
+@register("kv_cache_reorder", no_grad=True, infer_shape=_cache_infer,
+          inplace_outputs={"CacheKOut": "CacheK", "CacheVOut": "CacheV"})
+def lower_kv_cache_reorder(ctx, ins):
+    """Gather cache slots along the batch axis: Parents [b] flat indices
+    (beam-search parent pointers offset into the b*k lane).  One op
+    reorders every layer of both caches — the per-step beam shuffle is a
+    single gather, not 2L of them."""
+    import jax.numpy as jnp
+
+    cache_k, cache_v = ins["CacheK"][0], ins["CacheV"][0]
+    parents = ins["Parents"][0].reshape(-1).astype(jnp.int32)
+    return {"CacheKOut": [jnp.take(cache_k, parents, axis=1)],
+            "CacheVOut": [jnp.take(cache_v, parents, axis=1)]}
+
+
+def _sample_infer(ctx):
+    s = ctx.input_shape("Logits")
+    if s is not None:
+        ctx.set_output("Out", (s[0], 1), "int64")
+
+
+def _sample_derives_rng(op) -> bool:
+    # greedy argmax is deterministic; only the stochastic strategies draw
+    # from the step key (executor._COND_RANDOM_OPS carries the SAME
+    # predicate — the bidirectional RNG lint keeps the two in sync)
+    return op.attrs.get("strategy", "greedy") != "greedy"
+
+
+@register("sample_token", no_grad=True, infer_shape=_sample_infer,
+          derives_rng=_sample_derives_rng)
+def lower_sample_token(ctx, ins):
+    """Next-token selection from Logits [b, V]:
+      strategy="greedy"  argmax (no PRNG; the decode program then
+                         compiles key-free and is bit-deterministic)
+      strategy="sample"  temperature-scaled categorical draw, optionally
+                         truncated to the top_k logits
+    Out [b, 1] int64."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = ins["Logits"][0].astype(jnp.float32)
+    strategy = ctx.attr("strategy", "greedy")
+    if strategy == "greedy":
+        ids = jnp.argmax(logits, axis=-1)
+    else:
+        temperature = float(ctx.attr("temperature", 1.0)) or 1.0
+        top_k = int(ctx.attr("top_k", 0))
+        scaled = logits / temperature
+        if top_k and top_k < logits.shape[-1]:
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -1e30)
+        ids = jax.random.categorical(ctx.next_rng_key(), scaled, axis=-1)
+    # id outputs keep reference int64 semantics under x64, clamped
+    # EXPLICITLY to int32 when x64 is off (the repo-wide no-truncate-
+    # warning convention, ops/tensor_ops.py _canon_i64)
+    import numpy as np
+
+    return {"Out": [ids.astype(jax.dtypes.canonicalize_dtype(np.int64))
+                    [:, None]]}

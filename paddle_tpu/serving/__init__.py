@@ -16,11 +16,28 @@ Three layers:
     (JSON + npz), /v1/models introspection, /metrics //health //flight
     inherited from the monitor stack, persistent XLA compilation cache.
 
+A fourth layer serves autoregressive generation (ROADMAP item 2):
+
+  * `generation.py` — GenerationServingModel + ContinuousBatcher:
+    continuous TOKEN-level batching of decode steps across in-flight
+    sequences on the KV-cache program pair (paddle_tpu/generation); new
+    sequences join at prefill via the active-mask feed, finished ones
+    retire their cache slot, and nothing ever retraces.  Endpoint:
+    POST /v1/models/<name>:generate.
+
 CLI: `python -m paddle_tpu.serving --model name=/path/to/export ...`
-Load test: `python tools/loadgen.py --url http://host:port --model name`.
+     (add `--demo-generation NAME` for the seeded tiny generation model)
+Load test: `python tools/loadgen.py --url http://host:port --model name`
+           (`--generate` for prompt-in/tokens-out TTFT + tokens/sec).
 """
 
 from .batcher import DynamicBatcher, FILL_BUCKETS  # noqa: F401
+from .generation import (  # noqa: F401
+    ContinuousBatcher,
+    GenerationConfig,
+    GenerationServingModel,
+    build_demo_generation_model,
+)
 from .model import ModelConfig, ServingModel, parse_buckets  # noqa: F401
 from .server import (  # noqa: F401
     InferenceServer,

@@ -23,9 +23,20 @@ def main(argv=None) -> int:
         prog="python -m paddle_tpu.serving",
         description="multi-model inference server with dynamic batching")
     p.add_argument("--model", action="append", default=[],
-                   metavar="NAME=DIR", required=True,
+                   metavar="NAME=DIR",
                    help="serve the exported model at DIR as NAME "
                         "(repeatable)")
+    p.add_argument("--demo-generation", action="append", default=[],
+                   metavar="NAME",
+                   help="also serve the seeded tiny transformer "
+                        "generation model as NAME (continuous "
+                        "token-level batching at "
+                        "POST /v1/models/NAME:generate; the CI smoke "
+                        "and loadgen --generate target)")
+    p.add_argument("--gen-slots", type=int, default=None,
+                   help="cache-slot count (decode batch) for "
+                        "--demo-generation models "
+                        "(default FLAGS_serving_decode_slots)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="0 picks an ephemeral port (printed in the ready "
@@ -71,8 +82,17 @@ def main(argv=None) -> int:
     unknown = int8_names - {c.name for c in configs}
     if unknown:
         p.error(f"--int8 names not among --model entries: {sorted(unknown)}")
+    if not configs and not args.demo_generation:
+        p.error("nothing to serve: pass --model and/or --demo-generation")
 
     server = InferenceServer(configs, host=args.host, port=args.port)
+    if args.demo_generation:
+        from paddle_tpu.serving.generation import \
+            build_demo_generation_model
+
+        for name in args.demo_generation:
+            server.add_generation_model(
+                build_demo_generation_model(name, slots=args.gen_slots))
     server.start(warmup=not args.no_warmup)
     print(json.dumps({
         "event": "serving_ready",

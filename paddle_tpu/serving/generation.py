@@ -1,0 +1,419 @@
+"""Continuous token-level batching: the decode-aware serving model.
+
+Where the PR-6 DynamicBatcher coalesces whole REQUESTS into one padded
+forward pass, generation requests are thousands of per-token steps — so
+the unit of batching here is the DECODE STEP (Orca OSDI'22 iteration-
+level scheduling, mapped onto the executor's donated-cache machinery):
+
+  * a GenerationServingModel owns one GenerationSession whose programs
+    are compiled for a fixed SLOT count (the decode batch dimension);
+    each slot is one cache lane ([L, slots, max_t, h, dh]);
+  * the ContinuousBatcher scheduler thread runs one decode program call
+    per iteration for ALL occupied slots (active-mask feed) — in-flight
+    sequences share every step;
+  * new requests join between steps: the prefill program runs with an
+    active mask selecting only the joining slots (the kv_cache_update
+    Active input keeps every other slot's cache rows and counters
+    untouched), so a late arrival costs one prefill call and ZERO
+    retraces — both programs were compiled at warmup and their feed
+    shapes never change;
+  * finished sequences (eos or token budget) retire their slot at the
+    end of the step; the slot is immediately reusable.
+
+Observability (PR-1 registry): per-model time-to-first-token histogram
+(serving.gen.<name>.ttft_seconds), generated-token + decode-step
+counters (tokens/sec = rate(tokens)), request latency histogram,
+occupancy gauge.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+# TTFT is dominated by queue wait + one prefill + one decode step: a
+# finer-than-default ladder at the low end keeps p50 informative
+TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5, 5.0, 10.0, 30.0)
+
+_STOP = object()
+
+
+class GenerationConfig:
+    """Policy + model geometry for one generation serving model."""
+
+    __slots__ = ("name", "slots", "max_tokens", "model_kw")
+
+    def __init__(self, name: str, slots: Optional[int] = None,
+                 max_tokens: Optional[int] = None, **model_kw):
+        from ..flags import FLAGS
+
+        if not name or "/" in name or ":" in name:
+            raise ValueError(f"model name {name!r} must be URL-path safe")
+        self.name = name
+        self.slots = int(slots if slots is not None
+                         else FLAGS.serving_decode_slots)
+        # model_kw forwards to models/transformer.build_generation_programs
+        # (vocab sizes, depth, src_seq_len, max_out_len, bos/eos, ...)
+        self.model_kw = dict(model_kw)
+        self.max_tokens = int(max_tokens if max_tokens is not None
+                              else self.model_kw.get("max_out_len", 16))
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_tokens", "t_enqueue", "t_first_token",
+                 "event", "tokens", "error", "meta", "cancelled")
+
+    def __init__(self, prompt, max_tokens):
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.t_enqueue = time.perf_counter()
+        self.t_first_token = None
+        self.event = threading.Event()
+        self.tokens: List[int] = []
+        self.error = None
+        self.meta = None
+        # set by a timed-out client: the scheduler retires the slot at
+        # the next step instead of decoding the abandoned sequence to
+        # its full budget (repeated timeouts must not starve the slots)
+        self.cancelled = False
+
+
+class GenerationServingModel:
+    """One generation program pair + cache state, servable via the
+    continuous batcher.  Requires the KV-cache route (FLAGS_kv_cache):
+    continuous batching is meaningless when every step recomputes the
+    full prefix."""
+
+    def __init__(self, config: GenerationConfig, scope=None,
+                 session=None):
+        from ..core import executor as ex
+        from ..flags import FLAGS
+        from ..generation import GenerationSession
+        from ..models.transformer import build_generation_programs
+
+        if not FLAGS.kv_cache:
+            raise ValueError(
+                "generation serving requires FLAGS_kv_cache=1 (the "
+                "recompute oracle has no per-slot cache for continuous "
+                "batching to schedule)")
+        self.config = config
+        self.name = config.name
+        if session is None:
+            kw = dict(config.model_kw)
+            kw["batch_size"] = config.slots
+            kw.setdefault("strategy", "greedy")
+            programs = build_generation_programs(beam_size=None, **kw)
+            session = GenerationSession(programs,
+                                        scope=scope or ex.Scope())
+        p = session.p
+        if p.beam_size is not None or not p.kv_cache:
+            raise ValueError(
+                "generation serving needs a non-beam KV-cached session")
+        self.session = session
+        self.slots = p.batch_size
+        self.max_prompt_len = p.src_seq_len
+        self.max_tokens = min(config.max_tokens, p.max_out_len)
+        self.bos_id, self.eos_id = p.bos_id, p.eos_id
+        self.vocab = p.src_vocab_size
+        self.ready = False
+
+    def init_params(self):
+        self.session.init_params()
+
+    def warmup(self) -> int:
+        """Compile prefill + decode with an all-inactive mask (no slot
+        state is touched); production steps then never pay a trace."""
+        zeros_active = np.zeros((self.slots,), np.float32)
+        self.session.prefill(
+            np.zeros((self.slots, self.max_prompt_len, 1), np.int64),
+            active=zeros_active)
+        self.session.decode_step(
+            np.full((self.slots,), self.bos_id, np.int64),
+            active=zeros_active)
+        self.ready = True
+        return 2
+
+    @property
+    def compile_count(self) -> int:
+        return self.session.compile_count
+
+    def info(self) -> dict:
+        from .. import monitor
+
+        reg = monitor.default_registry()
+        ttft = reg.get(f"serving.gen.{self.name}.ttft_seconds")
+        toks = reg.get(f"serving.gen.{self.name}.tokens")
+        info = {
+            "name": self.name,
+            "type": "generation",
+            "ready": self.ready,
+            "slots": self.slots,
+            "max_prompt_len": self.max_prompt_len,
+            "max_tokens": self.max_tokens,
+            "vocab_size": self.vocab,
+            "bos_id": self.bos_id,
+            "eos_id": self.eos_id,
+            "compiled_signatures": self.compile_count,
+            "tokens_generated": toks.value if toks is not None else 0,
+        }
+        if ttft is not None and ttft.count:
+            info["ttft_s"] = {"p50": ttft.quantile(0.5),
+                              "p99": ttft.quantile(0.99),
+                              "count": ttft.count}
+        return info
+
+
+class ContinuousBatcher:
+    """One scheduler thread per generation model: admits requests into
+    free cache slots at prefill and coalesces every occupied slot's next
+    token into one decode-program call."""
+
+    def __init__(self, model: GenerationServingModel):
+        self.model = model
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        # slot state (scheduler-thread-private once started)
+        self._slot_req: List[Optional[_GenRequest]] = \
+            [None] * model.slots
+        self._slot_token = np.full((model.slots,), model.bos_id, np.int64)
+        self._pending_join: collections.deque = collections.deque()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop,
+            name=f"serving-genbatcher-{self.model.name}", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if not self._running:
+            return
+        self._running = False
+        self._queue.put(_STOP)
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    # -- client side -----------------------------------------------------
+    def submit(self, prompt, max_tokens: Optional[int] = None,
+               timeout: float = 60.0):
+        """Block until the sequence finishes; returns (tokens, meta)."""
+        from .. import monitor
+
+        model = self.model
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > model.max_prompt_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the model's "
+                f"max_prompt_len {model.max_prompt_len}")
+        # id 0 is the pad id (the cross-attention length mask assumes
+        # padding is TRAILING — a mid-prompt 0 would be attended as a
+        # real token, unlike the training-side pad bias): reject it
+        bad = [t for t in prompt if not 0 < t < model.vocab]
+        if bad:
+            raise ValueError(
+                f"prompt ids must be in (0, {model.vocab}) — 0 is the "
+                f"pad id: {bad[:5]}")
+        mt = (model.max_tokens if max_tokens is None
+              else min(int(max_tokens), model.max_tokens))
+        if mt <= 0:
+            raise ValueError(f"max_tokens must be positive, got {mt}")
+        req = _GenRequest(prompt, mt)
+        self._queue.put(req)
+        if not req.event.wait(timeout):
+            req.cancelled = True  # scheduler retires the slot next step
+            req.error = TimeoutError(
+                f"generation not finished within {timeout}s "
+                f"(model {model.name!r})")
+            if monitor.enabled():
+                monitor.counter(
+                    f"serving.gen.{model.name}.timeouts").inc()
+            raise req.error
+        if req.error is not None:
+            raise req.error
+        if monitor.enabled():
+            dt = time.perf_counter() - req.t_enqueue
+            monitor.counter(f"serving.gen.{model.name}.requests").inc()
+            monitor.histogram(
+                f"serving.gen.{model.name}.request_seconds").observe(dt)
+        return req.tokens, req.meta
+
+    # -- scheduler side --------------------------------------------------
+    def _drain_queue(self, block: bool) -> bool:
+        """Move arrivals into the pending-join deque; returns False on
+        STOP."""
+        while True:
+            try:
+                item = (self._queue.get(timeout=0.05) if block
+                        else self._queue.get_nowait())
+            except queue.Empty:
+                return True
+            if item is _STOP:
+                return False
+            self._pending_join.append(item)
+            block = False
+
+    def _admit(self) -> None:
+        """Prefill every pending request that fits a free slot — ONE
+        masked prefill call regardless of how many join this round."""
+        from .. import monitor
+
+        model = self.model
+        free = [i for i, r in enumerate(self._slot_req) if r is None]
+        if not free or not self._pending_join:
+            return
+        joining = []
+        while free and self._pending_join:
+            req = self._pending_join.popleft()
+            if req.cancelled:  # timed out while still queued
+                continue
+            slot = free.pop(0)
+            self._slot_req[slot] = req
+            self._slot_token[slot] = model.bos_id
+            joining.append((slot, req))
+        if not joining:
+            return
+        src = np.zeros((model.slots, model.max_prompt_len, 1), np.int64)
+        active = np.zeros((model.slots,), np.float32)
+        for slot, req in joining:
+            src[slot, :len(req.prompt), 0] = req.prompt
+            active[slot] = 1.0
+        model.session.prefill(src, active=active)
+        if monitor.enabled():
+            monitor.counter(
+                f"serving.gen.{model.name}.prefills").inc(len(joining))
+
+    def _step(self) -> None:
+        """One coalesced decode step for every occupied slot."""
+        from .. import monitor
+
+        model = self.model
+        active = np.asarray(
+            [1.0 if r is not None else 0.0 for r in self._slot_req],
+            np.float32)
+        if not active.any():
+            return
+        nxt = model.session.decode_step(self._slot_token, active=active)
+        now = time.perf_counter()
+        mon = monitor.enabled()
+        emitted = 0
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            if req.cancelled:
+                # abandoned by a timed-out client: free the slot now
+                # instead of decoding the rest of its budget
+                self._slot_req[slot] = None
+                continue
+            tok = int(nxt[slot])
+            if req.t_first_token is None:
+                req.t_first_token = now
+                if mon:
+                    monitor.histogram(
+                        f"serving.gen.{model.name}.ttft_seconds",
+                        buckets=TTFT_BUCKETS).observe(
+                        now - req.t_enqueue)
+            req.tokens.append(tok)
+            emitted += 1
+            self._slot_token[slot] = tok
+            if tok == model.eos_id or len(req.tokens) >= req.max_tokens:
+                req.meta = {
+                    "slot": slot,
+                    "tokens": len(req.tokens),
+                    "ttft_ms": round(
+                        (req.t_first_token - req.t_enqueue) * 1e3, 3),
+                    "total_ms": round((now - req.t_enqueue) * 1e3, 3),
+                    "finished": ("eos" if tok == model.eos_id
+                                 else "max_tokens"),
+                }
+                self._slot_req[slot] = None  # retire the slot
+                req.event.set()
+        if mon:
+            monitor.counter(f"serving.gen.{model.name}.tokens").inc(
+                emitted)
+            monitor.counter(
+                f"serving.gen.{model.name}.decode_steps").inc()
+            monitor.gauge(f"serving.gen.{model.name}.occupancy").set(
+                sum(1 for r in self._slot_req if r is not None))
+
+    def _fail_slots(self, exc: Exception) -> None:
+        """A prefill/decode call raised: fail every occupied slot (the
+        shared step means their state is suspect) but KEEP the scheduler
+        alive for future requests — the DynamicBatcher 'fail the batch,
+        not the loop' contract (batcher.py _execute)."""
+        from .. import monitor
+
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            self._slot_req[slot] = None
+            req.error = exc
+            req.event.set()
+        if monitor.enabled():
+            monitor.counter(
+                f"serving.gen.{self.model.name}.step_errors").inc()
+
+    def _loop(self) -> None:
+        try:
+            while self._running:
+                idle = not any(r is not None for r in self._slot_req)
+                if not self._drain_queue(block=idle):
+                    break
+                try:
+                    self._admit()
+                    self._step()
+                except Exception as e:  # noqa: BLE001 — fail the
+                    # in-flight slots, not the scheduler (a dead loop
+                    # would hang every current AND future caller)
+                    self._fail_slots(e)
+        finally:
+            # fail whatever is still in flight/queued so no caller
+            # hangs — in a finally so even an unexpected scheduler
+            # crash drains its callers
+            leftovers = [r for r in self._slot_req if r is not None]
+            self._slot_req = [None] * self.model.slots
+            leftovers.extend(self._pending_join)
+            self._pending_join.clear()
+            while True:
+                try:
+                    r = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if r is not _STOP:
+                    leftovers.append(r)
+            for r in leftovers:
+                r.error = RuntimeError(
+                    f"generation batcher for {self.model.name!r} stopped")
+                r.event.set()
+
+
+def build_demo_generation_model(name: str = "gendemo",
+                                slots: Optional[int] = None,
+                                seed: int = 11) -> GenerationServingModel:
+    """Deterministic tiny transformer generation model (random-init,
+    seeded) — the CLI `--demo-generation` target the CI smoke and
+    loadgen's generation mode drive."""
+    cfg = GenerationConfig(
+        name, slots=slots,
+        src_vocab_size=32, trg_vocab_size=32, max_length=72,
+        n_layer=2, n_head=2, d_key=16, d_value=16, d_model=32,
+        d_inner_hid=64, src_seq_len=8, max_out_len=64,
+        bos_id=0, eos_id=1)
+    model = GenerationServingModel(cfg)
+    for prog in (model.session.p.prefill, model.session.p.decode,
+                 model.session.p.startup):
+        prog.random_seed = seed
+    model.init_params()
+    return model

@@ -143,10 +143,15 @@ class ServingHandler(mserve.MonitorHandler):
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         try:
             url = urlparse(self.path)
+            gen_name = self._generate_target(url.path)
+            if gen_name is not None:
+                self._do_generate(gen_name)
+                return
             name = self._predict_target(url.path)
             if name is None:
                 self._send_json(404, {
-                    "error": "POST /v1/models/<name>:predict"})
+                    "error": "POST /v1/models/<name>:predict "
+                             "(or :generate for generation models)"})
                 return
             srv = self.server.inference_server
             model = srv.model(name)
@@ -209,6 +214,60 @@ class ServingHandler(mserve.MonitorHandler):
         if rest.endswith("/predict"):
             return rest[:-len("/predict")]
         return None
+
+    @staticmethod
+    def _generate_target(path: str) -> Optional[str]:
+        if not path.startswith("/v1/models/"):
+            return None
+        rest = path[len("/v1/models/"):]
+        for suffix in (":generate", "/generate"):
+            if rest.endswith(suffix):
+                return rest[:-len(suffix)]
+        return None
+
+    def _do_generate(self, name: str) -> None:
+        """POST /v1/models/<name>:generate — continuous-batched
+        autoregressive generation.  JSON body:
+            {"prompt": [token ids...], "max_tokens": N,
+             "timeout_s": S}  ->
+            {"tokens": [...], "meta": {"ttft_ms", "total_ms", ...}}
+        The request joins the model's in-flight decode stream at prefill
+        (no retrace, no stall of other sequences) and returns when its
+        sequence emits eos or exhausts its token budget."""
+        srv = self.server.inference_server
+        try:
+            gen = srv.generation_model(name)
+            if gen is None:
+                raise RequestError(
+                    404, f"no generation model {name!r} "
+                         f"(served: {sorted(srv._gen_models)})")
+            length = int(self.headers.get("Content-Length", 0))
+            if length <= 0:
+                raise RequestError(411, "request body required")
+            try:
+                payload = json.loads(self.rfile.read(length).decode())
+            except (ValueError, UnicodeDecodeError) as e:
+                raise RequestError(400, f"malformed JSON body: {e}")
+            if not isinstance(payload, dict) or "prompt" not in payload:
+                raise RequestError(
+                    400, 'JSON body must carry a "prompt" id list')
+            try:
+                timeout = float(payload.get("timeout_s", 60.0))
+            except (TypeError, ValueError):
+                raise RequestError(400, '"timeout_s" must be a number')
+            try:
+                tokens, meta = srv.submit_generate(
+                    name, payload["prompt"],
+                    max_tokens=payload.get("max_tokens"),
+                    timeout=timeout)
+            except (TypeError, ValueError) as e:
+                raise RequestError(400, str(e))
+            except TimeoutError as e:
+                raise RequestError(504, str(e))
+            self._send_json(200, {"tokens": [int(t) for t in tokens],
+                                  "meta": meta})
+        except RequestError as e:
+            self._send_json(e.code, {"error": str(e)})
 
     def _send_json(self, code: int, body: dict) -> None:
         self._send(code, json.dumps(_json_safe(body)) + "\n",
@@ -318,6 +377,9 @@ class InferenceServer:
         self._requested_port = port
         self._models: Dict[str, ServingModel] = {}
         self._batchers: Dict[str, DynamicBatcher] = {}
+        # decode-aware generation tier (continuous token-level batching)
+        self._gen_models: Dict[str, "GenerationServingModel"] = {}
+        self._gen_batchers: Dict[str, "ContinuousBatcher"] = {}
         self._httpd: Optional[_ServingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started = False
@@ -326,7 +388,8 @@ class InferenceServer:
 
     # -- model management ------------------------------------------------
     def add_model(self, config: ModelConfig) -> ServingModel:
-        if config.name in self._models:
+        if (config.name in self._models
+                or config.name in self._gen_models):
             raise ValueError(f"model {config.name!r} already served")
         model = ServingModel(config)
         batcher = DynamicBatcher(model)
@@ -338,15 +401,40 @@ class InferenceServer:
             _warmup_verified(model.warmup)
         return model
 
+    def add_generation_model(self, model) -> "GenerationServingModel":
+        """Serve a GenerationServingModel (serving/generation.py) at
+        POST /v1/models/<name>:generate with continuous token-level
+        batching.  Accepts a built model or a GenerationConfig."""
+        from .generation import (ContinuousBatcher, GenerationConfig,
+                                 GenerationServingModel)
+
+        if isinstance(model, GenerationConfig):
+            model = GenerationServingModel(model)
+            model.init_params()
+        if model.name in self._models or model.name in self._gen_models:
+            raise ValueError(f"model {model.name!r} already served")
+        batcher = ContinuousBatcher(model)
+        self._gen_models[model.name] = model
+        self._gen_batchers[model.name] = batcher
+        if self._started:
+            _warmup_verified(model.warmup)
+            batcher.start()
+        return model
+
     def model(self, name: str) -> Optional[ServingModel]:
         return self._models.get(name)
 
+    def generation_model(self, name: str):
+        return self._gen_models.get(name)
+
     @property
     def model_names(self) -> List[str]:
-        return sorted(self._models)
+        return sorted(self._models) + sorted(self._gen_models)
 
     def models_info(self) -> List[dict]:
-        return [self._models[n].info() for n in self.model_names]
+        return ([self._models[n].info() for n in sorted(self._models)]
+                + [self._gen_models[n].info()
+                   for n in sorted(self._gen_models)])
 
     # -- lifecycle -------------------------------------------------------
     def start(self, warmup: bool = True) -> int:
@@ -363,6 +451,8 @@ class InferenceServer:
             FLAGS.monitor = True
         enable_compilation_cache()
         for b in self._batchers.values():
+            b.start()
+        for b in self._gen_batchers.values():
             b.start()
         self._httpd = _ServingHTTPServer(
             (self.host, int(self._requested_port)), ServingHandler)
@@ -384,14 +474,18 @@ class InferenceServer:
         return self.port
 
     def warmup(self) -> int:
-        """Pre-compile every model's (precision x bucket) ladder; with
+        """Pre-compile every model's (precision x bucket) ladder and
+        every generation model's prefill+decode pair; with
         FLAGS.serving_cache_dir set the compiles persist across
         restarts.  Returns total signatures warmed."""
         return _warmup_verified(
-            lambda: sum(m.warmup() for m in self._models.values()))
+            lambda: sum(m.warmup() for m in self._models.values())
+            + sum(m.warmup() for m in self._gen_models.values()))
 
     def stop(self) -> None:
         for b in self._batchers.values():
+            b.stop()
+        for b in self._gen_batchers.values():
             b.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -421,13 +515,30 @@ class InferenceServer:
                            f"(served: {self.model_names})")
         return batcher.submit(feed, precision=precision, timeout=timeout)
 
+    def submit_generate(self, name: str, prompt, max_tokens=None,
+                        timeout: float = 60.0):
+        """Programmatic generation entry (the HTTP :generate handler and
+        in-process callers share the same continuous batcher)."""
+        batcher = self._gen_batchers.get(name)
+        if batcher is None:
+            raise KeyError(f"no generation model {name!r} "
+                           f"(served: {sorted(self._gen_models)})")
+        return batcher.submit(prompt, max_tokens=max_tokens,
+                              timeout=timeout)
+
     def readiness(self) -> dict:
         models = {
             n: {"ready": m.ready, "precisions": m.precisions}
             for n, m in self._models.items()
         }
+        models.update({
+            n: {"ready": m.ready, "type": "generation"}
+            for n, m in self._gen_models.items()
+        })
+        all_models = list(self._models.values()) \
+            + list(self._gen_models.values())
         return {
-            "ready": bool(self._models)
-            and all(m.ready for m in self._models.values()),
+            "ready": bool(all_models)
+            and all(m.ready for m in all_models),
             "models": models,
         }

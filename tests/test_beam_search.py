@@ -166,3 +166,139 @@ def test_transformer_beam_decode_end_to_end():
     # the trained model should mostly copy the source on beam 0
     acc = float((s[:, 0, :] == src[:, :, 0]).mean())
     assert acc > 0.55, (acc, s[:, 0], src[:, :, 0])
+
+
+# ---------------------------------------------------------------------------
+# Edge cases the PR-11 generation drivers exercise (beyond the book-test
+# decoder): end_id termination mid-beam, hypotheses shorter than max_len,
+# and batch-1 vs batch-N parity of the dense ops.
+# ---------------------------------------------------------------------------
+
+
+def _beam_step_graph(b_unused, k, v, end_id):
+    pre_ids = layers.data(name="pre_ids", shape=[k], dtype="int64")
+    pre_scores = layers.data(name="pre_scores", shape=[k],
+                             dtype="float32")
+    scores = layers.data(name="scores", shape=[k, v], dtype="float32")
+    return (pre_ids, pre_scores, scores, layers.beam_search(
+        pre_ids, pre_scores, None, scores, beam_size=k, end_id=end_id))
+
+
+def test_beam_search_end_id_termination_mid_beam():
+    """A beam that hits end_id mid-decode freezes: on EVERY later step it
+    admits only the end_id continuation at its frozen score, while live
+    beams keep extending — stepped through three rounds."""
+    k, v, end_id = 3, 8, 1
+    _, _, _, (sel_ids, sel_scores, parent) = _beam_step_graph(
+        1, k, v, end_id)
+    exe = pt.Executor(pt.CPUPlace())
+
+    def step(pi, ps, sc):
+        si, ss, pa = exe.run(
+            feed={"pre_ids": pi, "pre_scores": ps, "scores": sc},
+            fetch_list=[sel_ids, sel_scores, parent])
+        return np.asarray(si), np.asarray(ss), np.asarray(pa)
+
+    rng = np.random.RandomState(7)
+    pi = np.full((1, k), 5, "int64")
+    ps = np.array([[0.0, -0.1, -0.2]], "float32")
+    # step 1: force beam 0 to pick end_id (huge end_id score)
+    sc = np.full((1, k, v), -5.0, "float32")
+    sc[0, 0, end_id] = 0.0
+    si, ss, pa = step(pi, ps, sc)
+    assert si[0, 0] == end_id and pa[0, 0] == 0
+    frozen = ss[0, 0]
+    # steps 2..3: random live scores — the finished beam must survive
+    # with EXACTLY its frozen score and only the end_id continuation
+    for _ in range(2):
+        sc = np.log(rng.dirichlet(np.ones(v), size=(1, k))
+                    ).astype("float32")[:, :, :]
+        si, ss, pa = step(si, ss, sc)
+        done = [j for j in range(k)
+                if si[0, j] == end_id and abs(ss[0, j] - frozen) < 1e-6]
+        assert done, (si, ss, frozen)
+        # its parent chain points back at the finished lane
+        assert si[0, done[0]] == end_id
+
+
+def test_beam_search_decode_hypotheses_shorter_than_max_len():
+    """Steps past a hypothesis's termination carry (end_id, identity
+    parent): the backtrack must yield an end_id-PADDED tail, not replay
+    stale tokens — the convention the per-token beam driver feeds."""
+    t_cap, b, k, end_id = 5, 1, 2, 1
+    ids = layers.data(name="ids", shape=[b, k], dtype="int64")
+    parents = layers.data(name="parents", shape=[b, k], dtype="int64")
+    fin = layers.data(name="fin", shape=[k], dtype="float32")
+    sent, sscores = layers.beam_search_decode(
+        ids, fin, beam_size=k, end_id=end_id, parents=parents)
+    exe = pt.Executor(pt.CPUPlace())
+    # real steps: t0 tokens [4, 7]; t1 beam 0 finishes (end_id), beam 1
+    # continues from beam 1; t2.. padded with (end_id, identity)
+    ids_v = np.array([[[4, 7]], [[end_id, 6]], [[end_id, end_id]],
+                      [[end_id, end_id]], [[end_id, end_id]]], "int64")
+    par_v = np.array([[[0, 1]], [[0, 1]], [[0, 1]], [[0, 1]],
+                      [[0, 1]]], "int64")
+    fin_v = np.array([[-1.0, -2.0]], "float32")
+    s, sc = exe.run(feed={"ids": ids_v, "parents": par_v, "fin": fin_v},
+                    fetch_list=[sent, sscores])
+    s = np.asarray(s)
+    assert s.shape == (b, k, t_cap)
+    np.testing.assert_array_equal(s[0, 0], [4, end_id, end_id, end_id,
+                                            end_id])
+    np.testing.assert_array_equal(s[0, 1], [7, 6, end_id, end_id,
+                                            end_id])
+    np.testing.assert_allclose(np.asarray(sc)[0], fin_v[0])
+
+
+def test_beam_search_batch1_vs_batchN_parity():
+    """The dense beam step must treat batch lanes independently: running
+    batch N in one call == N batch-1 calls, row for row (and the same
+    through beam_search_decode)."""
+    bN, k, v, end_id, t_cap = 4, 3, 11, 1, 3
+    _, _, _, (sel_ids, sel_scores, parent) = _beam_step_graph(
+        bN, k, v, end_id)
+    exe = pt.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(11)
+    pi = rng.randint(2, v, (bN, k)).astype("int64")
+    pi[2, 1] = end_id  # one finished beam in one lane
+    ps = rng.randn(bN, k).astype("float32")
+    sc = np.log(rng.dirichlet(np.ones(v), size=(bN, k))).astype("float32")
+
+    si_N, ss_N, pa_N = exe.run(
+        feed={"pre_ids": pi, "pre_scores": ps, "scores": sc},
+        fetch_list=[sel_ids, sel_scores, parent])
+    for i in range(bN):
+        si1, ss1, pa1 = exe.run(
+            feed={"pre_ids": pi[i:i + 1], "pre_scores": ps[i:i + 1],
+                  "scores": sc[i:i + 1]},
+            fetch_list=[sel_ids, sel_scores, parent])
+        np.testing.assert_array_equal(np.asarray(si_N)[i],
+                                      np.asarray(si1)[0])
+        np.testing.assert_allclose(np.asarray(ss_N)[i],
+                                   np.asarray(ss1)[0], rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(pa_N)[i],
+                                      np.asarray(pa1)[0])
+
+    # decode parity on stacked steps (fresh program: the step graph above
+    # must not be re-traced with unfed inputs)
+    dec_prog = pt.Program()
+    with pt.program_guard(dec_prog, pt.Program()):
+        ids_d = layers.data(name="ids_d", shape=[bN, k], dtype="int64")
+        par_d = layers.data(name="par_d", shape=[bN, k], dtype="int64")
+        fin_d = layers.data(name="fin_d", shape=[k], dtype="float32")
+        sent, _ = layers.beam_search_decode(
+            ids_d, fin_d, beam_size=k, end_id=end_id, parents=par_d)
+    ids_steps = rng.randint(2, v, (t_cap, bN, k)).astype("int64")
+    par_steps = rng.randint(0, k, (t_cap, bN, k)).astype("int64")
+    fin_v = rng.randn(bN, k).astype("float32")
+    sN = np.asarray(exe.run(
+        dec_prog,
+        feed={"ids_d": ids_steps, "par_d": par_steps, "fin_d": fin_v},
+        fetch_list=[sent])[0])
+    for i in range(bN):
+        s1 = np.asarray(exe.run(
+            dec_prog,
+            feed={"ids_d": ids_steps[:, i:i + 1], "par_d":
+                  par_steps[:, i:i + 1], "fin_d": fin_v[i:i + 1]},
+            fetch_list=[sent])[0])
+        np.testing.assert_array_equal(sN[i], s1[0])

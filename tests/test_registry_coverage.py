@@ -23,6 +23,8 @@ PUBLIC_API = [
     "layers", "nets.py", "optimizer.py", "metrics.py", "io.py", "amp.py",
     "initializer.py", "clip.py", "regularizer.py", "contrib", "imperative",
     "passes.py", "inference.py", "layer_helper.py",
+    # the generation tier's op wrappers (KVCache.write/attend/reorder)
+    "generation",
 ]
 
 # Ops a user never spells: emitted by the executor/backward/compiler

@@ -319,7 +319,7 @@ class TestNoFalsePositives:
         # every Pallas plan family in kernels/ is covered
         assert set(report) == {
             "attention", "qkv_attention", "conv_bn", "dropout_epilogue",
-            "embedding", "ring_attention",
+            "embedding", "ring_attention", "decode_attention",
         }
         for fam, rows in report.items():
             assert rows, fam

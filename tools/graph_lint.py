@@ -162,6 +162,32 @@ def build_serving():
             (label, pruned, ["x"], [out.name], None)]
 
 
+def build_generation():
+    """The autoregressive generation tier's program pair (PR-11): the
+    encoder->cross-cache prefill and the While-FREE per-token KV-cached
+    decode program (the beam-search While program is the
+    transformer-decoder entry above).  A second decode build with
+    strategy="sample" keeps the bidirectional RNG lint honest on
+    sample_token's attr-gated derives_rng."""
+    from paddle_tpu.models import transformer as T
+
+    out = []
+    for strat in ("greedy", "sample"):
+        progs = T.build_generation_programs(
+            src_vocab_size=1000, trg_vocab_size=1000, max_length=64,
+            n_layer=2, n_head=4, d_key=32, d_value=32, d_model=128,
+            d_inner_hid=256, batch_size=4, src_seq_len=32, max_out_len=8,
+            beam_size=None, strategy=strat, top_k=8, kv_cache=True)
+        if strat == "greedy":
+            out.append(("generation/prefill", progs.prefill,
+                        ["src_word", "src_pos", "gen_active"],
+                        progs.prefill_fetch, progs.startup))
+        out.append((f"generation/decode-{strat}", progs.decode,
+                    ["gen_token", "gen_active"], progs.decode_fetch,
+                    None))
+    return out
+
+
 BUILDERS = {
     "mnist": build_mnist,
     "resnet": build_resnet,
@@ -170,6 +196,7 @@ BUILDERS = {
     "deepfm": build_deepfm,
     "seq2seq": build_seq2seq,
     "serving": build_serving,
+    "generation": build_generation,
 }
 
 

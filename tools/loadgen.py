@@ -20,6 +20,12 @@ sizes cycle through --batch-sizes so the request stream is
 shape-varying (the dynamic batcher's pad-to-bucket path, not one warm
 signature).
 
+Generation mode (--generate, closed loop): drives POST :generate on a
+generation model (serving/generation.py continuous token-level
+batching) with synthetic prompts and reports TTFT p50/p99 (server-side,
+from the response meta) plus aggregate tokens/sec alongside the usual
+latency/QPS/compile-delta story.
+
 Usage:
   python tools/loadgen.py --url http://127.0.0.1:8000 --model demo \
       --requests 300 --concurrency 8 --out loadgen.json
@@ -112,12 +118,17 @@ class _Stats:
         self.latencies = []
         self.errors = 0
         self.lag = []  # open loop: send lateness vs schedule
+        self.ttfts_ms = []  # generation mode: server-side TTFT per req
+        self.tokens = 0     # generation mode: tokens received
 
-    def ok(self, dt: float, lag: float = 0.0):
+    def ok(self, dt: float, lag: float = 0.0, ttft_ms=None, tokens=0):
         with self.lock:
             self.latencies.append(dt)
             if lag:
                 self.lag.append(lag)
+            if ttft_ms is not None:
+                self.ttfts_ms.append(float(ttft_ms))
+            self.tokens += tokens
 
     def fail(self):
         with self.lock:
@@ -137,6 +148,10 @@ class _Conn:
         self.conn = None
 
     def request(self, target: str, body: bytes) -> bool:
+        return self.request_body(target, body) is not None
+
+    def request_body(self, target: str, body: bytes):
+        """POST; returns the response bytes on 2xx, None on failure."""
         for attempt in (0, 1):  # one transparent reconnect
             try:
                 if self.conn is None:
@@ -146,13 +161,13 @@ class _Conn:
                     "POST", target, body=body,
                     headers={"Content-Type": "application/json"})
                 r = self.conn.getresponse()
-                r.read()
-                return 200 <= r.status < 300
+                data = r.read()
+                return data if 200 <= r.status < 300 else None
             except (http.client.HTTPException, OSError):
                 self.close()
                 if attempt:
-                    return False
-        return False
+                    return None
+        return None
 
     def close(self):
         if self.conn is not None:
@@ -175,6 +190,27 @@ def _fire(conn: _Conn, model: str, body: bytes, precision: str,
         stats.fail()
 
 
+def _fire_generate(conn: _Conn, model: str, body: bytes,
+                   stats: _Stats) -> None:
+    """Prompt-in/tokens-out request: records the server-side TTFT from
+    the response meta (the continuous batcher stamps time-to-first-token
+    at the decode step that produced it) and the generated token count
+    (client tokens/sec = sum(tokens) / wall)."""
+    t0 = time.perf_counter()
+    data = conn.request_body(f"/v1/models/{model}:generate", body)
+    if data is None:
+        stats.fail()
+        return
+    try:
+        payload = json.loads(data)
+        meta = payload.get("meta") or {}
+        stats.ok(time.perf_counter() - t0,
+                 ttft_ms=meta.get("ttft_ms"),
+                 tokens=len(payload.get("tokens") or ()))
+    except ValueError:
+        stats.fail()
+
+
 # ---------------------------------------------------------------------------
 # main
 # ---------------------------------------------------------------------------
@@ -194,6 +230,16 @@ def main(argv=None) -> int:
                    help="request batch sizes, cycled (shape-varying "
                         "stream)")
     p.add_argument("--precision", default="fp32")
+    p.add_argument("--generate", action="store_true",
+                   help="generation mode: drive POST :generate on a "
+                        "generation model (prompt-in/tokens-out); "
+                        "reports TTFT p50/p99 (server-side, from "
+                        "response meta) and aggregate tokens/sec")
+    p.add_argument("--prompt-len", type=int, default=4,
+                   help="generation mode: synthetic prompt length")
+    p.add_argument("--max-tokens", type=int, default=None,
+                   help="generation mode: per-request token budget "
+                        "(default: the model's max_tokens)")
     p.add_argument("--timeout-s", type=float, default=30.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default="",
@@ -211,13 +257,37 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     rng = np.random.RandomState(args.seed)
-    sizes = [int(s) for s in args.batch_sizes.split(",") if s.strip()]
-    # pre-serialized bodies (one per batch size): the generator must not
-    # bottleneck the measurement
-    bodies = [
-        json.dumps({"inputs": synth_feed(info["feeds"], b, rng)}).encode()
-        for b in sizes
-    ]
+    if args.generate:
+        if args.mode != "closed":
+            print("loadgen: --generate supports closed loop only",
+                  file=sys.stderr)
+            return 2
+        if info.get("type") != "generation":
+            print(f"loadgen: model {args.model!r} is not a generation "
+                  f"model (no :generate endpoint)", file=sys.stderr)
+            return 2
+        sizes = []
+        vocab = int(info["vocab_size"])
+        plen = min(args.prompt_len, int(info["max_prompt_len"]))
+        mt = args.max_tokens or int(info["max_tokens"])
+        # a handful of distinct prompts, cycled (pre-serialized)
+        bodies = [
+            json.dumps({
+                "prompt": rng.randint(2, vocab, plen).tolist(),
+                "max_tokens": mt,
+                "timeout_s": args.timeout_s,
+            }).encode()
+            for _ in range(8)
+        ]
+    else:
+        sizes = [int(s) for s in args.batch_sizes.split(",") if s.strip()]
+        # pre-serialized bodies (one per batch size): the generator must
+        # not bottleneck the measurement
+        bodies = [
+            json.dumps(
+                {"inputs": synth_feed(info["feeds"], b, rng)}).encode()
+            for b in sizes
+        ]
 
     prom_before = parse_prometheus(_get(f"{args.url}/metrics").decode())
     stats = _Stats()
@@ -236,8 +306,12 @@ def main(argv=None) -> int:
                         if i >= args.requests:
                             return
                         counter[0] += 1
-                    _fire(conn, args.model, bodies[i % len(bodies)],
-                          args.precision, stats)
+                    if args.generate:
+                        _fire_generate(conn, args.model,
+                                       bodies[i % len(bodies)], stats)
+                    else:
+                        _fire(conn, args.model, bodies[i % len(bodies)],
+                              args.precision, stats)
             finally:
                 conn.close()
 
@@ -288,6 +362,28 @@ def main(argv=None) -> int:
     fill = prom_after[1].get(f"serving_{mname}_batch_fill")
     fill_before = prom_before[1].get(f"serving_{mname}_batch_fill",
                                      {"sum": 0.0, "count": 0})
+    generation = None
+    if args.generate:
+        ttft = (np.asarray(sorted(stats.ttfts_ms))
+                if stats.ttfts_ms else None)
+        generation = {
+            "prompt_len": plen,
+            "max_tokens": mt,
+            "tokens_received": stats.tokens,
+            "tokens_per_sec": (round(stats.tokens / elapsed, 2)
+                               if elapsed else 0),
+            "ttft_ms": None if ttft is None else {
+                "p50": round(float(np.percentile(ttft, 50)), 3),
+                "p99": round(float(np.percentile(ttft, 99)), 3),
+                "max": round(float(ttft[-1]), 3),
+            },
+            "server": {
+                "tokens": delta(f"serving_gen_{mname}_tokens"),
+                "decode_steps": delta(
+                    f"serving_gen_{mname}_decode_steps"),
+                "prefills": delta(f"serving_gen_{mname}_prefills"),
+            },
+        }
     artifact = {
         "tool": "loadgen",
         "url": args.url,
@@ -312,6 +408,7 @@ def main(argv=None) -> int:
         "schedule_lag_ms_p99": (
             round(float(np.percentile(stats.lag, 99)) * 1e3, 3)
             if stats.lag else None),
+        "generation": generation,
         "policy": {
             "buckets": info.get("buckets"),
             "max_batch": info.get("max_batch"),

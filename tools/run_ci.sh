@@ -15,8 +15,10 @@
 #   5. chaos kill-and-resume fault-tolerance gate
 #   6. serving smoke gate: export a model, boot the inference server,
 #      drive tools/loadgen.py — p99/batch-fill histograms on /metrics,
-#      zero recompiles across a shape-varying stream, and the dynamic-
-#      batching A/B (batched >= 2x batch-size-1 QPS)
+#      zero recompiles across a shape-varying stream, the dynamic-
+#      batching A/B (batched >= 2x batch-size-1 QPS), and the generation
+#      continuous-batching gate (late joins without retrace/stall,
+#      concurrent streams >= 2x batch-1 decode tokens/sec)
 #   7. compile-check + multichip dryrun (the driver's graft contract)
 # Usage: tools/run_ci.sh [fast]   — "fast" skips the bench smoke.
 set -euo pipefail
@@ -106,6 +108,28 @@ print("transformer A/B records OK:", [(r["config"]["fused_qkv_attention"],
                                        r["value"]) for r in recs])
 PY
   echo "-- transformer A/B record artifact: ci_artifacts/bench_transformer_smoke.json"
+  # Decode generation leg (PERF.md r10): tokens/sec at two batch sizes
+  # through the KV-cache + flash-decode path, paired with the
+  # FLAGS_kv_cache=0 full-prefix-recompute baseline record; every record
+  # must carry compile_flat=true — the executor compile cache may NOT
+  # grow across generated tokens (the length-independent-key contract)
+  python -W error::UserWarning bench.py --model decode --smoke \
+    | tee ci_artifacts/bench_decode_smoke.json
+  FLAGS_kv_cache=0 python -W error::UserWarning bench.py \
+    --model decode --smoke | tee -a ci_artifacts/bench_decode_smoke.json
+  python - <<'PY'
+import json
+recs = [json.loads(l) for l in open("ci_artifacts/bench_decode_smoke.json")
+        if l.strip().startswith("{")]
+recs = [r for r in recs if r.get("metric", "").startswith("decode")]
+flags = {r["config"]["kv_cache"] for r in recs}
+assert flags == {True, False}, f"need a cached AND a recompute record: {flags}"
+bad = [r for r in recs if not r["config"]["compile_flat"]]
+assert not bad, f"executor compile cache grew across generated tokens: {bad}"
+print("decode A/B records OK:", [(r["config"]["kv_cache"], r["metric"],
+                                  r["value"]) for r in recs])
+PY
+  echo "-- decode A/B record artifact: ci_artifacts/bench_decode_smoke.json"
   # Copy census (PERF.md r09 attribution artifact): the automated
   # while-body copy-byte attribution on the smoke transformer, fused vs
   # unfused — tests assert the projection-site collapse; CI archives the

@@ -19,6 +19,13 @@ tools/loadgen.py:
      gate takes the best pair and stops early once the target is met.
   3. artifact — every loadgen JSON + an ab_summary.json with the
      per-trial QPS table lands in --out-dir for CI archiving.
+  4. generation — the continuous token-level batching gate against a
+     `--demo-generation` server (generation_gate): staggered
+     prompt-in/tokens-out stream with the compile counter FLAT and TTFT
+     histograms served, a late-joining request that must neither retrace
+     nor stall the in-flight long generation, and the throughput A/B
+     (concurrent streams >= 2x one sequential stream's tokens/sec);
+     artifacts loadgen_gen*.json + gen_ab_summary.json.
 
 Both servers stay resident across trials (warmup is paid once) and
 requests ride keep-alive connections, so the measurement sees the
@@ -67,14 +74,15 @@ class Server:
     """One `python -m paddle_tpu.serving` subprocess on an ephemeral
     port; parses the ready line, kills the process on close()."""
 
-    def __init__(self, model_dir: str, extra_args):
+    def __init__(self, model_dir, extra_args):
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    PYTHONPATH=REPO_ROOT + os.pathsep
                    + os.environ.get("PYTHONPATH", ""))
+        model_args = ([] if model_dir is None
+                      else ["--model", f"demo={model_dir}"])
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.serving",
-             "--model", f"demo={model_dir}", "--port", "0"]
-            + list(extra_args),
+             "--port", "0"] + model_args + list(extra_args),
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
         line = self.proc.stdout.readline().decode()
         try:
@@ -105,11 +113,12 @@ class Server:
 
 
 def run_loadgen(url: str, out: str, requests: int, concurrency: int,
-                batch_sizes: str) -> dict:
+                batch_sizes: str, model: str = "demo",
+                extra=()) -> dict:
     cmd = [sys.executable, os.path.join(REPO_ROOT, "tools", "loadgen.py"),
-           "--url", url, "--model", "demo",
+           "--url", url, "--model", model,
            "--requests", str(requests), "--concurrency", str(concurrency),
-           "--batch-sizes", batch_sizes, "--out", out]
+           "--batch-sizes", batch_sizes, "--out", out] + list(extra)
     r = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
     if r.returncode != 0:
         raise RuntimeError(f"loadgen failed:\n{r.stderr[-3000:]}")
@@ -117,11 +126,147 @@ def run_loadgen(url: str, out: str, requests: int, concurrency: int,
         return json.load(f)
 
 
+def http_generate(url: str, prompt, max_tokens: int,
+                  timeout: float = 60.0) -> dict:
+    import urllib.request
+
+    body = json.dumps({"prompt": prompt,
+                       "max_tokens": max_tokens}).encode()
+    req = urllib.request.Request(
+        f"{url}/v1/models/gendemo:generate", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def generation_gate(args) -> None:
+    """Continuous token-level batching gate (PR-11 acceptance):
+
+      1. loadgen --generate smoke: staggered prompt-in/tokens-out stream
+         with the executor compile counter FLAT and TTFT p50/p99 in the
+         artifact;
+      2. late-join: a short request submitted while a long generation is
+         mid-flight must finish FIRST (no head-of-line stall) and add
+         ZERO compiles (no retrace);
+      3. throughput A/B: >= --gen-ab-target x tokens/sec from
+         concurrent streams (continuous batching fills the decode batch)
+         vs one sequential stream (batch-1 decode), interleaved trials.
+    """
+    import urllib.request
+
+    server = Server(None, ["--demo-generation", "gendemo",
+                           "--gen-slots", "4"])
+    try:
+        # -- phase 1: staggered stream, compile counter flat ------------
+        smoke = run_loadgen(
+            server.url, os.path.join(args.out_dir, "loadgen_gen.json"),
+            40, 6, "1", model="gendemo",
+            extra=["--generate", "--max-tokens", "8"])
+        assert smoke["errors"] == 0, smoke
+        gen = smoke["generation"]
+        assert gen["tokens_received"] > 0, smoke
+        assert gen["ttft_ms"] and gen["ttft_ms"]["p99"] > 0, smoke
+        assert smoke["server_metrics"][
+            "executor_compiles_during_load"] == 0, \
+            f"retrace during generation load: {smoke['server_metrics']}"
+        prom = scrape(server.url)
+        assert "serving_gen_gendemo_ttft_seconds_bucket" in prom, \
+            "ttft histogram missing from /metrics"
+        print(f"generation smoke OK: {gen['tokens_received']} tokens, "
+              f"{gen['tokens_per_sec']} tok/s, "
+              f"ttft p50={gen['ttft_ms']['p50']}ms "
+              f"p99={gen['ttft_ms']['p99']}ms, recompiles=0", flush=True)
+
+        # -- phase 2: late join must neither retrace nor stall ----------
+        c0 = _prom_scalar(scrape(server.url), "executor_compiles")
+        done = {}
+
+        def long_req():
+            done["long"] = (http_generate(server.url, [3, 5, 7], 64),
+                            time.perf_counter())
+
+        t_long = threading.Thread(target=long_req)
+        t_long.start()
+        time.sleep(0.01)  # let the long request start decoding
+        short, t_short_done = (http_generate(server.url, [9, 2], 2),
+                               time.perf_counter())
+        t_long.join(timeout=60)
+        long_rec, t_long_done = done["long"]
+        assert len(short["tokens"]) == 2, short
+        assert len(long_rec["tokens"]) == 64, long_rec
+        assert t_short_done < t_long_done, \
+            "late-joining short request stalled behind the long one"
+        assert _prom_scalar(scrape(server.url),
+                            "executor_compiles") == c0, \
+            "late join retraced"
+        print(f"late-join OK: short ttft "
+              f"{short['meta']['ttft_ms']}ms while long in flight, "
+              f"0 compiles", flush=True)
+
+        # -- phase 3: continuous batching >= target x batch-1 decode ----
+        trials, best = [], None
+        for t in range(args.ab_trials):
+            multi = run_loadgen(
+                server.url,
+                os.path.join(args.out_dir, "loadgen_gen_multi.json"),
+                16, 4, "1", model="gendemo",
+                extra=["--generate", "--max-tokens", "16"])
+            single = run_loadgen(
+                server.url,
+                os.path.join(args.out_dir, "loadgen_gen_single.json"),
+                8, 1, "1", model="gendemo",
+                extra=["--generate", "--max-tokens", "16"])
+            for rec in (multi, single):
+                assert rec["errors"] == 0, rec
+                assert rec["server_metrics"][
+                    "executor_compiles_during_load"] == 0, rec
+            tps_m = multi["generation"]["tokens_per_sec"]
+            tps_s = single["generation"]["tokens_per_sec"]
+            ratio = tps_m / max(tps_s, 1e-9)
+            trials.append({"trial": t, "multi_tok_s": tps_m,
+                           "single_tok_s": tps_s,
+                           "ratio": round(ratio, 3)})
+            print(f"gen A/B trial {t}: {tps_m} vs {tps_s} tok/s -> "
+                  f"{ratio:.2f}x", flush=True)
+            if best is None or ratio > best["ratio"]:
+                best = trials[-1]
+            if ratio >= args.gen_ab_target:
+                break
+            time.sleep(1.0)
+        summary = {
+            "tool": "serving_smoke.generation",
+            "slots": 4,
+            "target_ratio": args.gen_ab_target,
+            "trials": trials,
+            "best": best,
+            "passed": best["ratio"] >= args.gen_ab_target,
+        }
+        with open(os.path.join(args.out_dir,
+                               "gen_ab_summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        if not summary["passed"]:
+            raise AssertionError(
+                f"generation A/B gate FAILED: best "
+                f"{best['ratio']}x < {args.gen_ab_target}x")
+        print(f"generation A/B gate OK: continuous batching "
+              f"{best['ratio']}x over batch-1 decode", flush=True)
+    finally:
+        server.close()
+
+
 def scrape(url: str) -> str:
     import urllib.request
 
     with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
         return r.read().decode()
+
+
+def _prom_scalar(text: str, name: str) -> float:
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 2 and parts[0] == name:
+            return float(parts[1])
+    return 0.0
 
 
 def main(argv=None) -> int:
@@ -139,6 +284,12 @@ def main(argv=None) -> int:
                         "target; the budget is sized for noisy shared "
                         "CI boxes where absolute QPS swings ~2x between "
                         "trials — a clean pair usually lands by trial 2)")
+    p.add_argument("--gen-ab-target", type=float, default=2.0,
+                   help="required concurrent/sequential tokens-per-sec "
+                        "ratio for the continuous-batching generation "
+                        "gate")
+    p.add_argument("--skip-generation", action="store_true",
+                   help="skip the generation continuous-batching gate")
     args = p.parse_args(argv)
 
     os.makedirs(args.out_dir, exist_ok=True)
@@ -222,10 +373,14 @@ def main(argv=None) -> int:
             return 1
         print(f"serving A/B gate OK: dynamic batching {best['ratio']}x "
               f"over batch-size-1 at zero recompiles", flush=True)
-        return 0
     finally:
         batched.close()
         batch1.close()
+
+    # -- phase 4: continuous token-level batching (generation tier) ------
+    if not args.skip_generation:
+        generation_gate(args)
+    return 0
 
 
 if __name__ == "__main__":
