@@ -956,12 +956,135 @@ def run_transformer(args, peak):
                      "spread": round(spread, 1)}, loss_first=loss0)
 
 
+def run_pipeline(args, peak):
+    """`--model transformer --pp N`: the pipeline-parallel training leg
+    (parallel/pipeline).  Runs pp-stage GPipe AND 1F1B micro-batch
+    schedules against single-program run_accumulated from identical
+    init, asserts the LOSS TRAJECTORIES ARE BIT-IDENTICAL (dropout on —
+    the subsystem's core numeric contract), and reports tokens/sec for
+    each variant; config carries pp/schedule/micro_batches/bit_parity +
+    the schedule's analytic bubble fraction.  run_ci.sh archives the
+    three paired records as ci_artifacts/bench_pipeline_smoke.json."""
+    import paddle_tpu as pt
+    from paddle_tpu.core import framework as fw
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.parallel.pipeline import (
+        PipelineProgram, bubble_fraction, split_program)
+
+    pp = args.pp
+    tiny = args.smoke
+    cfg = dict(n_layer=max(2, pp), n_head=4, d_key=16, d_value=16,
+               d_model=64, d_inner_hid=128, vocab=256,
+               seq=32) if tiny else dict(
+        n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+        d_inner_hid=2048, vocab=2048, seq=32)
+    k = args.scan_steps or 4                       # micro-batches
+    mbs = args.batch_size or 2                     # micro-batch size
+    steps = args.calls or 2
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup), fw.guard_unique_name():
+        avg_cost, _, feeds = T.transformer(
+            src_vocab_size=cfg["vocab"], trg_vocab_size=cfg["vocab"],
+            max_length=cfg["seq"], n_layer=cfg["n_layer"],
+            n_head=cfg["n_head"], d_key=cfg["d_key"],
+            d_value=cfg["d_value"], d_model=cfg["d_model"],
+            d_inner_hid=cfg["d_inner_hid"], dropout_rate=0.1,
+            src_seq_len=cfg["seq"], trg_seq_len=cfg["seq"],
+            use_flash=False)
+        pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    loss = avg_cost.name
+    stages = split_program(prog, feeds, n_stages=pp)
+    pnames = [p.name for p in prog.all_parameters()]
+
+    batches = [T.make_batch(mbs, cfg["seq"], cfg["seq"], cfg["n_head"],
+                            cfg["vocab"], cfg["vocab"],
+                            rng=np.random.RandomState(s))
+               for s in range(k)]
+    feed = {n: np.stack([b[n] for b in batches]) for n in batches[0]}
+    toks_per_step = k * mbs * cfg["seq"]
+
+    def run_variant(runner_for):
+        """Fresh scope from the shared init; returns (traj, tokens/sec,
+        final param snapshot)."""
+        scope = pt.Scope()
+        exe = pt.Executor()
+        with pt.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            for n, v in run_variant.init.items():
+                scope.set_var(n, v)
+            step = runner_for(exe, scope)
+            traj = [np.asarray(step())]          # warmup incl. compile
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                traj.append(np.asarray(step()))
+            dt = time.perf_counter() - t0
+            params = {n: np.asarray(scope.find_var(n)) for n in pnames}
+        return traj, steps * toks_per_step / dt, params
+
+    scope0 = pt.Scope()
+    exe0 = pt.Executor()
+    with pt.scope_guard(scope0):
+        exe0.run(startup, scope=scope0)
+        run_variant.init = {n: np.asarray(scope0.find_var(n)).copy()
+                            for n in pnames}
+
+    traj_single, tps_single, params_single = run_variant(
+        lambda exe, scope: lambda: exe.run_accumulated(
+            prog, feed=feed, fetch_list=[loss], scope=scope)[0])
+    variants = {"single": (traj_single, tps_single, None, 0.0)}
+    # ONE PipelineProgram: compiled stage entries are schedule-
+    # independent, so GPipe and 1F1B share them
+    pipe = PipelineProgram(prog, feeds, schedule="gpipe", stages=stages)
+    for sched in ("gpipe", "1f1b"):
+        pipe.schedule = sched
+        traj, tps, params = run_variant(
+            lambda exe, scope: lambda: exe.run(
+                pipe, feed=feed, fetch_list=[loss], scope=scope)[0])
+        # the pipeline parity CONTRACT (PERF.md r11): training STATE
+        # bit-identical; fetched loss to the ulp (a reduce feeding only
+        # a fetched scalar may round differently across separately
+        # compiled modules — params never drift)
+        state_parity = all(
+            np.array_equal(params_single[n], params[n]) for n in pnames)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            rel = max(
+                float(np.nanmax(np.abs(a - b) / np.maximum(
+                    np.abs(a), 1e-30)))
+                for a, b in zip(traj_single, traj))
+        variants[sched] = (traj, tps, state_parity, rel)
+
+    for name, (traj, tps, parity, rel) in variants.items():
+        emit_metric(
+            f"transformer_pp{pp}_{name}_tokens_per_sec", tps,
+            "tokens/sec", None, None, float(np.asarray(traj[-1]).mean()),
+            {"pp": pp, "schedule": name, "micro_batches": k,
+             "micro_batch_size": mbs, "seq_len": cfg["seq"],
+             "tiny": tiny, "dropout": 0.1,
+             "state_bit_parity": parity,
+             "loss_max_rel_diff": rel,
+             "bubble_fraction": (round(bubble_fraction(pp, k, name), 4)
+                                 if name != "single" else 0.0)})
+    bad = [n for n, (_, _, p, rel) in variants.items()
+           if p is False or (rel is not None and rel > 3e-7)]
+    if bad:
+        raise AssertionError(
+            f"pipeline schedules {bad} lost parity vs single-program "
+            f"run_accumulated (state must be bit-identical, losses "
+            f"within 1 ulp)")
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="all",
                    choices=["all", "resnet50", "transformer", "bert",
                             "deepfm", "mnist", "ringattn", "convbn",
                             "decode"])
+    p.add_argument("--pp", type=int, default=0,
+                   help="with --model transformer: run the pp-stage "
+                        "pipeline-parallel leg (GPipe + 1F1B vs single-"
+                        "program run_accumulated, loss bit-parity "
+                        "asserted) instead of the dense bench")
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for a fast correctness pass")
     p.add_argument("--no-amp", dest="amp", action="store_false")
@@ -1029,7 +1152,11 @@ def main():
         ran.append(run_guarded("ringattn", run_ringattn, args, peak))
     if args.model in ("all", "bert"):
         ran.append(run_guarded("bert", run_bert, args, peak))
-    if args.model in ("all", "transformer"):
+    if args.model == "transformer" and args.pp:
+        # pipeline-parallel leg (PERF.md r11): explicit-only, like
+        # convbn/decode — python bench.py --model transformer --pp 2
+        ran.append(run_guarded("pipeline", run_pipeline, args, peak))
+    elif args.model in ("all", "transformer"):
         ran.append(run_guarded("transformer", run_transformer, args, peak))
     if args.model in ("all", "resnet50"):
         ok = run_guarded("resnet50", run_resnet50, args, peak)

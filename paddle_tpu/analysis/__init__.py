@@ -30,6 +30,7 @@ from .verifier import (  # noqa: F401
     ProgramVerifyError,
     verify_or_raise,
     verify_program,
+    verify_program_set,
 )
 from .kernel_lint import lint_kernel_plans  # noqa: F401
 
@@ -38,5 +39,6 @@ __all__ = [
     "ProgramVerifyError",
     "verify_program",
     "verify_or_raise",
+    "verify_program_set",
     "lint_kernel_plans",
 ]

@@ -404,6 +404,14 @@ _ATTENTION_MATRIX = [
     # if the gate ever re-accepts this, the kv-tile check fires
     dict(label="transformer-big-f32-bthd", b=2, h=16, t=256, d=64,
          dtype="float32", fmt="bthd", must_accept=False),
+    # pipeline micro-batch shapes (parallel/pipeline): transformer-base
+    # under pp splits runs the SAME flash kernels per stage on the
+    # micro-batch slice — batch 32 / K=16 -> b=2, K=8 -> b=4 (covered by
+    # transformer-base-* above); the b=2 leg pins the smallest slice
+    dict(label="pp-microbatch-b2-bthd", b=2, h=8, t=256, d=64,
+         dtype="float32", fmt="bthd"),
+    dict(label="pp-microbatch-b2-bf16", b=2, h=8, t=256, d=64,
+         dtype="bfloat16", fmt="bhtd"),
 ]
 
 _QKV_MATRIX = [
@@ -453,6 +461,10 @@ _RING_MATRIX = [
          dtype="float32", fmt="bthd"),
     # transformer CP over sp=2 (the dryrun_multichip shape)
     dict(label="cp2-transformer-chunk-bthd", b=4, h=8, t=128, d=64,
+         dtype="float32", fmt="bthd"),
+    # CP chunk under a pp split's micro-batching (pp x cp composition:
+    # the per-rank ring chunk sees the micro-batch slice)
+    dict(label="pp2-cp2-microbatch-chunk-bthd", b=2, h=8, t=128, d=64,
          dtype="float32", fmt="bthd"),
 ]
 

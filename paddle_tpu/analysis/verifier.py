@@ -33,6 +33,18 @@ Checks (Finding.check ids):
                        class behind the PR-6 stateful-AOT corruption
     double-write       a persistable/scope var written by 2+ stateful ops
                        in one block (write-back order becomes load-bearing)
+
+Multi-program families (verify_program_set — the pipeline tier's
+per-stage sub-programs) add cross-stage checks:
+    stage-undefined-input    (error)  a stage input no earlier stage
+                             (activations) / later stage (grads)
+                             declares as an output
+    stage-io-mismatch        (error)  producer/consumer disagree on a
+                             boundary var's shape or dtype
+    stage-foreign-optimizer  (error)  an Optimize-role op on a stage
+                             that does not own its Param
+    stage-unconsumed-output  (warning) declared boundary output nobody
+                             consumes (dead wire traffic)
 """
 
 from __future__ import annotations
@@ -489,6 +501,112 @@ def verify_program(
 
     findings.sort(key=lambda f: (f.severity != "error", f.check))
     return findings
+
+
+def verify_program_set(stages: Sequence[dict]) -> List[Finding]:
+    """Cross-stage checks over a multi-program family (the pipeline
+    tier's per-stage sub-programs; PR-6 multi-model serving is the other
+    consumer of multi-program scheduling).  Each entry is a
+    PipelineStage.io_summary()-shaped dict:
+
+        {index, fwd_inputs/fwd_outputs/bwd_inputs/bwd_outputs:
+         [(name, shape, dtype)], owned_params: [names], program}
+
+    Checks (error severity — the pipeline trainer's pre-compile gate):
+      stage-undefined-input   a declared stage input no earlier stage
+                              (fwd) / later stage (bwd grads) declares as
+                              an output — the cross-program
+                              def-before-use class
+      stage-io-mismatch       producer and consumer declare different
+                              shapes/dtypes for the same boundary var
+      stage-foreign-optimizer an Optimize-role op landed on a stage that
+                              does not own its Param — its grads/moments
+                              would never meet
+    Warning severity:
+      stage-unconsumed-output a declared boundary output no other stage
+                              consumes (dead wire traffic)
+    """
+    findings: List[Finding] = []
+    cap = _Capped(findings)
+    n = len(stages)
+    by_idx = sorted(stages, key=lambda s: s["index"])
+
+    def _sigs(stage, key):
+        return {name: (tuple(shape), dtype)
+                for name, shape, dtype in stage.get(key, ())}
+
+    fwd_outs = [_sigs(s, "fwd_outputs") for s in by_idx]
+    bwd_outs = [_sigs(s, "bwd_outputs") for s in by_idx]
+    consumed: set = set()
+    for i, stage in enumerate(by_idx):
+        for name, shape, dtype in stage.get("fwd_inputs", ()):
+            consumed.add(("fwd", name))
+            hits = [(j, fwd_outs[j][name]) for j in range(i)
+                    if name in fwd_outs[j]]
+            if not hits:
+                cap.add(Finding(
+                    "stage-undefined-input", "error",
+                    f"stage {stage['index']} consumes activation "
+                    f"{name!r} that no earlier stage declares as a "
+                    f"forward output", var=name))
+                continue
+            _check_sig_match(cap, stage["index"], name,
+                             (tuple(shape), dtype), hits)
+        for name, shape, dtype in stage.get("bwd_inputs", ()):
+            consumed.add(("bwd", name))
+            hits = [(j, bwd_outs[j][name]) for j in range(i + 1, n)
+                    if name in bwd_outs[j]]
+            if not hits:
+                cap.add(Finding(
+                    "stage-undefined-input", "error",
+                    f"stage {stage['index']} consumes boundary grad "
+                    f"{name!r} that no later stage declares as a "
+                    f"backward output", var=name))
+                continue
+            _check_sig_match(cap, stage["index"], name,
+                             (tuple(shape), dtype), hits)
+        owned = set(stage.get("owned_params", ()))
+        prog = stage.get("program")
+        if prog is not None:
+            for op in prog.global_block().ops:
+                role = int(op.attrs.get(fw.OpRole.ROLE_ATTR_NAME, 0))
+                if not role & fw.OpRole.Optimize:
+                    continue
+                for p in op.inputs.get("Param", []):
+                    if p and p not in owned:
+                        cap.add(Finding(
+                            "stage-foreign-optimizer", "error",
+                            f"Optimize-role op {op.type!r} on stage "
+                            f"{stage['index']} updates param {p!r} owned "
+                            f"by another stage — its grads/moments would "
+                            f"never meet", op_type=op.type, var=p))
+    for i, stage in enumerate(by_idx):
+        for kind, outs in (("fwd", stage.get("fwd_outputs", ())),
+                           ("bwd", stage.get("bwd_outputs", ()))):
+            for name, _, _ in outs:
+                if (kind, name) not in consumed:
+                    cap.add(Finding(
+                        "stage-unconsumed-output", "warning",
+                        f"stage {stage['index']} declares {kind} boundary "
+                        f"output {name!r} that no other stage consumes",
+                        var=name))
+    findings.sort(key=lambda f: (f.severity != "error", f.check))
+    return findings
+
+
+def _check_sig_match(cap, idx, name, want, hits):
+    for j, got in hits:
+        if want[0] and got[0] and tuple(want[0]) != tuple(got[0]):
+            cap.add(Finding(
+                "stage-io-mismatch", "error",
+                f"boundary var {name!r}: stage {idx} expects shape "
+                f"{tuple(want[0])} but stage {j} produces "
+                f"{tuple(got[0])}", var=name))
+        elif want[1] != got[1]:
+            cap.add(Finding(
+                "stage-io-mismatch", "error",
+                f"boundary var {name!r}: stage {idx} expects dtype "
+                f"{want[1]} but stage {j} produces {got[1]}", var=name))
 
 
 def verify_or_raise(program, feed_names=(), fetch_names=(), scope=None,

@@ -253,6 +253,22 @@ def trace_block(block: fw.Block, env: Dict[str, Any], tctx: TraceContext,
             for name, val in zip(names, vals):
                 if name and val is not None:
                     env[name] = val
+        bvars = op.attrs.get("pipeline_boundary_vars")
+        if bvars and getattr(tctx, "boundary_barriers", True):
+            # Pipeline-annotated programs (parallel/pipeline/partition.py
+            # split_program): values that cross a stage cut get an
+            # optimization barrier at their producer, so XLA associates
+            # the reductions CONSUMING them identically whether the value
+            # is in-program (single-program run_accumulated) or a stage
+            # boundary input (the pipeline schedules) — the
+            # association-normalization behind the bit-parity contract.
+            # Unannotated programs pay one dict miss per op, trace-time
+            # only.
+            import jax as _jax
+
+            for n in bvars:
+                if env.get(n) is not None:
+                    env[n] = _jax.lax.optimization_barrier(env[n])
         if tctx.check_nan_inf and outs:
             flag = _all_finite_flag(outs)
             if flag is not None:
@@ -899,11 +915,21 @@ class Executor:
         scope: Optional[Scope] = None,
         accumulate_steps: Optional[int] = None,
         return_numpy: bool = True,
+        unroll: bool = False,
     ):
         """Gradient accumulation in ONE compiled XLA call: run the
         forward+backward prefix over K micro-batches (feed arrays carry a
         leading [K, micro_bs, ...] axis) summing every parameter gradient,
         then run the Optimize-role op suffix ONCE on the averaged grads.
+
+        unroll=True traces every micro-batch straight-line instead of
+        scanning 1..K-1 — the literal shape of the reference pass (clone
+        fwd/bwd K times).  Math is identical; compile time grows ~K-fold;
+        the pipeline tier's strict bit-parity gates compare against this
+        form because XLA may tile a reduce inside a scan body differently
+        from the same reduce compiled straight-line (a fetched loss
+        scalar can re-round by 1 ulp between the two — parameter updates
+        are bit-identical either way, probed in tests/test_pipeline.py).
 
         The capability of the reference's multi_batch_merge_pass
         (ir/multi_batch_merge_pass.h:25 — clone fwd/bwd N times, average,
@@ -911,6 +937,13 @@ class Executor:
         Gradient clipping/regularization ops carry the Backward role, so
         they apply per micro-batch (matching the reference pass, which
         clones everything before the optimizer).
+
+        Fetch contract: targets produced by the fwd/bwd prefix (or
+        feeds/state) come back stacked along a leading [K] axis, one
+        slice per micro-batch; targets produced by the Optimize suffix
+        (updated params, lr values) come back UN-stacked — the
+        post-update value.  A name neither side produces raises KeyError
+        at compile, naming both sets.
         """
         import jax
         import jax.numpy as jnp
@@ -936,7 +969,7 @@ class Executor:
         k = accumulate_steps
 
         key = (
-            "run_accumulated",
+            "run_accumulated" + ("_unrolled" if unroll else ""),
             program.fingerprint(),
             bool(getattr(program, "_amp_bf16", False)),
             bool(self.check_nan_inf),
@@ -956,7 +989,8 @@ class Executor:
         if entry is None:
             try:
                 entry = self._compile_accumulated(
-                    program, feed_names, fetch_names, scope, k
+                    program, feed_names, fetch_names, scope, k,
+                    unroll=unroll,
                 )
             except Exception:
                 self._count_error(mon)
@@ -994,7 +1028,7 @@ class Executor:
                                       return_numpy)
 
     def _compile_accumulated(self, program, feed_names, fetch_names, scope,
-                             k):
+                             k, unroll=False):
         import jax
         import jax.numpy as jnp
 
@@ -1028,6 +1062,31 @@ class Executor:
         check = self.check_nan_inf
         nan_check_ops: List[str] = []
 
+        # Fetch split: prefix targets are stashed per micro-batch and
+        # returned stacked [K, ...]; Optimize-suffix targets (updated
+        # params, lr) return their single post-suffix value — the
+        # fetch-from-prefix-only restriction is gone (the pipeline
+        # scheduler and plain users both fetch suffix products).
+        prefix_avail = set(feed_names) | set(rw_state) | set(ro_state)
+        for op in prefix_ops:
+            prefix_avail.update(n for n in op.output_arg_names() if n)
+        suffix_outputs = {
+            n for op in suffix_ops for n in op.output_arg_names() if n
+        }
+        # suffix takes precedence for names it PRODUCES: fetching an
+        # updated param/moment/lr returns the single post-update value
+        # (matching PipelineProgram's opt-fetch classification); names
+        # only the prefix covers come back stacked per micro-batch
+        prefix_fetch = [n for n in fetch_names
+                        if n in prefix_avail and n not in suffix_outputs]
+        suffix_fetch = [n for n in fetch_names if n in suffix_outputs]
+        unknown = [n for n in fetch_names
+                   if n not in prefix_avail and n not in suffix_outputs]
+        if unknown:
+            raise KeyError(
+                f"fetch target(s) {unknown} produced by neither the "
+                f"fwd/bwd prefix nor the Optimize suffix of this program")
+
         def acc_fn(feed_vals, rw_vals, ro_vals, base_key):
             rw0 = list(rw_vals)
 
@@ -1043,15 +1102,16 @@ class Executor:
                 env.update(zip(ro_state, ro_vals))
                 trace_block(block, env, tctx, ops=prefix_ops)
                 new_rw = [env.get(n, v) for n, v in zip(rw_state, rw)]
-                fetches = []
-                for n in fetch_names:
-                    if n not in env:
-                        raise KeyError(
-                            f"fetch target {n!r} not produced by the "
-                            "fwd/bwd prefix (run_accumulated cannot fetch "
-                            "Optimize-role outputs)"
-                        )
-                    fetches.append(env[n])
+                # fetch values are association-isolated (barrier): the
+                # reduce producing a fetched loss must not fuse with its
+                # scan-body packaging, or the same value compiled in a
+                # pipeline stage's straight-line program can differ by an
+                # ulp — the bit-parity contract of parallel/pipeline
+                # (value-dependent, surfaced under a multi-device-touched
+                # compiler state).  Fetch-only: env values downstream ops
+                # read stay unbarriered.
+                fetches = [jax.lax.optimization_barrier(env[n])
+                           for n in prefix_fetch]
                 wo = [env.get(n) for n in wo_state]
                 flags = (
                     jnp.stack([f for _, f in tctx.nan_checks])
@@ -1078,7 +1138,26 @@ class Executor:
             nan_check_ops.clear()
             nan_check_ops.extend(d for d, _ in tctx0.nan_checks)
 
-            if k > 1:
+            if k > 1 and unroll:
+                # straight-line micro-batches (the reference
+                # multi_batch_merge_pass shape): identical math to the
+                # scan, fusion context identical to step 0's inline trace
+                rw_u, sums_u = rw1, sums0
+                fetch_steps = [fetches0]
+                wo_last = wo0
+                flag_steps = [flags0]
+                for i in range(1, k):
+                    (rw_u, sums_u), (f_i, wo_i, fl_i) = body(
+                        (rw_u, sums_u), (jnp.asarray(i),
+                                         [v[i] for v in feed_vals]))
+                    fetch_steps.append(f_i)
+                    wo_last = [(wi if wi is not None else wl)
+                               for wl, wi in zip(wo_last, wo_i)]
+                    flag_steps.append(fl_i)
+                rw_f, sums_f = rw_u, sums_u
+                fetches = [jnp.stack(fs) for fs in zip(*fetch_steps)]
+                all_flags = jnp.stack(flag_steps)
+            elif k > 1:
                 xs = (jnp.arange(1, k),
                       [v[1:] for v in feed_vals])
                 (rw_f, sums_f), (rest, wo_rest, flag_rest) = jax.lax.scan(
@@ -1123,7 +1202,12 @@ class Executor:
                 if n in envf and envf[n] is not None:
                     by_name[n] = envf[n]
             new_state = [by_name.get(n) for n in state_writes]
-            return fetches, new_state, (all_flags, suf_flags)
+            # reassemble fetches in caller order: prefix targets stacked
+            # [K, ...], suffix targets as their single post-update value
+            fetch_by_name = dict(zip(prefix_fetch, fetches))
+            fetch_by_name.update((n, envf[n]) for n in suffix_fetch)
+            out_fetches = [fetch_by_name[n] for n in fetch_names]
+            return out_fetches, new_state, (all_flags, suf_flags)
 
         jitted = jax.jit(acc_fn, donate_argnums=(1,))
         return _CompiledEntry(

@@ -10,7 +10,13 @@ so identity is the correct degenerate form).
 from __future__ import annotations
 
 
-def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False,
+              auto=None):
+    """auto: optional frozenset of mesh axis names left to GSPMD while
+    the remaining axes are manual (the pipeline tier shard_maps over its
+    `pipe` axis only, composing with dp/tp GSPMD sharding inside).  Old
+    jaxlib builds without partial-manual support raise a NAMED error
+    rather than silently running fully manual."""
     import inspect
 
     import jax
@@ -19,12 +25,19 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
         fn = jax.shard_map
     else:
         from jax.experimental.shard_map import shard_map as fn
+    params = inspect.signature(fn).parameters
     # the check param was renamed check_rep -> check_vma independently of
     # the experimental->top-level promotion; probe the actual signature
-    if "check_vma" in inspect.signature(fn).parameters:
+    if "check_vma" in params:
         kw = {"check_vma": check_vma}
     else:
         kw = {"check_rep": check_vma}
+    if auto:
+        if "auto" not in params:
+            raise NotImplementedError(
+                "this jax's shard_map has no `auto` parameter (partial "
+                "manual mode); the pipeline mesh path needs it")
+        kw["auto"] = frozenset(auto)
     return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
 
 

@@ -1,0 +1,48 @@
+"""Pipeline parallelism: stage-split programs + micro-batch schedules.
+
+The reference's dist transpiler splits ONE ProgramDesc into per-role
+sub-programs (trainer/pserver); this package is the TPU-first analogue
+for INTER-LAYER pipelining (GPipe, Huang et al. 2019; PipeDream's 1F1B):
+
+  * partition.py — cut a trained Program (fwd+bwd+optimize) at
+    user-annotated or auto-balanced boundaries into per-stage
+    sub-programs with explicit activation/grad boundary vars; optimizer
+    ops stay local to the stage owning the param.  The N-segment
+    generalization of Executor.run_accumulated's prefix/suffix split.
+  * schedule.py — per-tick GPipe / 1F1B event tables shared by the host
+    scheduler and the mesh runner; dependency-validated.
+  * trainer.py — PipelineProgram: drives the per-stage compiled entries
+    through the executor (exe.run delegation, like ShardedProgram) with
+    activation stashing and loss/grad accumulation IDENTICAL to
+    run_accumulated (bit-parity asserted in tests/test_pipeline.py).
+  * mesh.py — PipelineMeshProgram: the same schedule as ONE compiled
+    collective program over a `pipe` mesh axis (shard_map + ppermute
+    boundary transfers), composing with the dp/tp sharding rules of
+    parallel/sharding.py.
+"""
+
+from .partition import (  # noqa: F401
+    PipelineStage,
+    PipelineStages,
+    split_program,
+)
+from .schedule import (  # noqa: F401
+    schedule_table,
+    validate_schedule,
+    bubble_fraction,
+    SCHEDULES,
+)
+from .trainer import PipelineProgram  # noqa: F401
+from .mesh import PipelineMeshProgram  # noqa: F401
+
+__all__ = [
+    "PipelineStage",
+    "PipelineStages",
+    "split_program",
+    "schedule_table",
+    "validate_schedule",
+    "bubble_fraction",
+    "SCHEDULES",
+    "PipelineProgram",
+    "PipelineMeshProgram",
+]

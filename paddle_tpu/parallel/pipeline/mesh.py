@@ -1,0 +1,554 @@
+"""PipelineMeshProgram: the pipeline schedule as ONE compiled collective
+program over a `pipe` mesh axis.
+
+Where trainer.py time-multiplexes per-stage executables on the host,
+this runner lowers the SAME tick table (schedule.py) into a single
+jitted step over a dp x tp x pp jax.sharding.Mesh:
+
+  * shard_map over the `pipe` axis only — the data/model axes stay AUTO,
+    so the existing GSPMD dp/tp sharding rules (parallel/sharding.py
+    ShardingPlan param/feed specs) compose unchanged inside each stage;
+  * per-tick boundary transfers are neighbor hops of a fixed-width
+    packed f32 wire (crossing-set layouts from partition.py; pass-through
+    vars ride hop-by-hop, so a stage-0 activation consumed at stage 3
+    crosses every cut between) — realized as a psum of a one-hot [S, W]
+    scatter because this jaxlib's partial-auto partitioner hard-rejects
+    lax.ppermute (and typed PRNG keys, and lax.axis_index) inside a
+    manual-pipe subgroup;
+  * the backward recomputes each stage's forward from the stashed wire
+    input under jax.vjp (rematerialization — the standard pipeline
+    memory trade; rng_id-keyed dropout regenerates bit-identical masks),
+    seeding the TRUE loss var's cotangent with 1.0 on its owning rank
+    (mirroring the IR's Backward|Loss fill_constant) and pulling the
+    cotangent wire back rank-by-rank; grads psum over `pipe` and the
+    UNSPLIT optimizer suffix runs once in plain GSPMD land, so parameter
+    updates land identically on every rank.
+
+Every rank's compiled program carries all stage branches (lax.switch on
+the pipe rank) and both phase switches execute per tick with invalid
+slots masked — demonstration-grade SPMD for the dryrun matrix, honest
+about the ~2x trace-size cost; production-scale pipelining over separate
+processes rides trainer.py's per-stage entries.
+
+Backend status: green at dp2 x tp2 x pp2 on dense towers (CPU mesh,
+tier-1 + dryrun).  jaxlib 0.4.37's CPU partial-auto SPMD partitioner
+does NOT terminate compiling transformer-class stage traces (scanned or
+unrolled) — retry on the driver's TPU runtime before trusting that
+negative (PERF.md round 11, risk a); the sharded host scheduler
+(PipelineProgram plan=) covers transformer dp x tp x pp meanwhile.
+
+Contract (named errors at compile): forward stages free of rw scope
+state (BatchNorm running stats), boundary vars float32, fetches scalar,
+and the optimizer consumes RAW `<param>@GRAD` grads — gradient-clip /
+regularization ops are Backward-role program ops the vjp recompute does
+not replay.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...core import executor as exec_mod
+from ...core import framework as fw
+from ...core.executor import prng_key as _prng_key
+from . import schedule as sched_mod
+from .partition import PipelineStages, split_program
+from .trainer import _phase_state
+
+
+def _tables(ticks, n_stages):
+    """Tick table -> (fwd_tbl, bwd_tbl) int32 [T, S]; -1 = idle slot."""
+    T = len(ticks)
+    fwd = -np.ones((T, n_stages), np.int32)
+    bwd = -np.ones((T, n_stages), np.int32)
+    for t, tick in enumerate(ticks):
+        for s, phase, m in tick:
+            (fwd if phase == "fwd" else bwd)[t, s] = m
+    return fwd, bwd
+
+
+def _find_loss_name(program: fw.Program) -> str:
+    """The var whose gradient the IR backward seeds with 1.0 (the
+    Backward|Loss fill_constant append_backward emits)."""
+    mask = fw.OpRole.Backward | fw.OpRole.Loss
+    for op in program.global_block().ops:
+        role = int(op.attrs.get(fw.OpRole.ROLE_ATTR_NAME, 0))
+        if op.type == "fill_constant" and (role & mask) == mask:
+            for n in op.output_arg_names():
+                if n.endswith("@GRAD"):
+                    return n[:-len("@GRAD")]
+    raise ValueError(
+        "PipelineMeshProgram: program has no Backward|Loss grad seed "
+        "(call optimizer.minimize / append_backward first)")
+
+
+class _ScopeView:
+    """Minimal scope shim over a name->value dict (shape-inference time)."""
+
+    def __init__(self, env):
+        self._env = env
+
+    def find_var(self, name):
+        return self._env.get(name)
+
+    def has_var(self, name):
+        return name in self._env
+
+
+class PipelineMeshProgram:
+    def __init__(
+        self,
+        program: fw.Program,
+        feed_names: Sequence[str],
+        plan,
+        cut_vars: Optional[Sequence[str]] = None,
+        schedule: str = "gpipe",
+        pipe_axis: str = "pipe",
+        stages: Optional[PipelineStages] = None,
+        unroll_ticks: bool = True,
+    ):
+        if pipe_axis not in plan.mesh_axes:
+            raise ValueError(
+                f"ShardingPlan has no {pipe_axis!r} mesh axis "
+                f"(axes: {list(plan.mesh_axes)})")
+        self.plan = plan
+        self.pipe_axis = pipe_axis
+        self.schedule = schedule
+        n_stages = int(plan.mesh_axes[pipe_axis])
+        self.stages = stages if stages is not None else split_program(
+            program, feed_names, n_stages=n_stages, cut_vars=cut_vars)
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.loss_name = _find_loss_name(program)
+        # unroll the tick loop instead of lax.scan: scanning the tick
+        # body (switch over stage branches inside a manual-pipe subgroup
+        # with auto dp/tp axes) sends this jaxlib's SPMD partitioner into
+        # a non-terminating compile on non-trivial models; the unrolled
+        # module is T times larger but partitions in seconds
+        self.unroll_ticks = unroll_ticks
+        self._mesh = None
+        self._cache: Dict[Any, Any] = {}
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = self.plan.build_mesh()
+        return self._mesh
+
+    # -- static contract checks -------------------------------------------
+    def _check_contract(self, scope, fetch_names):
+        if getattr(self.program, "_amp_bf16", False):
+            # declared IR dtypes stay float32 under amp but the traced
+            # boundary activations are bf16 — the f32 wire check below
+            # cannot see that, so name the rejection here
+            raise NotImplementedError(
+                "pipeline mesh path: amp (_amp_bf16) programs trace bf16 "
+                "boundary activations; the packed wire is float32-only — "
+                "use the host scheduler (PipelineProgram)")
+        for c, layout in enumerate(self.stages.crossing):
+            for name, _, dtype in layout:
+                if dtype != "float32":
+                    raise NotImplementedError(
+                        f"pipeline mesh path: boundary var {name!r} at cut "
+                        f"{c} has dtype {dtype}; the packed ppermute wire "
+                        f"is float32-only")
+        producible = set()
+        for st in self.stages:
+            producible |= st.fetch_candidates
+            _, writes = _phase_state(
+                st.fwd_ops(), scope,
+                st.feeds + [n for n, _, _ in st.fwd_inputs])
+            if writes:
+                raise NotImplementedError(
+                    f"pipeline mesh path: stage {st.index} forward writes "
+                    f"scope state {writes[:4]} (e.g. BatchNorm running "
+                    f"stats) — use the host scheduler (PipelineProgram)")
+            for op in st.opt_ops():
+                pnames = op.inputs.get("Param", [])
+                for p, g in zip(pnames, op.inputs.get("Grad", [])):
+                    if p and g and g != fw.grad_var_name(p):
+                        raise NotImplementedError(
+                            f"pipeline mesh path: optimizer op {op.type!r} "
+                            f"reads transformed grad {g!r} for {p!r} "
+                            f"(gradient clip/regularization ops are not "
+                            f"replayed by the vjp recompute)")
+        missing = [n for n in fetch_names if n not in producible]
+        if missing:
+            raise KeyError(
+                f"PipelineMeshProgram: fetch target(s) {missing} produced "
+                f"by no stage forward (mesh fetches are scalar forward "
+                f"values — loss terms)")
+
+    # -- compile ----------------------------------------------------------
+    def _infer_shapes(self, feed_stack, state_env):
+        """Concrete shapes for every boundary var via a chained
+        jax.eval_shape of the stage forwards on one micro-batch — the
+        declared IR shapes carry -1 batch dims, so wire widths must come
+        from the live feed signature."""
+        import jax
+
+        shapes: Dict[str, Any] = {}
+        for n, v in feed_stack.items():
+            shapes[n] = jax.ShapeDtypeStruct(tuple(v.shape[1:]), v.dtype)
+        for n, v in state_env.items():
+            shapes[n] = jax.ShapeDtypeStruct(
+                tuple(v.shape), np.asarray(v).dtype)
+        key_aval = jax.eval_shape(lambda: _prng_key(0))
+        for st in self.stages:
+            names_in = [n for n, _, _ in st.fwd_inputs]
+            names_out = [n for n, _, _ in st.fwd_outputs]
+            reads = _phase_state(st.fwd_ops(), _ScopeView(state_env),
+                                 st.feeds + names_in)[0]
+
+            def one(feeds, ins, states, key, st=st, names_in=names_in,
+                    names_out=names_out, reads=reads):
+                tctx = exec_mod.TraceContext(
+                    st.program, key,
+                    is_test=getattr(st.program, "_is_test", False))
+                env = dict(zip(st.feeds, feeds))
+                env.update(zip(names_in, ins))
+                env.update(zip(reads, states))
+                exec_mod.trace_block(st.program.global_block(), env, tctx,
+                                     ops=st.fwd_ops())
+                return [env[n] for n in names_out]
+
+            outs = jax.eval_shape(
+                one, [shapes[n] for n in st.feeds],
+                [shapes[n] for n in names_in],
+                [shapes[n] for n in reads], key_aval)
+            for n, o in zip(names_out, outs):
+                shapes[n] = o
+        layouts = []
+        for layout in self.stages.crossing:
+            layouts.append([
+                (n, tuple(shapes[n].shape), str(shapes[n].dtype))
+                for n, _, _ in layout
+            ])
+        return layouts
+
+    def _compile(self, feed_stack, fetch_names, scope, k: int):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ...kernels.jax_compat import shard_map as _shard_map
+
+        self._check_contract(scope, fetch_names)
+        mesh = self.mesh
+        S = self.stages.n_stages
+        pipe = self.pipe_axis
+        auto_axes = frozenset(a for a in self.plan.mesh_axes if a != pipe)
+
+        # ---- state (params + anything scope-resident the stages read) --
+        state_names: List[str] = []
+        seen = set()
+        for st in self.stages:
+            reads, _ = _phase_state(
+                st.fwd_ops(), scope,
+                st.feeds + [n for n, _, _ in st.fwd_inputs])
+            for n in reads:
+                if n not in seen:
+                    seen.add(n)
+                    state_names.append(n)
+        suffix_ops = [op for st in self.stages for op in st.opt_ops()]
+        grad_names = sorted({
+            n for op in suffix_ops for n in op.inputs.get("Grad", []) if n})
+        opt_reads, opt_writes = _phase_state(suffix_ops, scope, grad_names)
+        opt_rw = [n for n in opt_reads if n in set(opt_writes)]
+        opt_writes = opt_rw + [n for n in opt_writes
+                               if n not in set(opt_rw)]
+        for n in opt_reads:
+            if n not in seen:
+                seen.add(n)
+                state_names.append(n)
+        params = {p.name for p in self.program.all_parameters()}
+
+        # ---- wire layouts ----------------------------------------------
+        state_env = {n: scope.find_var(n) for n in state_names}
+        layouts = self._infer_shapes(feed_stack, state_env)
+        W = max([sum(int(np.prod(s)) if s else 1 for _, s, _ in lo)
+                 for lo in layouts] + [1])
+        in_layouts = [[]] + layouts          # stage s consumes layouts[s-1]
+        out_layouts = layouts + [[]]         # stage s produces layouts[s]
+
+        ticks = sched_mod.schedule_table(S, k, self.schedule)
+        fwd_tbl, bwd_tbl = _tables(ticks, S)
+
+        feed_names_sorted = sorted(feed_stack)
+        loss_name = self.loss_name
+        is_test = getattr(self.program, "_is_test", False)
+        n_fetch = len(fetch_names)
+
+        def _unpack(vec, layout):
+            env, off = {}, 0
+            for n, shape, _ in layout:
+                size = int(np.prod(shape)) if shape else 1
+                env[n] = vec[off:off + size].reshape(shape)
+                off += size
+            return env
+
+        def _pack(env, layout):
+            parts = [jnp.ravel(env[n]) for n, _, _ in layout]
+            vec = (jnp.concatenate(parts) if parts
+                   else jnp.zeros((0,), jnp.float32))
+            return jnp.pad(vec, (0, W - vec.shape[0]))
+
+        def _fwd_core(s, wire_in, feeds_m, state_vals, key):
+            """-> (wire_out [W], loss scalar, fetch_vec [n_fetch])."""
+            st = self.stages.stages[s]
+            tctx = exec_mod.TraceContext(st.program, key, is_test=is_test)
+            # the mesh stage trace runs under jax.vjp, and
+            # optimization_barrier has no differentiation rule; this path
+            # asserts allclose (not bit) parity, so barriers are moot
+            tctx.boundary_barriers = False
+            env = dict(_unpack(wire_in, in_layouts[s]))
+            env.update(zip(feed_names_sorted, feeds_m))
+            env.update(zip(state_names, state_vals))
+            exec_mod.trace_block(st.program.global_block(), env, tctx,
+                                 ops=st.fwd_ops())
+            wire_out = _pack(env, out_layouts[s])
+            loss = (env[loss_name].astype(jnp.float32).reshape(())
+                    if loss_name in st.fetch_candidates
+                    else jnp.asarray(0.0, jnp.float32))
+            fetch_vec = (jnp.stack([
+                (env[n].astype(jnp.float32).reshape(())
+                 if n in st.fetch_candidates
+                 else jnp.asarray(0.0, jnp.float32))
+                for n in fetch_names])
+                if n_fetch else jnp.zeros((0,), jnp.float32))
+            return wire_out, loss, fetch_vec
+
+        def _make_fwd_branch(s):
+            def branch(wire_in, feeds_m, state_vals, key, cot_wire, dloss):
+                wire_out, _, fetch_vec = _fwd_core(
+                    s, wire_in, feeds_m, state_vals, key)
+                zeros = [jnp.zeros_like(v) for v in state_vals]
+                return (wire_out, fetch_vec,
+                        jnp.zeros((W,), jnp.float32), zeros)
+            return branch
+
+        def _make_bwd_branch(s):
+            def branch(wire_in, feeds_m, state_vals, key, cot_wire, dloss):
+                def f(w, sv):
+                    wire_out, loss, _ = _fwd_core(s, w, feeds_m, sv, key)
+                    return wire_out, loss
+
+                _, vjp_fn = jax.vjp(f, wire_in, list(state_vals))
+                dwire, dstates = vjp_fn((cot_wire, dloss))
+                return (jnp.zeros((W,), jnp.float32),
+                        jnp.zeros((n_fetch,), jnp.float32),
+                        dwire, list(dstates))
+            return branch
+
+        fwd_branches = [_make_fwd_branch(s) for s in range(S)]
+        bwd_branches = [_make_bwd_branch(s) for s in range(S)]
+
+        def body(feed_vals, state_vals, key_data, rank_arr):
+            # the pipe rank rides in as a P('pipe')-sharded iota slice:
+            # lax.axis_index lowers to PartitionId, which GSPMD rejects
+            # inside partial-auto shard_map; the PRNG key rides as raw
+            # uint32 key data for the same reason (typed key arrays fail
+            # partial-auto sharding validation at the shard_map boundary)
+            rank = rank_arr[0]
+            base_key = jax.random.wrap_key_data(key_data, impl="rbg")
+
+            def _shift(vec, dst, ok):
+                """Deliver each rank's [W] vec to rank `dst` (one hop of
+                the boundary wire).  lax.ppermute is rejected by the
+                partial-auto SPMD partitioner (manual-subgroup check), so
+                the hop is a psum of a one-hot [S, W] scatter — S times
+                the wire bytes, fine at pipeline depths."""
+                scatter = jnp.zeros((S, W), jnp.float32)
+                scatter = jax.lax.dynamic_update_index_in_dim(
+                    scatter, vec, jnp.clip(dst, 0, S - 1), 0)
+                scatter = jnp.where(ok, scatter, 0.0)
+                total = jax.lax.psum(scatter, pipe)
+                return jax.lax.dynamic_index_in_dim(
+                    total, rank, 0, keepdims=False)
+            zero_wire = jnp.zeros((k, W), jnp.float32)
+            grads0 = [jnp.zeros_like(v) for v in state_vals]
+            fetch0 = jnp.zeros((n_fetch, k), jnp.float32)
+
+            def tick(carry, xs):
+                inbox_f, inbox_b, fetch_buf, grads = carry
+                # per-tick micro-batch indices arrive PRE-GATHERED per
+                # rank (xs streams, hoisted below): a take(tbl, rank)
+                # inside the scan body trips a fatal manual-subgroup
+                # check in the partial-auto SPMD partitioner
+                m_f, m_b, m_in, m_gin = xs
+
+                # ---- forward slot ------------------------------------
+                do_f = m_f >= 0
+                mf = jnp.clip(m_f, 0, k - 1)
+                feeds_f = [jax.lax.dynamic_index_in_dim(
+                    v, mf, 0, keepdims=False) for v in feed_vals]
+                w_out, fvec, _, _ = jax.lax.switch(
+                    rank, fwd_branches, inbox_f[mf], feeds_f, state_vals,
+                    jax.random.fold_in(base_key, mf),
+                    jnp.zeros((W,), jnp.float32),
+                    jnp.asarray(0.0, jnp.float32))
+                w_out = jnp.where(do_f, w_out, 0.0)
+                fetch_buf = jnp.where(
+                    do_f,
+                    jax.lax.dynamic_update_index_in_dim(
+                        fetch_buf, fvec, mf, 1),
+                    fetch_buf)
+
+                # ---- backward slot (recompute + vjp) -----------------
+                do_b = m_b >= 0
+                mb = jnp.clip(m_b, 0, k - 1)
+                feeds_b = [jax.lax.dynamic_index_in_dim(
+                    v, mb, 0, keepdims=False) for v in feed_vals]
+                # the IR backward's loss-grad seed is 1.0; only the
+                # owning stage's trace touches the loss, so a global 1.0
+                # is exact there and inert elsewhere
+                dloss = jnp.where(do_b, 1.0, 0.0).astype(jnp.float32)
+                _, _, dwire, dstates = jax.lax.switch(
+                    rank, bwd_branches, inbox_f[mb], feeds_b, state_vals,
+                    jax.random.fold_in(base_key, mb), inbox_b[mb], dloss)
+                dwire = jnp.where(do_b, dwire, 0.0)
+                grads = [g + jnp.where(do_b, d, jnp.zeros_like(d))
+                         for g, d in zip(grads, dstates)]
+
+                # ---- boundary transfers ------------------------------
+                recv_f = _shift(w_out, rank + 1, rank + 1 <= S - 1)
+                recv_b = _shift(dwire, rank - 1, rank - 1 >= 0)
+                ok_in = (rank > 0) & (m_in >= 0)
+                inbox_f = jnp.where(
+                    ok_in,
+                    jax.lax.dynamic_update_index_in_dim(
+                        inbox_f, recv_f, jnp.clip(m_in, 0, k - 1), 0),
+                    inbox_f)
+                ok_gin = (rank < S - 1) & (m_gin >= 0)
+                inbox_b = jnp.where(
+                    ok_gin,
+                    jax.lax.dynamic_update_index_in_dim(
+                        inbox_b, recv_b, jnp.clip(m_gin, 0, k - 1), 0),
+                    inbox_b)
+                return (inbox_f, inbox_b, fetch_buf, grads), None
+
+            ftj = jnp.asarray(fwd_tbl)  # [T, S]
+            btj = jnp.asarray(bwd_tbl)
+
+            def _col(tbl, i):
+                return jax.lax.dynamic_index_in_dim(
+                    tbl.T, jnp.clip(i, 0, S - 1), 0, keepdims=False)
+
+            xs = (_col(ftj, rank), _col(btj, rank),
+                  _col(ftj, rank - 1), _col(btj, rank + 1))
+            carry = (zero_wire, zero_wire, fetch0, grads0)
+            if self.unroll_ticks:
+                for t in range(fwd_tbl.shape[0]):
+                    carry, _ = tick(carry, tuple(x[t] for x in xs))
+            else:
+                carry, _ = jax.lax.scan(tick, carry, xs)
+            (_, _, fetch_buf, grads) = carry
+            # each value lives on exactly one rank; psum replicates
+            fetch_buf = jax.lax.psum(fetch_buf, pipe)
+            grads = [jax.lax.psum(g, pipe) for g in grads]
+            return fetch_buf, grads
+
+        smapped = _shard_map(
+            body, mesh,
+            in_specs=([P()] * len(feed_names_sorted),
+                      [P()] * len(state_names), P(), P(pipe)),
+            out_specs=(P(), [P()] * len(state_names)),
+            auto=auto_axes)
+
+        def step(feed_vals, state_vals, base_key):
+            import jax.numpy as jnp
+
+            rank_arr = jnp.arange(S, dtype=jnp.int32)
+            fetch_buf, grads = smapped(feed_vals, state_vals,
+                                       jax.random.key_data(base_key),
+                                       rank_arr)
+            # optimizer suffix ONCE in plain GSPMD land on the averaged
+            # grads — the run_accumulated suffix contract (key fold K,
+            # sums / float(K))
+            env: Dict[str, Any] = dict(zip(state_names, state_vals))
+            by_name = dict(zip(state_names, grads))
+            for g in grad_names:
+                env[g] = by_name[g[:-len("@GRAD")]] / float(k)
+            tctx = exec_mod.TraceContext(
+                self.program, jax.random.fold_in(base_key, k),
+                is_test=is_test)
+            exec_mod.trace_block(self.program.global_block(), env, tctx,
+                                 ops=suffix_ops)
+            new_state = [env.get(n) for n in opt_writes]
+            return fetch_buf, new_state
+
+        def sharding_for(name):
+            v = scope.find_var(name)
+            spec = self.plan.spec_for_param(
+                name, getattr(v, "shape", None),
+                is_moment=name not in params)
+            return NamedSharding(mesh, spec)
+
+        def feed_sharding(name):
+            spec = self.plan.spec_for_feed(name)
+            return NamedSharding(mesh, P(*((None,) + tuple(spec))))
+
+        # NOTE: state is deliberately NOT donated — read-only members
+        # (position tables, lr) are not returned as outputs, and donating
+        # an unreturned buffer would delete the live scope array
+        jitted = jax.jit(
+            step,
+            in_shardings=([feed_sharding(n) for n in feed_names_sorted],
+                          [sharding_for(n) for n in state_names], None),
+            out_shardings=(None, [sharding_for(n) for n in opt_writes]))
+        return (jitted, state_names, opt_writes, feed_names_sorted)
+
+    # -- execution (exe.run delegates here) -------------------------------
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        feed = feed or {}
+        scope = scope or exec_mod.global_scope()
+        fetch_names = [
+            v.name if isinstance(v, fw.Variable) else v
+            for v in (fetch_list or [])
+        ]
+        feed_stack = {
+            n: executor._to_device_array(self.program, n, feed[n])
+            for n in sorted(feed)
+        }
+        if not feed_stack:
+            raise ValueError("PipelineMeshProgram needs a "
+                             "[K, micro_bs, ...] feed")
+        k = int(next(iter(feed_stack.values())).shape[0])
+
+        key = (k,
+               tuple((n, tuple(v.shape), str(v.dtype))
+                     for n, v in sorted(feed_stack.items())),
+               tuple(fetch_names))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile(feed_stack, fetch_names, scope, k)
+            self._cache[key] = entry
+        jitted, state_names, opt_writes, feed_names_sorted = entry
+
+        mesh = self.mesh
+        feed_vals = []
+        for n in feed_names_sorted:
+            spec = self.plan.spec_for_feed(n)
+            feed_vals.append(jax.device_put(
+                feed_stack[n],
+                NamedSharding(mesh, P(*((None,) + tuple(spec))))))
+        state_vals = [scope.find_var(n) for n in state_names]
+
+        # step key from the delegating executor's run counter (the
+        # run_accumulated key schedule, same as trainer.py)
+        base_key = jax.random.fold_in(
+            _prng_key(self.program.random_seed or 0),
+            executor._next_run_id())
+        fetch_buf, new_state = jitted(feed_vals, state_vals, base_key)
+        for n, v in zip(opt_writes, new_state):
+            if v is not None:
+                scope.set_var(n, v)
+        outs = [fetch_buf[i] for i in range(len(fetch_names))]
+        if return_numpy:
+            return [np.asarray(v) for v in outs]
+        return outs

@@ -1,0 +1,608 @@
+"""Stage partitioner: cut ONE trained Program into per-stage sub-programs.
+
+Generalizes Executor.run_accumulated's prefix/suffix split (fwd+bwd
+prefix, Optimize suffix) into an N-segment pipeline form:
+
+  * Forward-role ops are split into N contiguous segments at
+    user-annotated cut vars or auto-balanced boundaries.
+  * Backward-role ops follow the forward op whose gradient they compute
+    (the stage where every forward value they read already lives).
+  * Optimize-role ops stay LOCAL to the stage owning their Param — no
+    optimizer state ever crosses a stage boundary.
+  * Cheap feed-derived subgraphs (attention masks/biases, position ids —
+    ops whose transitive inputs are only feeds and constants) are
+    REPLICATED into every consuming stage instead of wired across cuts,
+    so boundary transfers carry real activations only.
+
+Each stage is emitted as a REAL fw.Program (verifiable by
+paddle_tpu.analysis, lintable by tools/graph_lint.py) whose declared
+data vars include the activation/grad boundary inputs, plus the
+explicit IO contract the scheduler and the verifier's
+verify_program_set consume:
+
+  fwd_inputs   activations received from earlier stages
+  fwd_outputs  activations later stages (fwd OR bwd) consume
+  bwd_inputs   boundary grads received from later stages
+  bwd_outputs  boundary grads earlier stages consume
+  stash        fwd env names this stage's OWN backward re-reads
+               (activation stashing: held per in-flight micro-batch)
+
+Cut-crossing sets (crossing(c) = vars produced at stage <= c consumed at
+stage > c) drive the mesh runner's hop-by-hop neighbor wires; the
+direct-delivery scheduler uses the per-stage sets above.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ...core import framework as fw
+
+_GRAD_TOKEN = "@GRAD"
+
+
+def _is_grad_name(name: str) -> bool:
+    return _GRAD_TOKEN in name
+
+
+def _role(op) -> int:
+    return int(op.attrs.get(fw.OpRole.ROLE_ATTR_NAME, fw.OpRole.Forward))
+
+
+def _is_opt(op) -> bool:
+    return bool(_role(op) & fw.OpRole.Optimize)
+
+
+def _is_bwd(op) -> bool:
+    return bool(_role(op) & fw.OpRole.Backward) and not _is_opt(op)
+
+
+class PipelineStage:
+    """One stage's sub-program + boundary contract."""
+
+    def __init__(self, index: int, program: fw.Program):
+        self.index = index
+        self.program = program
+        # op index lists INTO program.global_block().ops, per phase
+        self.fwd_idx: List[int] = []
+        self.bwd_idx: List[int] = []
+        self.opt_idx: List[int] = []
+        # boundary IO: [(name, shape, dtype)], deterministic order
+        self.fwd_inputs: List[Tuple[str, tuple, str]] = []
+        self.fwd_outputs: List[Tuple[str, tuple, str]] = []
+        self.bwd_inputs: List[Tuple[str, tuple, str]] = []
+        self.bwd_outputs: List[Tuple[str, tuple, str]] = []
+        self.feeds: List[str] = []          # data vars this stage reads
+        self.bwd_feeds: List[str] = []      # feeds the bwd phase re-reads
+        self.stash: List[str] = []          # fwd env names bwd re-reads
+        self.owned_params: List[str] = []   # params whose optimizer is local
+        self.grad_names: List[str] = []     # grads the local optimizer reads
+        self.fetch_candidates: Set[str] = set()
+
+    def fwd_ops(self):
+        ops = self.program.global_block().ops
+        return [ops[i] for i in self.fwd_idx]
+
+    def bwd_ops(self):
+        ops = self.program.global_block().ops
+        return [ops[i] for i in self.bwd_idx]
+
+    def opt_ops(self):
+        ops = self.program.global_block().ops
+        return [ops[i] for i in self.opt_idx]
+
+    def io_summary(self) -> dict:
+        """The contract verify_program_set checks (analysis/verifier.py)."""
+        return {
+            "index": self.index,
+            "fwd_inputs": list(self.fwd_inputs),
+            "fwd_outputs": list(self.fwd_outputs),
+            "bwd_inputs": list(self.bwd_inputs),
+            "bwd_outputs": list(self.bwd_outputs),
+            "owned_params": list(self.owned_params),
+            "program": self.program,
+        }
+
+
+class PipelineStages:
+    """The partition result: stages + cut-crossing wire layouts."""
+
+    def __init__(self, source: fw.Program, stages: List[PipelineStage],
+                 crossing: List[List[Tuple[str, tuple, str]]],
+                 feed_names: List[str]):
+        self.source = source
+        self.stages = stages
+        # crossing[c]: vars flowing over cut c (stage c -> c+1); the bwd
+        # wire at cut c carries exactly these vars' cotangents
+        self.crossing = crossing
+        self.feed_names = feed_names
+        self.fetch_owner: Dict[str, Tuple[int, str]] = {}
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.stages)
+
+    def __iter__(self):
+        return iter(self.stages)
+
+
+def _feed_only_ops(block: fw.Block, opt_start_set: Set[int]) -> Set[int]:
+    """Indices of Forward-role ops whose TRANSITIVE inputs are only data
+    vars and constants (no param/persistable reads, no randomness, no
+    sub-blocks): the replicable mask/bias prologue."""
+    from ...core import executor as ex
+
+    cheap_names: Set[str] = set()
+    for v in block.vars.values():
+        if v.is_data:
+            cheap_names.add(v.name)
+    cheap_ops: Set[int] = set()
+    for i, op in enumerate(block.ops):
+        if i in opt_start_set or _is_bwd(op) or _is_opt(op):
+            continue
+        if op.attrs.get("sub_block") is not None:
+            continue
+        if ex.op_threads_rng(op):
+            continue
+        reads = [n for n in op.input_arg_names() if n]
+        writes = [n for n in op.output_arg_names() if n]
+        if any(n not in cheap_names for n in reads):
+            continue
+        if any(block._find_var_recursive(n) is not None
+               and block._find_var_recursive(n).persistable
+               for n in writes):
+            continue
+        cheap_ops.add(i)
+        cheap_names.update(writes)
+    return cheap_ops
+
+
+def _op_cost(block: fw.Block, op) -> float:
+    """Balance proxy: bytes of Parameter inputs (flop-dominant dots read
+    their weights) + 1 so param-free ops still carry weight."""
+    cost = 1.0
+    for n in op.input_arg_names():
+        v = block._find_var_recursive(n) if n else None
+        if isinstance(v, fw.Parameter) and v.shape:
+            cost += float(np.prod([d for d in v.shape if d]))
+    return cost
+
+
+def _auto_boundaries(block: fw.Block, fwd_ids: List[int],
+                     prologue: Set[int], n_stages: int) -> List[int]:
+    """Greedy prefix-sum balance of fwd op costs into n contiguous
+    segments; returns the fwd-op indices (into block.ops) where each new
+    stage begins (n_stages - 1 entries)."""
+    weighted = [(i, _op_cost(block, block.ops[i])) for i in fwd_ids
+                if i not in prologue]
+    total = sum(c for _, c in weighted)
+    bounds, acc, next_share, s = [], 0.0, total / n_stages, 1
+    for i, c in weighted:
+        if s < n_stages and acc >= next_share * s and acc + c > next_share * s:
+            bounds.append(i)
+            s += 1
+        acc += c
+    while len(bounds) < n_stages - 1:  # degenerate tiny programs
+        bounds.append(weighted[-1][0])
+    return bounds[:n_stages - 1]
+
+
+def split_program(
+    program: fw.Program,
+    feed_names: Sequence[str],
+    n_stages: int = 2,
+    cut_vars: Optional[Sequence[str]] = None,
+    mark_boundaries: bool = True,
+) -> PipelineStages:
+    """Partition `program` (a trained global-block program: forward +
+    append_backward grads + optimizer.minimize suffix) into `n_stages`
+    pipeline stages.
+
+    cut_vars: optional user annotation — n_stages-1 var names; stage s
+    ends with the op producing cut_vars[s].  Omitted: auto-balanced on
+    parameter-byte cost.
+
+    mark_boundaries (default on): annotate the SOURCE program's
+    boundary-crossing producers with `pipeline_boundary_vars` attrs — the
+    executor trace puts an optimization barrier on those values, so XLA
+    associates the reductions consuming them identically whether the
+    value is in-program (single-program run_accumulated) or a stage
+    boundary input.  Without it, XLA CPU fuses producer chains into
+    downstream reduces and the two compilations drift by ~1 ulp per step
+    (measured: a boundary layer-norm's bias-grad reduce) — the
+    association normalization is what makes the pipeline-vs-single-
+    program BIT-parity contract assertable.  The mark changes the source
+    program's fingerprint (it recompiles once) but not its math.
+    """
+    block = program.global_block()
+    if len(program.blocks) > 1:
+        raise ValueError(
+            "split_program: control-flow sub-blocks (While/conditional) "
+            "cannot be stage-split; pipeline the global block only")
+    n_ops = len(block.ops)
+    opt_ids = [i for i in range(n_ops) if _is_opt(block.ops[i])]
+    if not opt_ids:
+        raise ValueError(
+            "split_program: program has no Optimize-role ops (call "
+            "optimizer.minimize first) — pipeline stages keep each "
+            "param's optimizer local, so the suffix must exist")
+    bwd_ids = [i for i in range(n_ops) if _is_bwd(block.ops[i])]
+    fwd_ids = [i for i in range(n_ops)
+               if not _is_bwd(block.ops[i]) and not _is_opt(block.ops[i])]
+
+    feed_set = set(feed_names)
+    prologue = _feed_only_ops(block, set(opt_ids))
+
+    # ---- forward stage assignment --------------------------------------
+    if cut_vars is not None:
+        if len(cut_vars) != n_stages - 1:
+            raise ValueError(
+                f"split_program: {n_stages} stages need {n_stages - 1} "
+                f"cut vars, got {len(cut_vars)}")
+        stage_of_fwd: Dict[int, int] = {}
+        cur, cut_list = 0, list(cut_vars)
+        for i in fwd_ids:
+            stage_of_fwd[i] = cur
+            if cur < len(cut_list) and cut_list[cur] in set(
+                    block.ops[i].output_arg_names()):
+                cur += 1
+        if cur != n_stages - 1:
+            missing = cut_list[cur:]
+            raise ValueError(
+                f"split_program: cut var(s) {missing} produced by no "
+                f"forward op — annotate real activation names")
+    else:
+        bounds = _auto_boundaries(block, fwd_ids, prologue, n_stages)
+        stage_of_fwd = {}
+        for i in fwd_ids:
+            stage_of_fwd[i] = sum(1 for b in bounds if i >= b)
+
+    # producer map over fwd ops (last writer wins, program order)
+    producer: Dict[str, int] = {}
+    for i in fwd_ids:
+        if i in prologue:
+            continue
+        for n in block.ops[i].output_arg_names():
+            if n:
+                producer[n] = stage_of_fwd[i]
+    prologue_outputs: Set[str] = set()
+    for i in prologue:
+        prologue_outputs.update(
+            n for n in block.ops[i].output_arg_names() if n)
+
+    # param/persistable ownership: min consuming fwd stage (the
+    # optimizer op for that param lands there)
+    param_owner: Dict[str, int] = {}
+    for i in fwd_ids:
+        if i in prologue:
+            continue
+        for n in block.ops[i].input_arg_names():
+            if not n or n in feed_set or n in producer \
+                    or n in prologue_outputs:
+                continue
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable:
+                s = stage_of_fwd[i]
+                param_owner[n] = min(param_owner.get(n, s), s)
+
+    def _value_stage(name: str) -> Optional[int]:
+        """Stage where a FORWARD value is produced/available (None for
+        feeds and prologue values — available to every stage)."""
+        if name in producer:
+            return producer[name]
+        if name in param_owner:
+            return param_owner[name]
+        return None
+
+    # ---- backward stage assignment -------------------------------------
+    # rule 1: max producer stage over the op's forward-value inputs (a
+    # grad op reads its fwd op's inputs AND outputs, so this lands it on
+    # the fwd op's own stage); rule 2 (pure grad plumbing — the sum/
+    # assign combines, the loss-grad fill): the stage producing the base
+    # var of its @GRAD output.
+    stage_of_bwd: Dict[int, int] = {}
+    for i in bwd_ids:
+        op = block.ops[i]
+        cands = []
+        for n in op.input_arg_names():
+            if n and not _is_grad_name(n):
+                s = _value_stage(n)
+                if s is not None:
+                    cands.append(s)
+        if not cands:
+            for n in op.output_arg_names():
+                if n and _is_grad_name(n):
+                    base = n.split(_GRAD_TOKEN)[0]
+                    s = _value_stage(base)
+                    if s is not None:
+                        cands.append(s)
+                    elif base == "":  # loss-grad fill names <loss>@GRAD
+                        continue
+        stage_of_bwd[i] = max(cands) if cands else n_stages - 1
+
+    # Grad routing must be POSITION-aware: the IR accumulates
+    # multi-consumer grads in place (the first consumer writes the
+    # canonical <v>@GRAD, later consumers write @RENAME partials, and
+    # the materialize `sum` re-writes the canonical name at the
+    # producer's stage) — so "who produced the grad this op reads" is
+    # the last writer BEFORE the op, not the last writer overall.
+    grad_writer_stage: Dict[str, int] = {}
+    bwd_read_src: Dict[int, Dict[str, int]] = {}
+    for i in bwd_ids:
+        srcs = {}
+        for n in block.ops[i].input_arg_names():
+            if n and _is_grad_name(n) and n in grad_writer_stage:
+                srcs[n] = grad_writer_stage[n]
+        bwd_read_src[i] = srcs
+        for n in block.ops[i].output_arg_names():
+            if n:
+                grad_writer_stage[n] = stage_of_bwd[i]
+    # final-writer map (the canonical materialized grads the optimizer
+    # reads): used for opt placement fallbacks only
+    grad_producer: Dict[str, int] = dict(grad_writer_stage)
+
+    # ---- optimizer stage assignment ------------------------------------
+    stage_of_opt: Dict[int, int] = {}
+    for i in opt_ids:
+        op = block.ops[i]
+        pnames = op.inputs.get("Param", [])
+        if pnames and pnames[0]:
+            p = pnames[0]
+            if p not in param_owner:
+                # param read by no fwd op (frozen head etc.): keep its
+                # update with its grad producer, else the last stage
+                gname = op.inputs.get("Grad", [""])[0]
+                param_owner[p] = grad_producer.get(gname, n_stages - 1)
+            stage_of_opt[i] = param_owner[p]
+        else:
+            # param-less suffix op (global counters, shared lr chains):
+            # stage-local duplication would double-apply persistable
+            # writes — refuse loudly rather than corrupt state
+            writes_state = any(
+                block._find_var_recursive(n) is not None
+                and block._find_var_recursive(n).persistable
+                for n in op.output_arg_names() if n)
+            if writes_state:
+                raise NotImplementedError(
+                    f"split_program: Optimize-role op {op.type!r} has no "
+                    f"Param input but writes persistable state — a "
+                    f"global optimizer accumulator cannot be made "
+                    f"stage-local (cut the program differently or fold "
+                    f"the update into a per-param op)")
+            stage_of_opt[i] = n_stages - 1
+
+    # ---- per-stage op sets (prologue replicated on demand) -------------
+    fwd_by_stage: List[List[int]] = [[] for _ in range(n_stages)]
+    for i in fwd_ids:
+        if i not in prologue:
+            fwd_by_stage[stage_of_fwd[i]].append(i)
+    bwd_by_stage: List[List[int]] = [[] for _ in range(n_stages)]
+    for i in bwd_ids:
+        bwd_by_stage[stage_of_bwd[i]].append(i)
+    opt_by_stage: List[List[int]] = [[] for _ in range(n_stages)]
+    for i in opt_ids:
+        opt_by_stage[stage_of_opt[i]].append(i)
+
+    # prologue replication: closure of prologue ops whose outputs a
+    # stage's (fwd or bwd or opt) ops read
+    prologue_list = sorted(prologue)
+    prologue_producer = {}
+    for i in prologue_list:
+        for n in block.ops[i].output_arg_names():
+            if n:
+                prologue_producer[n] = i
+
+    def _prologue_for(op_ids: List[int]) -> List[int]:
+        needed: Set[int] = set()
+        frontier = [n for i in op_ids
+                    for n in block.ops[i].input_arg_names()
+                    if n in prologue_producer]
+        while frontier:
+            n = frontier.pop()
+            i = prologue_producer[n]
+            if i in needed:
+                continue
+            needed.add(i)
+            frontier.extend(m for m in block.ops[i].input_arg_names()
+                            if m in prologue_producer)
+        return sorted(needed)
+
+    # ---- boundary IO ----------------------------------------------------
+    def _var_sig(name: str) -> Tuple[str, tuple, str]:
+        v = block._find_var_recursive(name)
+        shape = tuple(v.shape) if v is not None and v.shape else ()
+        dtype = v.dtype if v is not None else "float32"
+        return (name, shape, dtype)
+
+    fwd_in: List[Set[str]] = [set() for _ in range(n_stages)]
+    fwd_out: List[Set[str]] = [set() for _ in range(n_stages)]
+    bwd_in: List[Set[str]] = [set() for _ in range(n_stages)]
+    bwd_out: List[Set[str]] = [set() for _ in range(n_stages)]
+    feeds_per_stage: List[Set[str]] = [set() for _ in range(n_stages)]
+    bwd_feeds: List[Set[str]] = [set() for _ in range(n_stages)]
+    stash_per_stage: List[Set[str]] = [set() for _ in range(n_stages)]
+
+    for s in range(n_stages):
+        own_fwd = set(fwd_by_stage[s])
+        own_prologue = set(_prologue_for(
+            fwd_by_stage[s] + bwd_by_stage[s] + opt_by_stage[s]))
+        produced_here: Set[str] = set()
+        for i in sorted(own_fwd | own_prologue):
+            produced_here.update(
+                n for n in block.ops[i].output_arg_names() if n)
+        # fwd reads
+        for i in fwd_by_stage[s]:
+            for n in block.ops[i].input_arg_names():
+                if not n or n in produced_here or n in feed_set:
+                    if n in feed_set:
+                        feeds_per_stage[s].add(n)
+                    continue
+                ps = _value_stage(n)
+                if ps is not None and ps < s:
+                    fwd_in[s].add(n)
+                    fwd_out[ps].add(n)
+                # ps == s or persistable state: scope-resident, local
+        # bwd reads: fwd values -> stash or boundary; grads -> boundary
+        for i in bwd_by_stage[s]:
+            for n in block.ops[i].input_arg_names():
+                if not n:
+                    continue
+                if _is_grad_name(n):
+                    gp = bwd_read_src[i].get(n)
+                    if gp is not None and gp > s:
+                        bwd_in[s].add(n)
+                        bwd_out[gp].add(n)
+                    continue
+                if n in feed_set:
+                    bwd_feeds[s].add(n)
+                    continue
+                if n in prologue_outputs or n in produced_here \
+                        or _value_stage(n) == s:
+                    if n in produced_here or n in prologue_outputs:
+                        stash_per_stage[s].add(n)
+                    continue
+                ps = _value_stage(n)
+                if ps is not None and ps < s:
+                    # fwd value from an earlier stage, read only by THIS
+                    # stage's bwd: it still crosses the fwd wire and is
+                    # stashed here with the rest of the fwd env
+                    fwd_in[s].add(n)
+                    fwd_out[ps].add(n)
+                    stash_per_stage[s].add(n)
+        # every fwd boundary input the bwd re-reads is stash too
+        for i in bwd_by_stage[s]:
+            for n in block.ops[i].input_arg_names():
+                if n in fwd_in[s]:
+                    stash_per_stage[s].add(n)
+        # opt reads (grads produced by own bwd by construction; anything
+        # else is a contract violation verify_program_set names)
+        for i in opt_by_stage[s]:
+            for n in block.ops[i].inputs.get("Grad", []):
+                if n:
+                    gp = grad_producer.get(n)
+                    if gp is not None and gp != s:
+                        bwd_in[s].add(n)
+                        bwd_out[gp].add(n)
+
+    # cut-crossing wires for the mesh runner: crossing(c) = fwd values
+    # produced at stage <= c consumed (fwd or bwd) at stage > c
+    crossing: List[List[Tuple[str, tuple, str]]] = []
+    for c in range(n_stages - 1):
+        names = sorted({
+            n
+            for s2 in range(c + 1, n_stages)
+            for n in fwd_in[s2]
+            if _value_stage(n) is not None and _value_stage(n) <= c
+        })
+        crossing.append([_var_sig(n) for n in names])
+
+    # ---- boundary association normalization ----------------------------
+    # (must precede the stage-program build so copied ops carry the mark)
+    if mark_boundaries:
+        crossing_names: Set[str] = set()
+        for s in range(n_stages):
+            crossing_names |= fwd_in[s] | bwd_in[s]
+        marked = False
+        for op in block.ops:
+            here = [n for n in op.output_arg_names()
+                    if n in crossing_names]
+            if here:
+                prev = set(op.attrs.get("pipeline_boundary_vars", ()))
+                merged = prev | set(here)
+                if merged != prev:
+                    op.attrs["pipeline_boundary_vars"] = sorted(merged)
+                    marked = True
+        if marked:
+            block._bump()
+
+    # ---- build per-stage programs --------------------------------------
+    stages: List[PipelineStage] = []
+    for s in range(n_stages):
+        sp = fw.Program()
+        sp.random_seed = program.random_seed
+        sp._is_test = getattr(program, "_is_test", False)
+        sp._amp_bf16 = bool(getattr(program, "_amp_bf16", False))
+        blk = sp.global_block()
+        st = PipelineStage(s, sp)
+
+        op_ids = (_prologue_for(fwd_by_stage[s] + bwd_by_stage[s]
+                                + opt_by_stage[s])
+                  + fwd_by_stage[s] + bwd_by_stage[s] + opt_by_stage[s])
+        # declare every referenced var first (copies — the stage program
+        # must not alias the source IR's mutable Variable objects)
+        boundary_ins = fwd_in[s] | bwd_in[s]
+        referenced: List[str] = []
+        seen: Set[str] = set()
+        for i in op_ids:
+            for n in (block.ops[i].input_arg_names()
+                      + block.ops[i].output_arg_names()):
+                if n and n not in seen:
+                    seen.add(n)
+                    referenced.append(n)
+        for n in referenced:
+            v = block._find_var_recursive(n)
+            is_param = isinstance(v, fw.Parameter)
+            kw = dict(
+                shape=(list(v.shape) if v is not None and v.shape is not None
+                       else None),
+                dtype=v.dtype if v is not None else "float32",
+                persistable=bool(v is not None and v.persistable),
+                stop_gradient=bool(v is None or v.stop_gradient),
+                is_data=bool(n in boundary_ins
+                             or (v is not None and v.is_data)),
+            )
+            if is_param:
+                nv = fw.Parameter(blk, n, kw["shape"], kw["dtype"],
+                                  trainable=getattr(v, "trainable", True))
+                blk.vars[n] = nv
+            else:
+                blk.create_var(name=n, **kw)
+        n_pro = len(_prologue_for(fwd_by_stage[s] + bwd_by_stage[s]
+                                  + opt_by_stage[s]))
+        for j, i in enumerate(op_ids):
+            op = block.ops[i]
+            blk.append_op(op.type, {k: list(v) for k, v in op.inputs.items()},
+                          {k: list(v) for k, v in op.outputs.items()},
+                          dict(op.attrs))
+            if j < n_pro or i in fwd_by_stage[s]:
+                # replicated prologue executes with the fwd phase
+                st.fwd_idx.append(j)
+            elif i in stage_of_bwd and stage_of_bwd.get(i) == s \
+                    and _is_bwd(op):
+                st.bwd_idx.append(j)
+            else:
+                st.opt_idx.append(j)
+
+        st.fwd_inputs = [_var_sig(n) for n in sorted(fwd_in[s])]
+        st.fwd_outputs = [_var_sig(n) for n in sorted(fwd_out[s])]
+        st.bwd_inputs = [_var_sig(n) for n in sorted(bwd_in[s])]
+        st.bwd_outputs = [_var_sig(n) for n in sorted(bwd_out[s])]
+        st.feeds = sorted(feeds_per_stage[s]
+                          | {n for i in _prologue_for(
+                              fwd_by_stage[s] + bwd_by_stage[s]
+                              + opt_by_stage[s])
+                             for n in block.ops[i].input_arg_names()
+                             if n in feed_set})
+        st.bwd_feeds = sorted(bwd_feeds[s])
+        st.stash = sorted(stash_per_stage[s])
+        st.owned_params = sorted(
+            p for p, o in param_owner.items() if o == s
+            and isinstance(block._find_var_recursive(p), fw.Parameter))
+        st.grad_names = sorted({
+            n for i in opt_by_stage[s]
+            for n in block.ops[i].inputs.get("Grad", []) if n
+        })
+        st.fetch_candidates = {
+            n for i in fwd_by_stage[s]
+            for n in block.ops[i].output_arg_names() if n
+        }
+        stages.append(st)
+
+    result = PipelineStages(program, stages, crossing,
+                            list(feed_names))
+    for s, st in enumerate(stages):
+        for n in st.fetch_candidates:
+            result.fetch_owner[n] = (s, "fwd")
+    return result

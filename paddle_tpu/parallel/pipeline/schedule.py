@@ -1,0 +1,164 @@
+"""Micro-batch schedules: per-tick GPipe / 1F1B event tables.
+
+One generator feeds BOTH execution paths: the host scheduler
+(trainer.py) walks the table tick by tick, and the mesh runner (mesh.py)
+lowers it to constant per-tick [n_stages] micro-batch index arrays the
+SPMD program indexes by pipe rank.  Tables are dependency-validated at
+build time (validate_schedule) — an invalid schedule is a named error,
+not silent numeric drift.
+
+Both schedules run every phase the same number of times in the same
+per-stage micro-batch ORDER (fwd 0..K-1, bwd 0..K-1), so loss/grad
+accumulation is bit-identical between them and to run_accumulated; they
+differ only in interleaving — GPipe stashes up to K micro-batches at the
+first stage, 1F1B caps the stash at the stage's warmup depth
+(min(K, n_stages - stage)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+SCHEDULES = ("gpipe", "1f1b")
+
+# one tick's work for one stage: ("fwd"|"bwd", micro_batch) — at most one
+# fwd and one bwd per (tick, stage)
+Tick = List[Tuple[int, str, int]]  # [(stage, phase, mb), ...]
+
+
+def _action_sequences(n_stages: int, n_micro: int, kind: str
+                      ) -> List[List[Tuple[str, int]]]:
+    """Per-stage action list [(phase, mb), ...] in issue order."""
+    seqs = []
+    for s in range(n_stages):
+        if kind == "gpipe":
+            seq = ([("fwd", m) for m in range(n_micro)]
+                   + [("bwd", m) for m in range(n_micro)])
+        elif kind == "1f1b":
+            # PipeDream-Flush / Megatron non-interleaved 1F1B: warmup
+            # fwds, steady-state one-forward-one-backward, cooldown bwds
+            warmup = min(n_micro, n_stages - s)
+            seq = [("fwd", m) for m in range(warmup)]
+            f, b = warmup, 0
+            while b < n_micro:
+                seq.append(("bwd", b))
+                b += 1
+                if f < n_micro:
+                    seq.append(("fwd", f))
+                    f += 1
+        else:
+            raise ValueError(f"unknown schedule {kind!r}; one of {SCHEDULES}")
+        seqs.append(seq)
+    return seqs
+
+
+@functools.lru_cache(maxsize=128)
+def schedule_table(n_stages: int, n_micro: int, kind: str = "gpipe"
+                   ) -> List[Tick]:
+    """Greedy dependency-respecting tick simulation: each tick, every
+    stage issues its next pending action if its dependencies completed
+    at a STRICTLY earlier tick (so within-tick order is free):
+
+      fwd(s, m):  needs fwd(s-1, m)
+      bwd(s, m):  needs fwd(s, m); and bwd(s+1, m) unless s is last
+
+    Memoized per (S, K, kind) — the trainer walks it every step; treat
+    the returned table as read-only.
+    """
+    seqs = _action_sequences(n_stages, n_micro, kind)
+    pos = [0] * n_stages
+    done: Dict[Tuple[str, int, int], int] = {}  # (phase, s, m) -> tick
+    ticks: List[Tick] = []
+    t = 0
+    guard = 8 * n_stages * n_micro + 16
+    while any(pos[s] < len(seqs[s]) for s in range(n_stages)):
+        tick: Tick = []
+        for s in range(n_stages):
+            if pos[s] >= len(seqs[s]):
+                continue
+            phase, m = seqs[s][pos[s]]
+            if phase == "fwd":
+                ready = s == 0 or done.get(("fwd", s - 1, m), t) < t
+            else:
+                ready = done.get(("fwd", s, m), t) < t and (
+                    s == n_stages - 1
+                    or done.get(("bwd", s + 1, m), t) < t)
+            if ready:
+                tick.append((s, phase, m))
+        for s, phase, m in tick:
+            done[(phase, s, m)] = t
+            pos[s] += 1
+        ticks.append(tick)
+        t += 1
+        if t > guard:  # a schedule bug must fail loudly, never spin
+            raise RuntimeError(
+                f"schedule_table({n_stages}, {n_micro}, {kind!r}): no "
+                f"progress after {t} ticks — dependency deadlock")
+    return ticks
+
+
+def validate_schedule(n_stages: int, n_micro: int, kind: str) -> List[str]:
+    """Named violations in the generated table (empty = valid): every
+    (phase, stage, mb) exactly once, fwd per stage in mb order, all
+    dependencies strictly earlier.  graph_lint's pipeline entry runs
+    this for every (pp, schedule) it covers."""
+    problems: List[str] = []
+    ticks = schedule_table(n_stages, n_micro, kind)
+    at: Dict[Tuple[str, int, int], int] = {}
+    for t, tick in enumerate(ticks):
+        for s, phase, m in tick:
+            key = (phase, s, m)
+            if key in at:
+                problems.append(f"{key} issued twice (ticks {at[key]},{t})")
+            at[key] = t
+    for s in range(n_stages):
+        for phase in ("fwd", "bwd"):
+            mbs = sorted(
+                (t, m) for (p, st, m), t in at.items()
+                if p == phase and st == s)
+            order = [m for _, m in mbs]
+            if order != list(range(n_micro)):
+                problems.append(
+                    f"stage {s} {phase} order {order} != 0..{n_micro - 1} "
+                    f"(grad accumulation order would drift)")
+    for (phase, s, m), t in at.items():
+        if phase == "fwd" and s > 0:
+            dep = at.get(("fwd", s - 1, m))
+            if dep is None or dep >= t:
+                problems.append(f"fwd({s},{m})@{t} before fwd({s - 1},{m})")
+        if phase == "bwd":
+            dep = at.get(("fwd", s, m))
+            if dep is None or dep >= t:
+                problems.append(f"bwd({s},{m})@{t} before fwd({s},{m})")
+            if s < n_stages - 1:
+                dep = at.get(("bwd", s + 1, m))
+                if dep is None or dep >= t:
+                    problems.append(
+                        f"bwd({s},{m})@{t} before bwd({s + 1},{m})")
+    return problems
+
+
+def bubble_fraction(n_stages: int, n_micro: int, kind: str = "gpipe"
+                    ) -> float:
+    """Measured idle fraction of the generated table: 1 - busy slots /
+    (ticks * stages).  For both schedules this lands on the analytic
+    GPipe bubble (S-1)/(K+S-1) when fwd and bwd cost one tick each —
+    1F1B buys MEMORY (bounded stash), not bubble, in its non-interleaved
+    form."""
+    ticks = schedule_table(n_stages, n_micro, kind)
+    busy = sum(len(t) for t in ticks)
+    return 1.0 - busy / float(len(ticks) * n_stages)
+
+
+def max_in_flight(n_stages: int, n_micro: int, kind: str = "gpipe") -> int:
+    """Peak stashed micro-batches on any stage (fwd done, bwd pending) —
+    the activation-memory high-water mark the schedules trade on."""
+    ticks = schedule_table(n_stages, n_micro, kind)
+    stash = [0] * n_stages
+    peak = 0
+    for tick in ticks:
+        for s, phase, _ in tick:
+            stash[s] += 1 if phase == "fwd" else -1
+        peak = max(peak, max(stash))
+    return peak

@@ -1,0 +1,487 @@
+"""PipelineProgram: micro-batch pipeline training through the executor.
+
+The multi-program counterpart of Executor.run_accumulated: feed arrays
+carry a leading [K, micro_bs, ...] axis; one train step walks a
+GPipe/1F1B tick table (schedule.py) driving per-stage compiled entries
+— forward with activation stashing, backward with boundary-grad routing,
+then each stage's LOCAL optimizer once on its averaged grads.
+
+The parity contract vs run_accumulated on the unsplit program
+(asserted in tests/test_pipeline.py with dropout on): TRAINING STATE —
+every parameter and optimizer-state update — is BIT-IDENTICAL; the
+fetched loss trajectory agrees to the last ulp.  (The carve-out is a
+measured XLA CPU property: a reduce feeding only a fetched scalar may
+tile differently across separately compiled modules and re-round by one
+ulp on tie values; state never drifts — probed per-gradient.  PERF.md
+round 11.)  The mechanics:
+
+  * micro-batch m's traces use fold_in(step_key, m), the optimizer
+    fold_in(step_key, K) — the exact run_accumulated key schedule; all
+    bundled random ops key on static per-op rng_id attrs, so stage-split
+    traces regenerate the same masks;
+  * per-stage grad accumulation adds micro-batches in 0..K-1 order
+    (both schedules guarantee per-stage mb order) and averages by
+    /float(K), matching the scan in _compile_accumulated;
+  * split_program marks boundary-crossing producers with optimization
+    barriers honored by BOTH compilations, normalizing cut-point reduce
+    association (partition.py).
+
+Runs via exe.run delegation (the ShardedProgram _run-hook pattern):
+
+    pipe = PipelineProgram(prog, feed_names, n_stages=2, schedule="1f1b")
+    losses = exe.run(pipe, feed={...}, fetch_list=[loss], scope=scope)
+
+rw scope state (e.g. BatchNorm running stats) threads through each
+stage's forward in micro-batch order and is donated per call, exactly
+like run_accumulated's scan carry; optimizer rw buffers are donated to
+the per-stage optimizer entries.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...core import executor as exec_mod
+from ...core import framework as fw
+from ...core.executor import prng_key as _prng_key
+from . import schedule as sched_mod
+from .partition import PipelineStage, PipelineStages, split_program
+
+
+def _phase_state(ops, scope, skip_names) -> Tuple[List[str], List[str]]:
+    """(reads, writes) of scope-resident names for an op subset — the
+    per-phase analogue of analyze_block_io."""
+    defined = set(skip_names)
+    reads, writes = [], []
+    seen_r, seen_w = set(), set()
+    for op in ops:
+        for n in op.input_arg_names():
+            if n and n not in defined and n not in seen_r \
+                    and scope.find_var(n) is not None:
+                reads.append(n)
+                seen_r.add(n)
+                defined.add(n)
+        for n in op.output_arg_names():
+            if not n:
+                continue
+            defined.add(n)
+            v = op.block._find_var_recursive(n)
+            if ((v is not None and v.persistable) or scope.has_var(n)) \
+                    and n not in seen_w:
+                writes.append(n)
+                seen_w.add(n)
+    return reads, writes
+
+
+class _StageEntry:
+    """Compiled fwd/bwd/opt callables + their name lists for one stage."""
+
+    __slots__ = ("fwd", "bwd", "opt", "fwd_rw", "fwd_ro", "bwd_ro",
+                 "opt_rw", "opt_ro", "opt_writes", "fwd_fetch",
+                 "bwd_fetch", "opt_fetch")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
+class PipelineProgram:
+    def __init__(
+        self,
+        program: fw.Program,
+        feed_names: Sequence[str],
+        n_stages: int = 2,
+        cut_vars: Optional[Sequence[str]] = None,
+        schedule: str = "gpipe",
+        stages: Optional[PipelineStages] = None,
+        plan=None,
+    ):
+        """plan: optional parallel.sharding.ShardingPlan over dp/tp mesh
+        axes — each stage's compiled entries then carry GSPMD shardings
+        (feeds over the data axis, params by the plan's rules), so the
+        schedule time-multiplexes pp stages over a dp x tp device mesh:
+        the dryrun matrix's dp x tp x pp composition.  Sharded entries
+        skip buffer donation (the scope holds unsharded arrays between
+        steps; donating a to-be-resharded buffer is a copy anyway) and
+        the parity contract relaxes to allclose — collectives reassociate
+        reductions.
+
+        `schedule` is mutable between steps: compiled stage entries are
+        schedule-independent (the tick table is consulted per step), so
+        swapping gpipe <-> 1f1b on one instance reuses every entry."""
+        if schedule not in sched_mod.SCHEDULES:
+            raise ValueError(
+                f"unknown schedule {schedule!r}; one of "
+                f"{sched_mod.SCHEDULES}")
+        self.schedule = schedule
+        self.stages = stages if stages is not None else split_program(
+            program, feed_names, n_stages=n_stages, cut_vars=cut_vars)
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.plan = plan
+        self._mesh = None
+        self._cache: Dict[Any, List[_StageEntry]] = {}
+        self._ref_names = None
+        self._verified = set()
+
+    @property
+    def mesh(self):
+        if self.plan is not None and self._mesh is None:
+            self._mesh = self.plan.build_mesh()
+        return self._mesh
+
+    def _scope_signature(self, scope) -> frozenset:
+        """Which stage-referenced names resolve to a live scope var —
+        part of the compile-cache AND verify keys: _compile_stage bakes
+        the scope-dependent rw/ro state split into the jitted entries,
+        so a differently-populated scope must recompile, not hit a stale
+        entry (the executor's _scope_signature contract, PR 9's memo
+        class)."""
+        if self._ref_names is None:
+            seen = set()
+            for st in self.stages:
+                for op in st.program.global_block().ops:
+                    for n in op.input_arg_names() + op.output_arg_names():
+                        if n:
+                            seen.add(n)
+            self._ref_names = tuple(seen)
+        return frozenset(n for n in self._ref_names
+                         if scope.find_var(n) is not None)
+
+    # -- verification -----------------------------------------------------
+    def _maybe_verify(self, scope, scope_sig):
+        from ...flags import FLAGS
+
+        if scope_sig in self._verified or not FLAGS.verify_program:
+            return
+        from ...analysis import verify_or_raise, verify_program_set
+
+        for st in self.stages:
+            feedish = (st.feeds + [n for n, _, _ in st.fwd_inputs]
+                       + [n for n, _, _ in st.bwd_inputs] + st.bwd_feeds)
+            fetch = ([n for n, _, _ in st.fwd_outputs]
+                     + [n for n, _, _ in st.bwd_outputs])
+            verify_or_raise(st.program, feed_names=feedish,
+                            fetch_names=fetch, scope=scope)
+        findings = verify_program_set(
+            [st.io_summary() for st in self.stages])
+        errors = [f for f in findings if f.severity == "error"]
+        if errors:
+            from ...analysis import ProgramVerifyError
+
+            raise ProgramVerifyError(findings)
+        self._verified.add(scope_sig)
+
+    # -- compile ----------------------------------------------------------
+    def _compile_stage(self, st: PipelineStage, scope, fetch_names):
+        import jax
+
+        block = st.program.global_block()
+        fwd_ops, bwd_ops, opt_ops = st.fwd_ops(), st.bwd_ops(), st.opt_ops()
+        fwd_in_names = [n for n, _, _ in st.fwd_inputs]
+        fwd_out_names = [n for n, _, _ in st.fwd_outputs]
+        bwd_in_names = [n for n, _, _ in st.bwd_inputs]
+        bwd_out_names = [n for n, _, _ in st.bwd_outputs]
+
+        fwd_reads, fwd_writes = _phase_state(
+            fwd_ops, scope, st.feeds + fwd_in_names)
+        fwd_rw = [n for n in fwd_reads if n in set(fwd_writes)]
+        fwd_ro = [n for n in fwd_reads if n not in set(fwd_rw)]
+        # params the grad ops re-read (matmul_grad reads W) ride bwd_ro
+        # from the scope — within a step their value is fwd-time exact
+        bwd_ro, bwd_writes = _phase_state(
+            bwd_ops, scope, st.stash + bwd_in_names + st.bwd_feeds)
+        if bwd_writes:
+            raise NotImplementedError(
+                f"pipeline stage {st.index}: backward ops write scope "
+                f"state {bwd_writes[:4]} — not supported (grads must stay "
+                f"program-local)")
+        opt_reads, opt_writes = _phase_state(
+            opt_ops, scope, st.grad_names)
+        opt_rw = [n for n in opt_reads if n in set(opt_writes)]
+        opt_ro = [n for n in opt_reads if n not in set(opt_rw)]
+        # write-only opt outputs (fresh moment vars) surface too
+        opt_writes = opt_rw + [n for n in opt_writes if n not in set(opt_rw)]
+
+        fwd_fetch = [n for n in fetch_names
+                     if n in st.fetch_candidates
+                     or n in set(st.feeds) | set(fwd_in_names)]
+        bwd_produced = {n for op in bwd_ops
+                        for n in op.output_arg_names() if n}
+        bwd_fetch = [n for n in fetch_names
+                     if n in bwd_produced and n not in set(fwd_fetch)]
+        opt_produced = {n for op in opt_ops
+                        for n in op.output_arg_names() if n}
+        opt_fetch = [n for n in fetch_names
+                     if n in opt_produced
+                     and n not in set(fwd_fetch) | set(bwd_fetch)]
+
+        is_test = getattr(st.program, "_is_test", False)
+
+        def fwd_fn(feed_vals, in_vals, rw_vals, ro_vals, key):
+            tctx = exec_mod.TraceContext(st.program, key, is_test=is_test)
+            env: Dict[str, Any] = {}
+            env.update(zip(st.feeds, feed_vals))
+            env.update(zip(fwd_in_names, in_vals))
+            env.update(zip(fwd_rw, rw_vals))
+            env.update(zip(fwd_ro, ro_vals))
+            exec_mod.trace_block(block, env, tctx, ops=fwd_ops)
+            # fetch values barriered like run_accumulated's (the
+            # association-isolation half of the bit-parity contract)
+            return (
+                [env[n] for n in fwd_out_names],
+                [env[n] for n in st.stash],
+                [jax.lax.optimization_barrier(env[n])
+                 for n in fwd_fetch],
+                [env.get(n, v) for n, v in zip(fwd_rw, rw_vals)],
+            )
+
+        def bwd_fn(stash_vals, gin_vals, bfeed_vals, ro_vals, key):
+            tctx = exec_mod.TraceContext(st.program, key, is_test=is_test)
+            env: Dict[str, Any] = {}
+            env.update(zip(st.stash, stash_vals))
+            env.update(zip(bwd_in_names, gin_vals))
+            env.update(zip(st.bwd_feeds, bfeed_vals))
+            env.update(zip(bwd_ro, ro_vals))
+            exec_mod.trace_block(block, env, tctx, ops=bwd_ops)
+            return (
+                [env[n] for n in bwd_out_names],
+                [env[n] for n in st.grad_names],
+                [jax.lax.optimization_barrier(env[n])
+                 for n in bwd_fetch],
+            )
+
+        def opt_fn(grad_avgs, rw_vals, ro_vals, key):
+            tctx = exec_mod.TraceContext(st.program, key, is_test=is_test)
+            env: Dict[str, Any] = {}
+            env.update(zip(opt_rw, rw_vals))
+            env.update(zip(opt_ro, ro_vals))
+            env.update(zip(st.grad_names, grad_avgs))
+            exec_mod.trace_block(block, env, tctx, ops=opt_ops)
+            return (
+                [env.get(n) for n in opt_writes],
+                [env.get(n) for n in opt_fetch],
+            )
+
+        if self.plan is not None:
+            from jax.sharding import NamedSharding
+
+            mesh = self.mesh
+            params = {p.name for p in st.program.all_parameters()}
+
+            def shard_of(n):
+                v = scope.find_var(n)
+                return NamedSharding(mesh, self.plan.spec_for_param(
+                    n, getattr(v, "shape", None),
+                    is_moment=n not in params))
+
+            feed_sh = [NamedSharding(mesh, self.plan.spec_for_feed(n))
+                       for n in st.feeds]
+            bfeed_sh = [NamedSharding(mesh, self.plan.spec_for_feed(n))
+                        for n in st.bwd_feeds]
+            fwd_jit = jax.jit(fwd_fn, in_shardings=(
+                feed_sh, None, [shard_of(n) for n in fwd_rw],
+                [shard_of(n) for n in fwd_ro], None))
+            bwd_jit = jax.jit(bwd_fn, in_shardings=(
+                None, None, bfeed_sh,
+                [shard_of(n) for n in bwd_ro], None))
+            opt_jit = jax.jit(opt_fn, in_shardings=(
+                None, [shard_of(n) for n in opt_rw],
+                [shard_of(n) for n in opt_ro], None),
+                out_shardings=([shard_of(n) for n in opt_writes],
+                               None)) if opt_ops else None
+            return _StageEntry(
+                fwd=fwd_jit, bwd=bwd_jit, opt=opt_jit,
+                fwd_rw=fwd_rw, fwd_ro=fwd_ro, bwd_ro=bwd_ro,
+                opt_rw=opt_rw, opt_ro=opt_ro, opt_writes=opt_writes,
+                fwd_fetch=fwd_fetch, bwd_fetch=bwd_fetch,
+                opt_fetch=opt_fetch,
+            )
+        return _StageEntry(
+            fwd=jax.jit(fwd_fn, donate_argnums=(2,)),
+            bwd=jax.jit(bwd_fn),
+            opt=jax.jit(opt_fn, donate_argnums=(1,)) if opt_ops else None,
+            fwd_rw=fwd_rw, fwd_ro=fwd_ro, bwd_ro=bwd_ro,
+            opt_rw=opt_rw, opt_ro=opt_ro, opt_writes=opt_writes,
+            fwd_fetch=fwd_fetch, bwd_fetch=bwd_fetch, opt_fetch=opt_fetch,
+        )
+
+    # -- execution (exe.run delegates here) -------------------------------
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        import jax
+
+        feed = feed or {}
+        scope = scope or exec_mod.global_scope()
+        fetch_names = [
+            v.name if isinstance(v, fw.Variable) else v
+            for v in (fetch_list or [])
+        ]
+        if not feed:
+            raise ValueError("PipelineProgram needs a [K, micro_bs, ...] "
+                             "feed to derive the micro-batch count")
+        feed_stack = {
+            n: executor._to_device_array(self.program, n, feed[n])
+            for n in sorted(feed)
+        }
+        k = int(next(iter(feed_stack.values())).shape[0])
+        for n, v in feed_stack.items():
+            if int(v.shape[0]) != k:
+                raise ValueError(
+                    f"feed {n!r} leading dim {v.shape[0]} != micro-batch "
+                    f"count {k}")
+
+        scope_sig = self._scope_signature(scope)
+        self._maybe_verify(scope, scope_sig)
+        key = (k, scope_sig, tuple(sorted(feed_stack)),
+               tuple((tuple(v.shape), str(v.dtype))
+                     for _, v in sorted(feed_stack.items())),
+               tuple(fetch_names))
+        entries = self._cache.get(key)
+        if entries is None:
+            # unresolvable fetches fail loudly before any compile
+            known = set(feed_stack) | {
+                n for st in self.stages
+                for n in (st.fetch_candidates
+                          | {o for op in st.bwd_ops()
+                             for o in op.output_arg_names() if o}
+                          | {o for op in st.opt_ops()
+                             for o in op.output_arg_names() if o})}
+            missing = [n for n in fetch_names if n not in known]
+            if missing:
+                raise KeyError(
+                    f"fetch target(s) {missing} produced by no pipeline "
+                    f"stage (fwd/bwd/optimizer) and covered by no feed")
+            entries = [self._compile_stage(st, scope, fetch_names)
+                       for st in self.stages]
+            self._cache[key] = entries
+
+        S = self.stages.n_stages
+        ticks = sched_mod.schedule_table(S, k, self.schedule)
+
+        # the step key draws the DELEGATING executor's run counter —
+        # run_accumulated on the unsplit program draws the same source,
+        # so trajectories line up call-for-call (bit-parity contract)
+        base_key = jax.random.fold_in(
+            _prng_key(self.program.random_seed or 0),
+            executor._next_run_id())
+        mb_keys = [jax.random.fold_in(base_key, m) for m in range(k)]
+
+        from ...monitor import enabled as _mon_enabled
+
+        mon = _mon_enabled()
+        if mon:
+            from ...monitor import flight as _flight
+        boundary: List[Dict[str, Any]] = [dict() for _ in range(k)]
+        grad_env: List[Dict[str, Any]] = [dict() for _ in range(k)]
+        stash: Dict[Tuple[int, int], list] = {}
+        grad_sums: List[Optional[list]] = [None] * S
+        fetch_store: Dict[Tuple[str, int], Any] = {}
+        rw_vals = [[scope.find_var(n) for n in entries[s].fwd_rw]
+                   for s in range(S)]
+        in_flight = [0] * S
+        peak_in_flight = 0
+
+        for tick in ticks:
+            for s, phase, m in tick:
+                st, en = self.stages.stages[s], entries[s]
+                t0 = time.perf_counter() if mon else 0.0
+                if phase == "fwd":
+                    feeds_m = [feed_stack[n][m] for n in st.feeds]
+                    ins_m = [boundary[m][n]
+                             for n, _, _ in st.fwd_inputs]
+                    ro = [scope.find_var(n) for n in en.fwd_ro]
+                    outs, stvals, fvals, new_rw = en.fwd(
+                        feeds_m, ins_m, rw_vals[s], ro, mb_keys[m])
+                    rw_vals[s] = new_rw
+                    # keep the scope current: the fwd entry donated the
+                    # previous rw buffers, and another phase reading the
+                    # scope must never see a deleted array
+                    for n, v in zip(en.fwd_rw, new_rw):
+                        scope.set_var(n, v)
+                    for (n, _, _), v in zip(st.fwd_outputs, outs):
+                        boundary[m][n] = v
+                    stash[(s, m)] = stvals
+                    for n, v in zip(en.fwd_fetch, fvals):
+                        fetch_store[(n, m)] = v
+                    in_flight[s] += 1
+                    peak_in_flight = max(peak_in_flight, in_flight[s])
+                else:
+                    gins = [grad_env[m][n] for n, _, _ in st.bwd_inputs]
+                    bfeeds = [feed_stack[n][m] for n in st.bwd_feeds]
+                    ro = [scope.find_var(n) for n in en.bwd_ro]
+                    gouts, gvals, bfvals = en.bwd(
+                        stash.pop((s, m)), gins, bfeeds, ro, mb_keys[m])
+                    for (n, _, _), v in zip(st.bwd_outputs, gouts):
+                        grad_env[m][n] = v
+                    # accumulate in micro-batch order: bit-identical to
+                    # run_accumulated's scan (sums0 + g1 + g2 + ...)
+                    if grad_sums[s] is None:
+                        grad_sums[s] = list(gvals)
+                    else:
+                        grad_sums[s] = [a + b for a, b in
+                                        zip(grad_sums[s], gvals)]
+                    for n, v in zip(en.bwd_fetch, bfvals):
+                        fetch_store[(n, m)] = v
+                    in_flight[s] -= 1
+                if mon:
+                    with _flight.context(f"pipeline/{s}"):
+                        _flight.record(
+                            "pipeline.stage", stage=s, phase=phase, mb=m,
+                            t0=t0 + (time.time() - time.perf_counter()),
+                            dur=round(time.perf_counter() - t0, 6))
+
+        # optimizer: once per stage on its averaged local grads, exactly
+        # the run_accumulated suffix (key fold_in(base, K), sums/float(K))
+        opt_key = jax.random.fold_in(base_key, k)
+        for s in range(S):
+            en, st = entries[s], self.stages.stages[s]
+            # final fwd rw writes land before the optimizer (scan-carry
+            # order parity with _compile_accumulated)
+            for n, v in zip(en.fwd_rw, rw_vals[s]):
+                scope.set_var(n, v)
+            if en.opt is None:
+                continue
+            sums = grad_sums[s] or []
+            avgs = [g / float(k) for g in sums]
+            opt_rw_vals = [scope.find_var(n) for n in en.opt_rw]
+            opt_ro_vals = [scope.find_var(n) for n in en.opt_ro]
+            new_state, ofvals = en.opt(avgs, opt_rw_vals, opt_ro_vals,
+                                       opt_key)
+            for n, v in zip(en.opt_writes, new_state):
+                if v is not None:
+                    scope.set_var(n, v)
+            for n, v in zip(en.opt_fetch, ofvals):
+                fetch_store[(n, None)] = v
+
+        if mon:
+            from ... import monitor
+            from ...monitor import flight as _flight
+
+            bf = sched_mod.bubble_fraction(S, k, self.schedule)
+            monitor.gauge("pipeline.bubble_fraction").set(bf)
+            monitor.gauge("pipeline.microbatches_in_flight").set(
+                peak_in_flight)
+            _flight.record("pipeline.schedule", schedule=self.schedule,
+                           n_stages=S, n_micro=k,
+                           bubble_fraction=round(bf, 4),
+                           peak_in_flight=peak_in_flight)
+
+        import jax.numpy as jnp
+
+        outs = []
+        for n in fetch_names:
+            if (n, None) in fetch_store:
+                outs.append(fetch_store[(n, None)])
+            elif (n, 0) in fetch_store:
+                outs.append(jnp.stack([fetch_store[(n, m)]
+                                       for m in range(k)]))
+            elif n in feed_stack:
+                outs.append(feed_stack[n])
+            else:  # pragma: no cover — guarded by the compile-time check
+                raise KeyError(f"fetch target {n!r} not produced")
+        if return_numpy:
+            return [np.asarray(v) for v in outs]
+        return list(outs)

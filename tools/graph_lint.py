@@ -188,6 +188,57 @@ def build_generation():
     return out
 
 
+def build_pipeline():
+    """The pipeline tier's stage-program families (PR-12): transformer-
+    base widths (short seq keeps CI wall time sane) split at pp=2 and
+    pp=4.  Per-stage programs run the FULL verifier below like any other
+    entry; this builder additionally emits precomputed findings entries
+    for the CROSS-stage contract (analysis.verify_program_set — every
+    stage input some earlier/later stage's declared output, optimizer
+    locality) and for the GPipe/1F1B tick-table dependency validation
+    (schedule.validate_schedule), so the CI gate covers all three layers
+    of the subsystem."""
+    import paddle_tpu as pt
+    from paddle_tpu.analysis import verify_program_set
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.parallel.pipeline import (
+        split_program, validate_schedule)
+
+    out = []
+    for pp in (2, 4):
+        # fresh build per pp: boundary-association marks are per-split
+        prog, startup, guard = _fresh()
+        with guard, pt.program_guard(prog, startup):
+            avg_cost, _, feeds = T.transformer(
+                src_vocab_size=2048, trg_vocab_size=2048, max_length=64,
+                n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+                d_inner_hid=2048, dropout_rate=0.1, src_seq_len=64,
+                trg_seq_len=64, use_flash=False)
+            pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+        stages = split_program(prog, feeds, n_stages=pp)
+        for st in stages:
+            feedish = (st.feeds + [n for n, _, _ in st.fwd_inputs]
+                       + [n for n, _, _ in st.bwd_inputs] + st.bwd_feeds)
+            fetch = ([n for n, _, _ in st.fwd_outputs]
+                     + [n for n, _, _ in st.bwd_outputs]
+                     + ([avg_cost.name]
+                        if avg_cost.name in st.fetch_candidates else []))
+            out.append((f"pipeline/pp{pp}-stage{st.index}", st.program,
+                        feedish, fetch, startup if st.index == 0 else None))
+        set_findings = verify_program_set(
+            [st.io_summary() for st in stages])
+        out.append({"name": f"pipeline/pp{pp}-set-contract",
+                    "findings": [f.to_dict() for f in set_findings]})
+        for sched in ("gpipe", "1f1b"):
+            problems = validate_schedule(pp, 8, sched)
+            out.append({
+                "name": f"pipeline/pp{pp}-{sched}-schedule",
+                "findings": [
+                    {"check": "schedule-dependency", "severity": "error",
+                     "message": p} for p in problems]})
+    return out
+
+
 BUILDERS = {
     "mnist": build_mnist,
     "resnet": build_resnet,
@@ -197,6 +248,7 @@ BUILDERS = {
     "seq2seq": build_seq2seq,
     "serving": build_serving,
     "generation": build_generation,
+    "pipeline": build_pipeline,
 }
 
 
@@ -219,7 +271,19 @@ def main(argv=None):
         builder = BUILDERS.get(name.strip())
         if builder is None:
             ap.error(f"unknown model {name!r}")
-        for prog_name, prog, feeds, fetch, startup in builder():
+        for built in builder():
+            if isinstance(built, dict):
+                # precomputed findings (cross-program set contracts,
+                # schedule validation) — reported like program entries
+                report["programs"].append(built)
+                n = len(built["findings"])
+                n_findings += n
+                status = "clean" if not n else f"{n} finding(s)"
+                print(f"graph_lint: {built['name']:<28} {'':>9} {status}")
+                for f in built["findings"]:
+                    print(f"  {f}")
+                continue
+            prog_name, prog, feeds, fetch, startup = built
             findings = verify_program(prog, feed_names=feeds,
                                       fetch_names=fetch, check_dead=True)
             if startup is not None:

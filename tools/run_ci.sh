@@ -130,6 +130,43 @@ print("decode A/B records OK:", [(r["config"]["kv_cache"], r["metric"],
                                   r["value"]) for r in recs])
 PY
   echo "-- decode A/B record artifact: ci_artifacts/bench_decode_smoke.json"
+  # Pipeline-parallel leg (PERF.md r11): pp=2 GPipe vs 1F1B vs single-
+  # program run_accumulated on the CPU mesh — every pipeline record must
+  # carry state_bit_parity=true (training state may not drift a BIT from
+  # the unsplit program) and a fetched-loss trajectory within 1 ulp;
+  # bench.py itself raises if they do not, this check keeps the archived
+  # artifact honest
+  python -W error::UserWarning bench.py --model transformer --pp 2 \
+    --smoke | tee ci_artifacts/bench_pipeline_smoke.json
+  # transformer-BASE widths (d_model 512, 6 layers; short seq), pp=2 AND
+  # pp=4, dropout ON — the base-width pipeline parity gates
+  python -W error::UserWarning bench.py --model transformer --pp 2 \
+    | tee -a ci_artifacts/bench_pipeline_smoke.json
+  python -W error::UserWarning bench.py --model transformer --pp 4 \
+    | tee -a ci_artifacts/bench_pipeline_smoke.json
+  python - <<'PY'
+import json
+recs = [json.loads(l) for l in open("ci_artifacts/bench_pipeline_smoke.json")
+        if l.strip().startswith("{")]
+recs = [r for r in recs if r.get("metric", "").startswith("transformer_pp")]
+groups = {}
+for r in recs:
+    groups.setdefault((r["config"]["pp"], r["config"]["tiny"]),
+                      set()).add(r["config"]["schedule"])
+assert (2, True) in groups and (2, False) in groups \
+    and (4, False) in groups, f"missing pipeline legs: {sorted(groups)}"
+for g, scheds in groups.items():
+    assert scheds == {"single", "gpipe", "1f1b"}, (g, scheds)
+bad = [r["metric"] for r in recs
+       if r["config"]["schedule"] != "single"
+       and (r["config"]["state_bit_parity"] is not True
+            or r["config"]["loss_max_rel_diff"] > 3e-7)]
+assert not bad, f"pipeline schedules lost parity: {bad}"
+print("pipeline records OK:",
+      [(r["config"]["pp"], r["config"]["tiny"], r["config"]["schedule"],
+        r["value"]) for r in recs])
+PY
+  echo "-- pipeline A/B record artifact: ci_artifacts/bench_pipeline_smoke.json"
   # Copy census (PERF.md r09 attribution artifact): the automated
   # while-body copy-byte attribution on the smoke transformer, fused vs
   # unfused — tests assert the projection-site collapse; CI archives the

@@ -111,6 +111,25 @@ def watchdog_trips(doc: dict):
             if ev.get("kind") == "watchdog.trip"]
 
 
+def pipeline_stages(doc: dict):
+    """Per-stage span aggregation + the last schedule summary from the
+    pipeline tier's flight events (parallel/pipeline/trainer.py:
+    `pipeline.stage` spans carry ctx `pipeline/<stage>`;
+    `pipeline.schedule` carries bubble-fraction / in-flight gauges)."""
+    stages = defaultdict(lambda: defaultdict(lambda: [0.0, 0]))
+    sched = None
+    for ev in doc.get("flight", {}).get("events", []):
+        if ev.get("kind") == "pipeline.stage":
+            agg = stages[ev.get("ctx", f"pipeline/{ev.get('stage')}")]
+            a = agg[ev.get("phase", "?")]
+            a[0] += float(ev.get("dur", 0.0))
+            a[1] += 1
+        elif ev.get("kind") == "pipeline.schedule":
+            sched = ev
+    return {k: {p: tuple(v) for p, v in d.items()}
+            for k, d in stages.items()}, sched
+
+
 def embedding_census(doc: dict):
     """Last sparse-tier trace census (gather launches / rows touched per
     step — the embedding.* gauges, mirrored into the flight ring at
@@ -170,6 +189,23 @@ def report(doc: dict, k: int = 20) -> str:
         lines.append(f"  gather launches      {census.get('gather_launches')}")
         lines.append(
             f"  sparse rows touched  {census.get('sparse_rows_touched')}")
+
+    stages, sched = pipeline_stages(doc)
+    if stages or sched:
+        lines.append("")
+        lines.append("Pipeline stages (flight spans)")
+        if sched:
+            lines.append(
+                f"  schedule {sched.get('schedule')}: "
+                f"{sched.get('n_stages')} stages x "
+                f"{sched.get('n_micro')} micro-batches, bubble fraction "
+                f"{sched.get('bubble_fraction')}, peak in-flight "
+                f"{sched.get('peak_in_flight')}")
+        for ctx in sorted(stages):
+            parts = ", ".join(
+                f"{p}: {t:.4f}s/{c}" for p, (t, c) in
+                sorted(stages[ctx].items()))
+            lines.append(f"  {ctx:<16} {parts}")
 
     trips = watchdog_trips(doc)
     if trips:
