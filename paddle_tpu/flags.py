@@ -268,6 +268,39 @@ FLAGS.define(
     "most this many ms after arrival even if its batch is not full "
     "(latency/fill tradeoff knob of the batching policy)")
 FLAGS.define(
+    "serving_max_queue_depth", int, 128,
+    "admission control: a model's batcher sheds new requests (HTTP 429 "
+    "with a Retry-After derived from the observed queue-latency EWMA, "
+    "serving.<model>.shed_total counter) once this many requests are "
+    "already queued ahead of them; the generation tier bounds its "
+    "slot wait-queue the same way.  0 = unbounded queues (the pre-"
+    "admission-control behavior: under overload, queue latency grows "
+    "without bound and every request times out)")
+FLAGS.define(
+    "serving_max_inflight", int, 0,
+    "server-level cap on concurrently admitted requests across ALL "
+    "models of one InferenceServer (predict + generate); at the cap new "
+    "requests shed with HTTP 429 + Retry-After.  0 = uncapped")
+FLAGS.define(
+    "serving_drain_timeout_s", float, 10.0,
+    "graceful-drain budget: on SIGTERM the serving CLI flips /health to "
+    "'draining' (503), rejects new requests with 503, lets in-flight "
+    "and queued-admitted work complete up to this many seconds, dumps "
+    "the flight recorder (trigger 'drain'), and exits 0")
+FLAGS.define(
+    "serving_breaker_threshold", int, 5,
+    "per-model circuit breaker: this many CONSECUTIVE batch-execution "
+    "failures open the breaker — submits fail fast with HTTP 503 "
+    "(serving.<model>.breaker_state gauge: 0 closed / 1 open / 2 half-"
+    "open) instead of queueing against a broken executor; after "
+    "FLAGS_serving_breaker_cooldown_s ONE half-open probe is admitted "
+    "and its outcome closes or re-opens the breaker.  0 disables "
+    "(every request reaches the executor, the pre-breaker behavior)")
+FLAGS.define(
+    "serving_breaker_cooldown_s", float, 5.0,
+    "how long an open circuit breaker rejects before admitting its "
+    "half-open probe request")
+FLAGS.define(
     "serving_cache_dir", str, "",
     "persistent XLA compilation-cache directory for the inference server "
     "(jax compilation cache): warmup compiles of the bucket ladder are "
@@ -334,3 +367,20 @@ FLAGS.define(
     "chaos_nan_at_step", int, -1,
     "training loops report a NaN loss at this step (watchdog fodder); "
     "-1 disables")
+FLAGS.define(
+    "chaos_serve_latency_s", float, 0.0,
+    "sleep injected into every serving batch execution / generation "
+    "decode step (chaos.maybe_serve_latency — a slow-executor "
+    "simulation that pins serving capacity so the CI overload gate is "
+    "box-independent); 0 disables")
+FLAGS.define(
+    "chaos_serve_errors", int, 0,
+    "the first K serving batch executions raise a transient "
+    "RuntimeError (chaos.maybe_serve_error — circuit-breaker fodder; "
+    "the budget is process-global and deterministic); 0 disables")
+FLAGS.define(
+    "chaos_serve_flood", int, 0,
+    "request-flood burst: the FIRST admitted serving request after "
+    "arming additionally fires this many synthetic duplicate requests "
+    "at its own model (chaos.serve_flood — deterministic queue-pressure "
+    "spike); 0 disables")

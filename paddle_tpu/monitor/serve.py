@@ -80,7 +80,18 @@ def health_body():
             serving = {"ready": False,
                        "error": f"{type(e).__name__}: {e}"}
         not_ready = not (serving or {}).get("ready", False)
+    # robustness statuses the serving tier reports through the provider:
+    # "draining" (graceful SIGTERM drain — load balancers stop sending
+    # while in-flight work completes) and "scheduler_dead" (a batcher's
+    # scheduler thread died: the server LOOKS healthy but would time out
+    # every request — the liveness probe must evict it)
+    draining = bool((serving or {}).get("draining"))
+    scheduler_dead = bool((serving or {}).get("scheduler_dead"))
+    # scheduler_dead outranks draining: a dead scheduler can never finish
+    # a drain (its queue never empties) — the probe must evict, not wait
     status = ("stalled" if stalled
+              else "scheduler_dead" if scheduler_dead
+              else "draining" if draining
               else "not_ready" if not_ready else "ok")
     body = {
         "status": status,
@@ -111,11 +122,14 @@ class MonitorHandler(BaseHTTPRequestHandler):
 
         vlog(2, "monitor.serve: " + fmt, *args)
 
-    def _send(self, code: int, body: str, ctype: str = "text/plain"):
+    def _send(self, code: int, body: str, ctype: str = "text/plain",
+              extra_headers=None):
         data = body.encode()
         self.send_response(code)
         self.send_header("Content-Type", ctype + "; charset=utf-8")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 

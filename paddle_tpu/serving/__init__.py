@@ -25,13 +25,28 @@ A fourth layer serves autoregressive generation (ROADMAP item 2):
     retire their cache slot, and nothing ever retraces.  Endpoint:
     POST /v1/models/<name>:generate.
 
+The tier is overload-hardened (ISSUE 13): bounded queues + in-flight
+cap shed with 429/Retry-After, request deadlines propagate into the
+schedulers (expired work is dropped before dispatch), SIGTERM drains
+gracefully (503 new work, finish admitted work, dump flight, exit 0),
+a per-model circuit breaker fails fast past consecutive executor
+failures, and /health reports `draining` / `scheduler_dead`.  Chaos
+kinds in testing/chaos.py (serve latency / transient executor errors /
+request flood) drive the CI overload gate.
+
 CLI: `python -m paddle_tpu.serving --model name=/path/to/export ...`
      (add `--demo-generation NAME` for the seeded tiny generation model)
 Load test: `python tools/loadgen.py --url http://host:port --model name`
            (`--generate` for prompt-in/tokens-out TTFT + tokens/sec).
 """
 
-from .batcher import DynamicBatcher, FILL_BUCKETS  # noqa: F401
+from .batcher import (  # noqa: F401
+    CircuitBreaker,
+    DynamicBatcher,
+    FILL_BUCKETS,
+    Overloaded,
+    Unavailable,
+)
 from .generation import (  # noqa: F401
     ContinuousBatcher,
     GenerationConfig,

@@ -7,6 +7,13 @@ machine-readable ready line to stdout —
 
 — then serves until SIGTERM/SIGINT (the CI gate and subprocess tests
 parse the ready line for the ephemeral port).
+
+SIGTERM triggers a GRACEFUL DRAIN (the load-balancer contract): /health
+flips to "draining" (503 — LBs stop sending), new requests get 503,
+in-flight and queued-admitted work completes up to
+FLAGS_serving_drain_timeout_s, the flight recorder dumps with trigger
+"drain", and the process exits 0.  SIGINT stops immediately (interactive
+use).
 """
 
 from __future__ import annotations
@@ -102,8 +109,10 @@ def main(argv=None) -> int:
     }), flush=True)
 
     done = threading.Event()
+    sigs = []
 
     def _shutdown(signum, frame):
+        sigs.append(signum)
         done.set()
 
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -114,7 +123,17 @@ def main(argv=None) -> int:
     try:
         done.wait()
     finally:
-        server.stop()
+        if sigs and sigs[0] == signal.SIGTERM:
+            # graceful drain: readiness -> draining, new requests 503,
+            # admitted work completes (bounded), flight dump, exit 0
+            from paddle_tpu.monitor import flight
+
+            drained = server.drain()
+            flight.record("serving.drain_complete", drained=drained)
+            flight.dump(trigger="drain",
+                        extra={"drained": drained, "signal": "SIGTERM"})
+        else:
+            server.stop()
     return 0
 
 

@@ -19,11 +19,33 @@ Policy knobs (per model, flag defaults): bucket ladder, max_batch rows,
 max_wait deadline.  Observability: queue-latency + batch-fill histograms,
 per-model in-flight gauge and request/row counters, all in the PR-1
 registry.
+
+Overload hardening (the robustness tier):
+
+  * admission control — the queue is BOUNDED (FLAGS_serving_max_queue_depth);
+    at the bound `submit()` fails fast with `Overloaded` (HTTP 429) carrying
+    a Retry-After derived from the observed queue-latency EWMA, instead of
+    letting queue latency grow without bound until every request times out;
+  * deadline propagation — each request carries `deadline` (its client
+    timeout_s); the scheduler drops already-expired requests BEFORE forming
+    a batch (`expired_dropped_total`, never dispatched to the executor), so
+    an overloaded device never burns time computing answers nobody waits for;
+  * circuit breaker — FLAGS_serving_breaker_threshold consecutive batch
+    failures open the per-model breaker: submits fail fast with
+    `Unavailable` (HTTP 503) until a half-open probe succeeds;
+  * graceful drain — `drain()` stops admission and waits for queued-admitted
+    work; `stop()` fails whatever is still queued with a NAMED 503
+    (`Unavailable`) even when the scheduler thread already died;
+  * scheduler hardening — an exception escaping the batch-forming path
+    fails that group and keeps the loop alive (counted
+    `scheduler_restarts`, fatal flight event); `scheduler_alive` feeds the
+    /health `scheduler_dead` probe for the truly unrecoverable case.
 """
 
 from __future__ import annotations
 
 import collections
+import math
 import queue
 import threading
 import time
@@ -31,6 +53,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..flags import FLAGS
 from .model import ServingModel, item_signature
 
 # batch-fill is a fraction of the executed bucket: fixed 0..1 ladder
@@ -39,16 +62,191 @@ FILL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 _STOP = object()
 
 
+class _ServingRejection(RuntimeError):
+    """Base of the fail-fast rejections: carries the machine-readable
+    `reason` and the Retry-After contract (`retry_after_s` float +
+    the integer-delta-seconds HTTP header form)."""
+
+    def __init__(self, message: str,
+                 retry_after_s: Optional[float] = None,
+                 reason: str = "rejected"):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.reason = reason
+
+    @property
+    def retry_after_header(self) -> Optional[str]:
+        """HTTP Retry-After is integer delta-seconds; the JSON body
+        carries the sub-second `retry_after_s` for latency-sensitive
+        clients (tools/loadgen.py honors the body value).  None when no
+        hint applies."""
+        if not self.retry_after_s:
+            return None
+        return str(max(1, int(math.ceil(self.retry_after_s))))
+
+
+class Overloaded(_ServingRejection):
+    """Admission control rejected the request — HTTP 429 with a
+    Retry-After.  `retry_after_s` is derived from the shedding batcher's
+    observed queue-latency EWMA (how long a retry would realistically
+    wait right now); `reason` names the saturated resource
+    (queue_depth / inflight_cap / gen_queue_depth)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 reason: str = "overloaded"):
+        super().__init__(message, retry_after_s=float(retry_after_s),
+                         reason=reason)
+
+
+class Unavailable(_ServingRejection):
+    """Named fail-fast rejection — HTTP 503: the server is draining, the
+    batcher stopped, or the model's circuit breaker is open.  Unlike a
+    crash-500, a 503 tells load balancers/clients the condition is
+    intentional and retryable elsewhere/later."""
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None,
+                 reason: str = "unavailable"):
+        super().__init__(message, retry_after_s=retry_after_s,
+                         reason=reason)
+
+
+def _record_shed(counter_name: str, reason: str, retry_after_s: float,
+                 **flight_fields) -> None:
+    """Shared shed telemetry (dynamic batcher / generation wait-queue /
+    server in-flight cap): the named counter + the aggregate
+    serving.shed_total + one serving.shed flight event, all no-ops with
+    FLAGS.monitor off."""
+    from .. import monitor
+    from ..monitor import flight
+
+    if monitor.enabled():
+        monitor.counter(counter_name).inc()
+        monitor.counter("serving.shed_total").inc()
+    flight.record("serving.shed", reason=reason,
+                  retry_after_s=round(retry_after_s, 4), **flight_fields)
+
+
+def _fail_waiters(q: "queue.Queue", pending, message: str) -> None:
+    """Fail every request still in `pending` (a deque) or `q` with the
+    NAMED 503 and set their events — the shared stop()/scheduler-death
+    drain of both batcher kinds (no waiter ever rides out its full
+    client timeout against a stopped scheduler)."""
+    leftovers = list(pending)
+    pending.clear()
+    while True:
+        try:
+            r = q.get_nowait()
+        except queue.Empty:
+            break
+        if r is not _STOP:
+            leftovers.append(r)
+    for r in leftovers:
+        r.error = Unavailable(message, reason="stopped")
+        r.event.set()
+
+
+class CircuitBreaker:
+    """Per-model executor-failure breaker: CLOSED until
+    FLAGS_serving_breaker_threshold CONSECUTIVE batch executions fail,
+    then OPEN (allow() is False — submits fail fast with 503 instead of
+    queueing against a broken executor) for
+    FLAGS_serving_breaker_cooldown_s, then HALF-OPEN: exactly ONE probe
+    request is admitted; its success closes the breaker, its failure
+    re-opens it.  Threshold 0 disables — allow() is always True and the
+    only cost is one flag read.  The `serving.<name>.breaker_state`
+    gauge (0 closed / 1 open / 2 half-open) tracks transitions while
+    FLAGS.monitor is on."""
+
+    CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self._probe_started = 0.0
+
+    @property
+    def state(self) -> int:
+        return self._state
+
+    def _transition(self, state: int) -> None:
+        from .. import monitor
+
+        self._state = state
+        if monitor.enabled():
+            monitor.gauge(f"serving.{self.name}.breaker_state").set(state)
+
+    def allow(self) -> bool:
+        if FLAGS.serving_breaker_threshold <= 0:
+            return True
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            now = time.monotonic()
+            if self._state == self.OPEN:
+                if (now - self._opened_at
+                        < FLAGS.serving_breaker_cooldown_s):
+                    return False
+                self._transition(self.HALF_OPEN)
+                self._probing = False
+            # HALF_OPEN: admit one in-flight probe at a time.  The slot
+            # RECLAIMS after a cooldown: a probe that never reached the
+            # executor (shed by admission, dropped expired, killed by a
+            # batch-forming crash) must not wedge the breaker half-open
+            # forever — the next caller becomes the probe instead.
+            if (self._probing
+                    and now - self._probe_started
+                    < FLAGS.serving_breaker_cooldown_s):
+                return False
+            self._probing = True
+            self._probe_started = now
+            return True
+
+    def record_success(self) -> None:
+        if FLAGS.serving_breaker_threshold <= 0:
+            return
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            if self._state != self.CLOSED:
+                self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        threshold = FLAGS.serving_breaker_threshold
+        if threshold <= 0:
+            return
+        with self._lock:
+            self._failures += 1
+            probe_failed = self._probing and self._state == self.HALF_OPEN
+            self._probing = False
+            if probe_failed or self._failures >= threshold:
+                self._opened_at = time.monotonic()
+                if self._state != self.OPEN:
+                    from ..monitor import flight
+
+                    flight.record("serving.breaker_open", model=self.name,
+                                  consecutive_failures=self._failures)
+                    self._transition(self.OPEN)
+
+
 class _Request:
     __slots__ = ("feed", "rows", "sig", "precision", "t_enqueue",
-                 "event", "outputs", "meta", "error")
+                 "deadline", "event", "outputs", "meta", "error")
 
-    def __init__(self, feed, rows, sig, precision):
+    def __init__(self, feed, rows, sig, precision, timeout=None):
         self.feed = feed
         self.rows = rows
         self.sig = sig
         self.precision = precision
         self.t_enqueue = time.perf_counter()
+        # the client abandons the wait at t_enqueue + timeout; past that
+        # point executing the request only burns device time under the
+        # very overload that made it late — the scheduler drops it
+        self.deadline = (None if timeout is None
+                         else self.t_enqueue + float(timeout))
         self.event = threading.Event()
         self.outputs = None
         self.meta = None
@@ -73,25 +271,79 @@ class DynamicBatcher:
         self._spill: "collections.deque" = collections.deque()
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        self._draining = False
+        # scheduler-thread-written, submit-side-read (GIL-atomic floats):
+        # the queue-latency EWMA behind Retry-After, and the busy flag
+        # drain() polls alongside the queue
+        self._queue_ewma_s = 0.0
+        self._busy = False
+        self.breaker = CircuitBreaker(model.name)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
         if self._running:
             return
         self._running = True
+        self._draining = False
         self._thread = threading.Thread(
             target=self._loop, name=f"serving-batcher-{self.model.name}",
             daemon=True)
         self._thread.start()
 
     def stop(self, timeout: float = 5.0) -> None:
-        if not self._running:
-            return
-        self._running = False
-        self._queue.put(_STOP)
+        if self._running:
+            self._running = False
+            self._queue.put(_STOP)
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+        # belt and braces: the scheduler's own finally-drain covers the
+        # normal path, but a dead scheduler (or one that never started)
+        # leaves queued waiters riding out their full client timeout —
+        # fail them NOW with the named 503
+        self._fail_pending()
+
+    def begin_drain(self) -> None:
+        """Stop admitting: every subsequent submit gets Unavailable
+        (HTTP 503).  Queued-admitted and in-flight work still runs."""
+        self._draining = True
+
+    def drain(self, timeout: float) -> bool:
+        """begin_drain(), then wait (bounded by `timeout` seconds) until
+        the queue, spill and in-flight batch are all empty; returns True
+        when fully drained inside the budget."""
+        self.begin_drain()
+        t_end = time.monotonic() + max(0.0, timeout)
+        while True:
+            idle = self._idle()
+            if idle:
+                time.sleep(0.02)  # re-confirm across the pop hand-off
+                idle = self._idle()
+            if idle or time.monotonic() >= t_end:
+                return idle
+            time.sleep(0.02)
+
+    def _idle(self) -> bool:
+        """Nothing queued, spilled, or popped-but-unexecuted.  drain()
+        samples this TWICE (the pop->_busy hand-off in _take is two
+        instructions wide) before trusting it."""
+        return (self._queue.qsize() == 0 and not self._spill
+                and not self._busy)
+
+    @property
+    def scheduler_alive(self) -> bool:
+        """False only when the batcher SHOULD be running but its
+        scheduler thread died (a BaseException escaped the hardened
+        loop) — the /health `scheduler_dead` probe."""
+        if not self._running:
+            return True
+        return self._thread is not None and self._thread.is_alive()
+
+    def _fail_pending(self) -> None:
+        """Fail everything still queued/spilled with the named 503
+        (satellite: stop-with-queued-requests)."""
+        _fail_waiters(self._queue, self._spill,
+                      f"serving batcher for {self.model.name!r} stopped")
 
     # -- client side -----------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
@@ -123,7 +375,32 @@ class DynamicBatcher:
         (n_rows,) = rows
         if n_rows == 0:
             raise ValueError("empty batch (0 rows)")
-        req = _Request(feed, n_rows, item_signature(feed), precision)
+        # -- admission control (after validation: a malformed request is
+        # a 4xx, not a shed) ---------------------------------------------
+        if self._draining:
+            raise Unavailable(
+                f"model {self.model.name!r} is draining", reason="draining")
+        # queue depth BEFORE the breaker: a shed must not consume the
+        # breaker's half-open probe slot (the probe should only be
+        # admitted when it can actually reach the executor)
+        depth = FLAGS.serving_max_queue_depth
+        if depth > 0 and self._queue.qsize() + len(self._spill) >= depth:
+            self._shed("queue_depth",
+                       f"model {self.model.name!r}: request queue full "
+                       f"({depth} queued)")
+        if not self.breaker.allow():
+            if monitor.enabled():
+                monitor.counter(
+                    f"serving.{self.model.name}.breaker_rejected_total"
+                ).inc()
+            raise Unavailable(
+                f"model {self.model.name!r}: circuit breaker open "
+                f"({FLAGS.serving_breaker_threshold} consecutive executor "
+                "failures; half-open probe pending)",
+                retry_after_s=FLAGS.serving_breaker_cooldown_s,
+                reason="breaker_open")
+        req = _Request(feed, n_rows, item_signature(feed), precision,
+                       timeout=timeout)
 
         mon = monitor.enabled()
         inflight = (monitor.gauge(f"serving.{self.model.name}.inflight")
@@ -159,72 +436,153 @@ class DynamicBatcher:
             monitor.histogram("serving.request_seconds").observe(dt)
         return req.outputs, req.meta
 
+    def retry_after(self) -> float:
+        """Suggested client back-off for a shed: ~2x the observed
+        queue-latency EWMA (what a retry would realistically wait right
+        now), floored at the batch max-wait, capped at 30s."""
+        return min(30.0, max(self.max_wait_s, 2.0 * self._queue_ewma_s,
+                             0.05))
+
+    def _shed(self, reason: str, message: str) -> None:
+        """Count + flight-tag one shed admission, then raise Overloaded
+        (HTTP 429 + Retry-After)."""
+        ra = self.retry_after()
+        _record_shed(f"serving.{self.model.name}.shed_total", reason, ra,
+                     model=self.model.name)
+        raise Overloaded(message, retry_after_s=ra, reason=reason)
+
     # -- scheduler side --------------------------------------------------
     def _take(self, timeout: float):
         """Next pending request: spilled (incompatible last round) first,
-        then the shared queue.  timeout <= 0 means poll (non-blocking)."""
+        then the shared queue.  timeout <= 0 means poll (non-blocking).
+        A popped request flips `_busy` IMMEDIATELY — it is out of the
+        queue but not yet executed, and drain()'s idle check must not
+        mistake that hand-off window for 'fully drained'."""
         if self._spill:
+            self._busy = True
             return self._spill.popleft()
         try:
             if timeout <= 0:
-                return self._queue.get_nowait()
-            return self._queue.get(timeout=timeout)
+                r = self._queue.get_nowait()
+            else:
+                r = self._queue.get(timeout=timeout)
         except queue.Empty:
             return None
+        if r is not _STOP:
+            self._busy = True
+        return r
+
+    def _take_live(self, timeout: float):
+        """_take, dropping requests whose deadline already passed — they
+        are counted (`expired_dropped_total`) and NEVER dispatched: under
+        the overload that made them late, executing them would spend
+        device time on answers nobody is waiting for."""
+        t_end = (time.perf_counter() + timeout) if timeout > 0 else None
+        while True:
+            r = self._take(timeout)
+            if (r is None or r is _STOP
+                    or r.deadline is None
+                    or time.perf_counter() < r.deadline):
+                return r
+            self._drop_expired(r)
+            if t_end is not None:
+                # the block budget is a deadline, not per-attempt: after
+                # draining an expired request, only the remainder blocks
+                timeout = max(0.0, t_end - time.perf_counter())
+
+    def _drop_expired(self, r) -> None:
+        from .. import monitor
+        from ..monitor import flight
+
+        r.error = TimeoutError(
+            f"request expired before dispatch (deadline passed while "
+            f"queued; model {self.model.name!r})")
+        r.event.set()
+        if monitor.enabled():
+            monitor.counter(
+                f"serving.{self.model.name}.expired_dropped_total").inc()
+            monitor.counter("serving.expired_dropped_total").inc()
+        flight.record("serving.expired_dropped", model=self.model.name,
+                      queued_s=round(time.perf_counter() - r.t_enqueue, 4))
+
+    def _collect(self, first, group) -> int:
+        """Coalesce compatible pending requests behind `first` up to
+        max_batch / the first request's max-wait deadline; returns total
+        rows.  Incompatible requests spill to the next round."""
+        rows = first.rows
+        # the max-wait deadline bounds a request's QUEUE time; under
+        # saturation it is often already past when the scheduler gets
+        # here (the request aged while the previous batch executed) —
+        # so pending requests always drain for free (poll), and the
+        # scheduler only BLOCKS for stragglers while under deadline
+        # with an unfilled batch
+        deadline = first.t_enqueue + self.max_wait_s
+        defer = []
+        while rows < self.max_batch:
+            nxt = self._take_live(0.0)
+            if nxt is None:
+                rem = deadline - time.perf_counter()
+                if rem <= 0:
+                    break
+                nxt = self._take_live(rem)
+                if nxt is None:
+                    break
+            if nxt is _STOP:
+                self._running = False
+                break
+            if (nxt.precision == first.precision
+                    and nxt.sig == first.sig
+                    and rows + nxt.rows <= self.max_batch):
+                group.append(nxt)
+                rows += nxt.rows
+            else:
+                defer.append(nxt)
+        # deferred requests lead the next round, in arrival order
+        self._spill.extendleft(reversed(defer))
+        return rows
 
     def _loop(self) -> None:
-        while self._running:
-            first = self._take(0.1)
-            if first is None:
-                continue
-            if first is _STOP:
-                break
-            group = [first]
-            rows = first.rows
-            # the max-wait deadline bounds a request's QUEUE time; under
-            # saturation it is often already past when the scheduler gets
-            # here (the request aged while the previous batch executed) —
-            # so pending requests always drain for free (poll), and the
-            # scheduler only BLOCKS for stragglers while under deadline
-            # with an unfilled batch
-            deadline = first.t_enqueue + self.max_wait_s
-            defer = []
-            while rows < self.max_batch:
-                nxt = self._take(0.0)
-                if nxt is None:
-                    rem = deadline - time.perf_counter()
-                    if rem <= 0:
+        try:
+            while self._running:
+                first = self._take_live(0.1)
+                if first is None or first is _STOP:
+                    # an expired-drop round may have flipped _busy: the
+                    # dropped request completed (error set), nothing is
+                    # pending execution
+                    self._busy = False
+                    if first is _STOP:
                         break
-                    nxt = self._take(rem)
-                    if nxt is None:
-                        break
-                if nxt is _STOP:
-                    self._running = False
-                    break
-                if (nxt.precision == first.precision
-                        and nxt.sig == first.sig
-                        and rows + nxt.rows <= self.max_batch):
-                    group.append(nxt)
-                    rows += nxt.rows
-                else:
-                    defer.append(nxt)
-            # deferred requests lead the next round, in arrival order
-            self._spill.extendleft(reversed(defer))
-            self._execute(group, rows)
-        # drain: fail whatever is still queued so no caller hangs
-        leftovers = list(self._spill)
-        self._spill.clear()
-        while True:
-            try:
-                r = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if r is not _STOP:
-                leftovers.append(r)
-        for r in leftovers:
-            r.error = RuntimeError(
-                f"serving batcher for {self.model.name!r} stopped")
-            r.event.set()
+                    continue
+                group = [first]
+                try:
+                    rows = self._collect(first, group)
+                    self._execute(group, rows)
+                except Exception as e:  # noqa: BLE001 — a scheduler
+                    # crash would strand every current AND future
+                    # caller behind a healthy-looking server: fail this
+                    # round's requests, record the fatal event, keep
+                    # the loop alive
+                    for r in group:
+                        r.error = e
+                        r.event.set()
+                    self._note_scheduler_error(e)
+                finally:
+                    self._busy = False
+        finally:
+            # fail whatever is still queued so no caller hangs — in a
+            # finally so even a BaseException escape drains its callers
+            self._fail_pending()
+
+    def _note_scheduler_error(self, exc: Exception) -> None:
+        from .. import monitor
+        from ..monitor import flight
+
+        flight.record("serving.scheduler_error", model=self.model.name,
+                      fatal=True,
+                      error=f"{type(exc).__name__}: {exc}")
+        if monitor.enabled():
+            monitor.counter(
+                f"serving.{self.model.name}.scheduler_restarts").inc()
 
     def _execute(self, group, rows: int) -> None:
         from .. import monitor
@@ -232,6 +590,10 @@ class DynamicBatcher:
         model = self.model
         mon = monitor.enabled()
         t_start = time.perf_counter()
+        # queue-latency EWMA (scheduler-thread-only write): the basis of
+        # the Retry-After a shed response suggests
+        self._queue_ewma_s += 0.2 * (
+            max(t_start - r.t_enqueue for r in group) - self._queue_ewma_s)
         if mon:
             qh = monitor.histogram(
                 f"serving.{model.name}.queue_seconds")
@@ -255,12 +617,14 @@ class DynamicBatcher:
             outs = model.run_batch(group[0].precision, feed, rows, bucket,
                                    group[0].sig)
         except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+            self.breaker.record_failure()
             for r in group:
                 r.error = e
                 r.event.set()
             if mon:
                 monitor.counter(f"serving.{model.name}.batch_errors").inc()
             return
+        self.breaker.record_success()
         if mon:
             monitor.counter(f"serving.{model.name}.batches").inc()
             monitor.counter(f"serving.{model.name}.padded_rows").inc(

@@ -36,12 +36,14 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..flags import FLAGS
+from .batcher import (_STOP, _fail_waiters, _record_shed, CircuitBreaker,
+                      Overloaded, Unavailable)
+
 # TTFT is dominated by queue wait + one prefill + one decode step: a
 # finer-than-default ladder at the low end keeps p50 informative
 TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                 1.0, 2.5, 5.0, 10.0, 30.0)
-
-_STOP = object()
 
 
 class GenerationConfig:
@@ -66,13 +68,19 @@ class GenerationConfig:
 
 
 class _GenRequest:
-    __slots__ = ("prompt", "max_tokens", "t_enqueue", "t_first_token",
-                 "event", "tokens", "error", "meta", "cancelled")
+    __slots__ = ("prompt", "max_tokens", "t_enqueue", "deadline",
+                 "t_first_token", "event", "tokens", "error", "meta",
+                 "cancelled")
 
-    def __init__(self, prompt, max_tokens):
+    def __init__(self, prompt, max_tokens, timeout=None):
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.t_enqueue = time.perf_counter()
+        # the scheduler-side mirror of the client timeout: expired
+        # requests never admit, and an expired SLOT retires at the next
+        # iteration boundary even if the client thread is gone
+        self.deadline = (None if timeout is None
+                         else self.t_enqueue + float(timeout))
         self.t_first_token = None
         self.event = threading.Event()
         self.tokens: List[int] = []
@@ -179,6 +187,14 @@ class ContinuousBatcher:
         self._queue: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        self._draining = False
+        # admission-wait EWMA (scheduler-written, submit-read): the
+        # Retry-After basis for a shed :generate request
+        self._wait_ewma_s = 0.0
+        # consecutive prefill/decode failures open the breaker exactly
+        # like batch failures do on the predict path (gauge/flight name
+        # prefix "gen.<model>")
+        self.breaker = CircuitBreaker(f"gen.{model.name}")
         # slot state (scheduler-thread-private once started)
         self._slot_req: List[Optional[_GenRequest]] = \
             [None] * model.slots
@@ -190,19 +206,59 @@ class ContinuousBatcher:
         if self._running:
             return
         self._running = True
+        self._draining = False
         self._thread = threading.Thread(
             target=self._loop,
             name=f"serving-genbatcher-{self.model.name}", daemon=True)
         self._thread.start()
 
     def stop(self, timeout: float = 5.0) -> None:
-        if not self._running:
-            return
-        self._running = False
-        self._queue.put(_STOP)
+        if self._running:
+            self._running = False
+            self._queue.put(_STOP)
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+        # a dead/never-started scheduler can't run its finally-drain:
+        # fail queued waiters with the named 503 instead of leaving
+        # them to ride out their full client timeout
+        self._fail_queued()
+
+    def begin_drain(self) -> None:
+        """Stop admitting (submit -> 503); in-flight sequences and
+        already-admitted joins still run to completion."""
+        self._draining = True
+
+    def drain(self, timeout: float) -> bool:
+        """begin_drain(), then wait (bounded) for every occupied slot
+        and queued join to finish; True when fully drained in budget."""
+        self.begin_drain()
+        t_end = time.monotonic() + max(0.0, timeout)
+        while True:
+            idle = self._idle()
+            if idle:
+                time.sleep(0.02)  # re-confirm across the join hand-off
+                idle = self._idle()
+            if idle or time.monotonic() >= t_end:
+                return idle
+            time.sleep(0.02)
+
+    def _idle(self) -> bool:
+        return (self._queue.qsize() == 0 and not self._pending_join
+                and not any(r is not None for r in self._slot_req))
+
+    @property
+    def scheduler_alive(self) -> bool:
+        """False only when the batcher should be running but its
+        scheduler thread died — the /health `scheduler_dead` probe."""
+        if not self._running:
+            return True
+        return self._thread is not None and self._thread.is_alive()
+
+    def _fail_queued(self) -> None:
+        _fail_waiters(
+            self._queue, self._pending_join,
+            f"generation batcher for {self.model.name!r} stopped")
 
     # -- client side -----------------------------------------------------
     def submit(self, prompt, max_tokens: Optional[int] = None,
@@ -230,7 +286,36 @@ class ContinuousBatcher:
               else min(int(max_tokens), model.max_tokens))
         if mt <= 0:
             raise ValueError(f"max_tokens must be positive, got {mt}")
-        req = _GenRequest(prompt, mt)
+        # -- admission control (validated requests only: bad input is a
+        # 4xx, not a shed) ------------------------------------------------
+        if self._draining:
+            raise Unavailable(
+                f"generation model {model.name!r} is draining",
+                reason="draining")
+        depth = FLAGS.serving_max_queue_depth
+        if (depth > 0
+                and self._queue.qsize() + len(self._pending_join) >= depth):
+            # cache-slot exhaustion beyond the bounded wait-queue fails
+            # fast with 429 — never a silent stall behind full slots
+            ra = self.retry_after()
+            _record_shed(f"serving.gen.{model.name}.shed_total",
+                         "gen_queue_depth", ra, model=model.name)
+            raise Overloaded(
+                f"generation model {model.name!r}: slot wait-queue full "
+                f"({depth} waiting)",
+                retry_after_s=ra, reason="gen_queue_depth")
+        if not self.breaker.allow():
+            if monitor.enabled():
+                monitor.counter(
+                    f"serving.gen.{model.name}.breaker_rejected_total"
+                ).inc()
+            raise Unavailable(
+                f"generation model {model.name!r}: circuit breaker open "
+                f"({FLAGS.serving_breaker_threshold} consecutive "
+                "prefill/decode failures; half-open probe pending)",
+                retry_after_s=FLAGS.serving_breaker_cooldown_s,
+                reason="breaker_open")
+        req = _GenRequest(prompt, mt, timeout=timeout)
         self._queue.put(req)
         if not req.event.wait(timeout):
             req.cancelled = True  # scheduler retires the slot next step
@@ -249,6 +334,11 @@ class ContinuousBatcher:
             monitor.histogram(
                 f"serving.gen.{model.name}.request_seconds").observe(dt)
         return req.tokens, req.meta
+
+    def retry_after(self) -> float:
+        """Suggested back-off for a shed :generate request: ~2x the
+        observed admission-wait EWMA, capped at 30s."""
+        return min(30.0, max(0.05, 2.0 * self._wait_ewma_s))
 
     # -- scheduler side --------------------------------------------------
     def _drain_queue(self, block: bool) -> bool:
@@ -274,11 +364,28 @@ class ContinuousBatcher:
         free = [i for i, r in enumerate(self._slot_req) if r is None]
         if not free or not self._pending_join:
             return
+        now = time.perf_counter()
         joining = []
         while free and self._pending_join:
             req = self._pending_join.popleft()
             if req.cancelled:  # timed out while still queued
                 continue
+            if req.deadline is not None and now >= req.deadline:
+                # expired while waiting for a slot: never admitted, never
+                # prefilled — the deadline-propagation contract
+                req.error = TimeoutError(
+                    f"request expired before a cache slot freed "
+                    f"(model {model.name!r})")
+                req.event.set()
+                if monitor.enabled():
+                    monitor.counter(
+                        f"serving.gen.{model.name}.expired_dropped_total"
+                    ).inc()
+                    monitor.counter("serving.expired_dropped_total").inc()
+                continue
+            # admission-wait EWMA (Retry-After basis for sheds)
+            self._wait_ewma_s += 0.2 * (
+                (now - req.t_enqueue) - self._wait_ewma_s)
             slot = free.pop(0)
             self._slot_req[slot] = req
             self._slot_token[slot] = model.bos_id
@@ -295,16 +402,19 @@ class ContinuousBatcher:
             monitor.counter(
                 f"serving.gen.{model.name}.prefills").inc(len(joining))
 
-    def _step(self) -> None:
-        """One coalesced decode step for every occupied slot."""
+    def _step(self) -> bool:
+        """One coalesced decode step for every occupied slot; returns
+        True when a decode actually ran (breaker-success evidence)."""
         from .. import monitor
+        from ..testing import chaos
 
         model = self.model
         active = np.asarray(
             [1.0 if r is not None else 0.0 for r in self._slot_req],
             np.float32)
         if not active.any():
-            return
+            return False
+        chaos.maybe_serve_latency()
         nxt = model.session.decode_step(self._slot_token, active=active)
         now = time.perf_counter()
         mon = monitor.enabled()
@@ -312,10 +422,23 @@ class ContinuousBatcher:
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
-            if req.cancelled:
-                # abandoned by a timed-out client: free the slot now
-                # instead of decoding the rest of its budget
+            expired = req.deadline is not None and now >= req.deadline
+            if req.cancelled or expired:
+                # abandoned by a timed-out client, or past its deadline
+                # (the scheduler-side mirror — holds even when the
+                # client thread is gone): free the slot at this
+                # iteration boundary instead of decoding the rest of
+                # its budget
                 self._slot_req[slot] = None
+                if expired and not req.cancelled:
+                    req.error = TimeoutError(
+                        f"generation deadline passed mid-decode "
+                        f"(model {model.name!r}, slot {slot})")
+                    req.event.set()
+                    if mon:
+                        monitor.counter(
+                            f"serving.gen.{model.name}."
+                            "expired_slots_total").inc()
                 continue
             tok = int(nxt[slot])
             if req.t_first_token is None:
@@ -347,6 +470,7 @@ class ContinuousBatcher:
                 f"serving.gen.{model.name}.decode_steps").inc()
             monitor.gauge(f"serving.gen.{model.name}.occupancy").set(
                 sum(1 for r in self._slot_req if r is not None))
+        return True
 
     def _fail_slots(self, exc: Exception) -> None:
         """A prefill/decode call raised: fail every occupied slot (the
@@ -355,6 +479,7 @@ class ContinuousBatcher:
         not the loop' contract (batcher.py _execute)."""
         from .. import monitor
 
+        self.breaker.record_failure()
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
@@ -373,7 +498,8 @@ class ContinuousBatcher:
                     break
                 try:
                     self._admit()
-                    self._step()
+                    if self._step():
+                        self.breaker.record_success()
                 except Exception as e:  # noqa: BLE001 — fail the
                     # in-flight slots, not the scheduler (a dead loop
                     # would hang every current AND future caller)
@@ -381,22 +507,15 @@ class ContinuousBatcher:
         finally:
             # fail whatever is still in flight/queued so no caller
             # hangs — in a finally so even an unexpected scheduler
-            # crash drains its callers
-            leftovers = [r for r in self._slot_req if r is not None]
+            # crash drains its callers, with the NAMED 503 error
+            slotted = [r for r in self._slot_req if r is not None]
             self._slot_req = [None] * self.model.slots
-            leftovers.extend(self._pending_join)
-            self._pending_join.clear()
-            while True:
-                try:
-                    r = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if r is not _STOP:
-                    leftovers.append(r)
-            for r in leftovers:
-                r.error = RuntimeError(
-                    f"generation batcher for {self.model.name!r} stopped")
+            for r in slotted:
+                r.error = Unavailable(
+                    f"generation batcher for {self.model.name!r} stopped",
+                    reason="stopped")
                 r.event.set()
+            self._fail_queued()
 
 
 def build_demo_generation_model(name: str = "gendemo",

@@ -243,7 +243,13 @@ class ServingModel:
         must be diagnosable from /flight, not silent retrace stalls)."""
         from .. import monitor
         from ..monitor import flight
+        from ..testing import chaos
 
+        # chaos fault points (no-ops unless FLAGS_chaos): deterministic
+        # per-batch latency pins capacity for the overload gate; the
+        # transient-error budget is the circuit breaker's fodder
+        chaos.maybe_serve_latency()
+        chaos.maybe_serve_error(f"serving/{self.name}")
         pred = self.predictor(precision)
         before = pred.compile_count
         with flight.context(f"serving/{self.name}"):

@@ -35,6 +35,7 @@ import base64
 import io as _io
 import json
 import threading
+import time
 from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
@@ -43,7 +44,8 @@ import numpy as np
 
 from ..monitor import serve as mserve
 from ..monitor.registry import _json_safe
-from .batcher import DynamicBatcher
+from .batcher import (DynamicBatcher, Overloaded, Unavailable,
+                      _record_shed)
 from .model import ModelConfig, ServingModel
 
 
@@ -197,6 +199,17 @@ class ServingHandler(mserve.MonitorHandler):
             self.wfile.write(data)
         except RequestError as e:
             self._send_json(e.code, {"error": str(e)})
+        except Overloaded as e:
+            # admission control shed: fail fast, tell the client when a
+            # retry would realistically be served (queue-latency EWMA)
+            self._send_json(
+                429, {"error": str(e), "reason": e.reason,
+                      "retry_after_s": round(e.retry_after_s, 4)},
+                headers={"Retry-After": e.retry_after_header})
+        except Unavailable as e:
+            hdr = e.retry_after_header
+            self._send_json(503, {"error": str(e), "reason": e.reason},
+                            headers={"Retry-After": hdr} if hdr else None)
         except Exception as e:  # noqa: BLE001 — a request must not kill serving
             try:
                 self._send_json(500, {
@@ -269,9 +282,10 @@ class ServingHandler(mserve.MonitorHandler):
         except RequestError as e:
             self._send_json(e.code, {"error": str(e)})
 
-    def _send_json(self, code: int, body: dict) -> None:
+    def _send_json(self, code: int, body: dict,
+                   headers: Optional[dict] = None) -> None:
         self._send(code, json.dumps(_json_safe(body)) + "\n",
-                   "application/json")
+                   "application/json", extra_headers=headers)
 
 
 def enable_compilation_cache() -> bool:
@@ -383,6 +397,15 @@ class InferenceServer:
         self._httpd: Optional[_ServingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started = False
+        self._draining = False
+        # server-level in-flight accounting: the FLAGS_serving_max_inflight
+        # admission cap, and the drain path's "every admitted request has
+        # written its response" condition
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        # scheduler-death is flight-recorded once per batcher, not once
+        # per health poll
+        self._reported_dead: set = set()
         for c in configs or []:
             self.add_model(c)
 
@@ -447,6 +470,7 @@ class InferenceServer:
             return self.port
         from ..flags import FLAGS
 
+        self._draining = False
         if self._monitor:
             FLAGS.monitor = True
         enable_compilation_cache()
@@ -482,11 +506,11 @@ class InferenceServer:
             lambda: sum(m.warmup() for m in self._models.values())
             + sum(m.warmup() for m in self._gen_models.values()))
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
         for b in self._batchers.values():
-            b.stop()
+            b.stop(timeout=timeout)
         for b in self._gen_batchers.values():
-            b.stop()
+            b.stop(timeout=timeout)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -513,7 +537,15 @@ class InferenceServer:
         if batcher is None:
             raise KeyError(f"no model {name!r} "
                            f"(served: {self.model_names})")
-        return batcher.submit(feed, precision=precision, timeout=timeout)
+        if self._draining:
+            raise Unavailable("server draining", reason="draining")
+        self._chaos_flood(name, feed, precision)
+        self._admit_inflight(batcher.retry_after)
+        try:
+            return batcher.submit(feed, precision=precision,
+                                  timeout=timeout)
+        finally:
+            self._release_inflight()
 
     def submit_generate(self, name: str, prompt, max_tokens=None,
                         timeout: float = 60.0):
@@ -523,8 +555,108 @@ class InferenceServer:
         if batcher is None:
             raise KeyError(f"no generation model {name!r} "
                            f"(served: {sorted(self._gen_models)})")
-        return batcher.submit(prompt, max_tokens=max_tokens,
-                              timeout=timeout)
+        if self._draining:
+            raise Unavailable("server draining", reason="draining")
+        self._admit_inflight(batcher.retry_after)
+        try:
+            return batcher.submit(prompt, max_tokens=max_tokens,
+                                  timeout=timeout)
+        finally:
+            self._release_inflight()
+
+    # -- admission (server-level) ----------------------------------------
+    def _admit_inflight(self, retry_after) -> None:
+        """Count one admitted request; at the FLAGS_serving_max_inflight
+        cap, shed with 429 instead (Retry-After from the target
+        batcher's queue-latency EWMA).  The count always runs (it is the
+        drain path's completion condition); only the cap is flag-gated."""
+        from ..flags import FLAGS
+
+        cap = FLAGS.serving_max_inflight
+        with self._inflight_lock:
+            if cap > 0 and self._inflight >= cap:
+                shed = True
+            else:
+                self._inflight += 1
+                shed = False
+        if shed:
+            ra = retry_after()
+            _record_shed("serving.inflight_shed_total", "inflight_cap",
+                         ra, cap=cap)
+            raise Overloaded(
+                f"server in-flight cap reached ({cap} admitted)",
+                retry_after_s=ra, reason="inflight_cap")
+
+    def _release_inflight(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    def _chaos_flood(self, name: str, feed, precision: str) -> None:
+        """FLAGS_chaos request-flood: one deterministic burst of
+        synthetic duplicate requests piles queue pressure on `name`
+        (admission control must shed, not stall).  One flag read when
+        chaos is off."""
+        from ..testing import chaos
+
+        burst = chaos.serve_flood()
+        if not burst:
+            return
+        batcher = self._batchers[name]
+
+        def _one():
+            try:
+                batcher.submit(feed, precision=precision, timeout=0.5)
+            except Exception:  # noqa: BLE001 — synthetic load, outcome moot
+                pass
+
+        for _ in range(burst):
+            threading.Thread(target=_one, daemon=True).start()
+
+    # -- graceful drain ---------------------------------------------------
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful drain (the SIGTERM path): flip /health readiness to
+        'draining' (load balancers stop sending), reject new requests
+        with 503, let in-flight and queued-admitted work complete up to
+        FLAGS_serving_drain_timeout_s, then stop the serving tier.
+        Returns True when every admitted request completed inside the
+        budget."""
+        from ..flags import FLAGS
+        from ..monitor import flight
+
+        if timeout_s is None:
+            timeout_s = FLAGS.serving_drain_timeout_s
+        self._draining = True
+        batchers = (list(self._batchers.values())
+                    + list(self._gen_batchers.values()))
+        for b in batchers:
+            b.begin_drain()
+        flight.record("serving.drain", timeout_s=float(timeout_s),
+                      models=self.model_names)
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        ok = True
+        for b in batchers:
+            ok = b.drain(max(0.0, deadline - time.monotonic())) and ok
+        # admitted work has left the batchers; wait for handler threads
+        # to finish writing responses (the in-flight count spans the
+        # whole submit), then a short grace for the final socket writes
+        while True:
+            with self._inflight_lock:
+                n = self._inflight
+            if n == 0:
+                break
+            if time.monotonic() >= deadline:
+                ok = False
+                break
+            time.sleep(0.02)
+        time.sleep(0.1)
+        # a stuck batch past the budget is ABANDONED (daemon scheduler),
+        # not waited out: the drain deadline is the whole point
+        self.stop(timeout=max(0.5, deadline - time.monotonic()))
+        return ok
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def readiness(self) -> dict:
         models = {
@@ -537,8 +669,28 @@ class InferenceServer:
         })
         all_models = list(self._models.values()) \
             + list(self._gen_models.values())
-        return {
+        out = {
             "ready": bool(all_models)
             and all(m.ready for m in all_models),
             "models": models,
         }
+        if self._draining:
+            out["ready"] = False
+            out["draining"] = True
+        # liveness satellite: a dead scheduler thread leaves a healthy-
+        # LOOKING server that times out every request — name it so the
+        # probe can evict the process
+        dead = sorted(
+            n for n, b in {**self._batchers, **self._gen_batchers}.items()
+            if not b.scheduler_alive)
+        if dead:
+            out["ready"] = False
+            out["scheduler_dead"] = dead
+            from ..monitor import flight
+
+            for n in dead:
+                if n not in self._reported_dead:
+                    self._reported_dead.add(n)
+                    flight.record("serving.scheduler_dead", model=n,
+                                  fatal=True)
+        return out

@@ -14,6 +14,12 @@ production hooks are auditable:
     raise; retry loops ride past them)
   * feed stall                                 (sleep per parsed batch)
   * NaN loss at step N                         (training loops substitute)
+  * serving latency                            (sleep per executed batch /
+    decode step — pins serving capacity for the overload gate)
+  * transient serving executor error           (first K batch executions
+    raise RuntimeError — circuit-breaker fodder)
+  * request flood                              (one deterministic burst of
+    synthetic duplicate requests — queue-pressure spike)
 
 Gating: every hook first checks FLAGS_chaos (the master switch); when it is
 off — the default — hooks return immediately without touching any state, so
@@ -43,6 +49,8 @@ class _State:
     def __init__(self):
         self.lock = threading.Lock()
         self.io_errors_left = None  # lazily seeded from FLAGS.chaos_io_errors
+        self.serve_errors_left = None  # lazily from FLAGS.chaos_serve_errors
+        self.flood_fired = False
         self.run_count = 0
         self.save_count = 0
         self.injected = {}  # kind -> count (introspection for tests)
@@ -169,6 +177,60 @@ def maybe_feed_stall() -> None:
         import time
 
         time.sleep(s)
+
+
+def maybe_serve_latency() -> None:
+    """The serving tier calls this once per executed batch
+    (ServingModel.run_batch) and once per generation decode step
+    (ContinuousBatcher._step); sleeps FLAGS.chaos_serve_latency_s.  A
+    deterministic slow executor pins serving capacity, so the CI
+    overload gate's '~4x capacity' flood is box-independent."""
+    if not enabled():
+        return
+    s = FLAGS.chaos_serve_latency_s
+    if s > 0:
+        _count("serve_latency")
+        import time
+
+        time.sleep(s)
+
+
+def maybe_serve_error(site: str) -> None:
+    """Serving batch executions call this; the first
+    FLAGS.chaos_serve_errors calls raise a transient RuntimeError (the
+    budget is process-global and deterministic) — the broken-executor
+    simulation the per-model circuit breaker must absorb."""
+    if not enabled():
+        return
+    with _state.lock:
+        if _state.serve_errors_left is None:
+            _state.serve_errors_left = int(FLAGS.chaos_serve_errors)
+        if _state.serve_errors_left <= 0:
+            return
+        _state.serve_errors_left -= 1
+        k = _state.serve_errors_left
+    _count("serve_error")
+    raise RuntimeError(f"chaos[{site}]: injected transient executor "
+                       f"error ({k} more to come)")
+
+
+def serve_flood() -> int:
+    """Request-flood burst: the FIRST call after arming returns
+    FLAGS.chaos_serve_flood (then 0 forever) — the inference server
+    fires that many synthetic duplicate requests at the same model, a
+    deterministic queue-pressure spike the admission control must
+    shed."""
+    if not enabled():
+        return 0
+    n = int(FLAGS.chaos_serve_flood)
+    if n <= 0:
+        return 0
+    with _state.lock:
+        if _state.flood_fired:
+            return 0
+        _state.flood_fired = True
+    _count("serve_flood")
+    return n
 
 
 def nan_loss(step: int, loss):

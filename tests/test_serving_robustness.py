@@ -1,0 +1,954 @@
+"""Overload-hardened serving (ISSUE 13): admission control + shedding,
+deadline propagation, graceful drain, circuit breaker, scheduler-death
+liveness, and the serving chaos kinds.
+
+Covers the robustness tentpole + satellites: bounded queues shed with
+429/Retry-After (queue-latency EWMA), expired requests are dropped
+BEFORE dispatch (never reach the executor), stop()/drain() fail or
+finish queued-admitted work with named 503s instead of client-timeout
+hangs, the per-model circuit breaker opens on consecutive executor
+failures and half-open-probes closed, /health reports `draining` and
+`scheduler_dead`, a SIGTERM'd serving subprocess drains in-flight work
+and exits 0 with a drain-trigger flight dump, and all of it is
+zero-cost with FLAGS_monitor / FLAGS_chaos off.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.monitor import default_registry, flight
+from paddle_tpu.monitor import serve as mserve
+from paddle_tpu.serving import (
+    CircuitBreaker,
+    DynamicBatcher,
+    InferenceServer,
+    ModelConfig,
+    Overloaded,
+    ServingModel,
+    Unavailable,
+)
+from paddle_tpu.testing import chaos
+
+rng = np.random.RandomState(13)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    """Default flags, empty registry/chaos counters around every test;
+    never leak the serving readiness provider."""
+    FLAGS.reset()
+    default_registry().reset()
+    chaos.reset()
+    flight.default_recorder().clear()
+    yield
+    mserve.set_readiness_provider(None)
+    FLAGS.reset()
+    default_registry().reset()
+    chaos.reset()
+    flight.default_recorder().clear()
+
+
+def _export_fc_model(dirname, in_dim=6, out_dim=3, seed=3):
+    prog, startup = pt.Program(), pt.Program()
+    prog.random_seed = startup.random_seed = seed
+    with pt.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[in_dim], dtype="float32")
+        h = layers.fc(x, size=8, act="relu")
+        out = layers.fc(h, size=out_dim)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        pt.io.save_inference_model(dirname, ["x"], [out], exe,
+                                   main_program=prog, scope=scope)
+    return dirname
+
+
+@pytest.fixture(scope="module")
+def fc_dir(tmp_path_factory):
+    return _export_fc_model(
+        str(tmp_path_factory.mktemp("robustness") / "fc"))
+
+
+def _serving_model(dirname, **kw):
+    kw.setdefault("buckets", "1,2,4,8")
+    kw.setdefault("max_wait_ms", 5.0)
+    return ServingModel(ModelConfig("m", dirname, **kw))
+
+
+def _feed(n_rows=1):
+    return {"x": rng.randn(n_rows, 6).astype("float32")}
+
+
+# ---------------------------------------------------------------------------
+# admission control: bounded queues shed with 429 + Retry-After
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionControl:
+    def test_queue_depth_sheds_with_retry_after(self, fc_dir):
+        FLAGS.monitor = True
+        FLAGS.serving_max_queue_depth = 2
+        b = DynamicBatcher(_serving_model(fc_dir))  # scheduler NOT started
+        for _ in range(2):  # fill the bounded queue
+            with pytest.raises(TimeoutError):
+                b.submit(_feed(), timeout=0.01)
+        with pytest.raises(Overloaded) as ei:
+            b.submit(_feed(), timeout=0.01)
+        assert ei.value.reason == "queue_depth"
+        assert ei.value.retry_after_s > 0
+        assert int(ei.value.retry_after_header) >= 1
+        reg = default_registry()
+        assert reg.get("serving.m.shed_total").value == 1
+        assert reg.get("serving.shed_total").value == 1
+        assert flight.default_recorder().events(kind="serving.shed")
+        b.stop()
+
+    def test_queue_depth_zero_is_unbounded_legacy(self, fc_dir):
+        FLAGS.serving_max_queue_depth = 0
+        b = DynamicBatcher(_serving_model(fc_dir))
+        for _ in range(6):  # would shed at any bound; 0 = legacy queue
+            with pytest.raises(TimeoutError):
+                b.submit(_feed(), timeout=0.01)
+        b.stop()
+
+    def test_server_inflight_cap_sheds(self, fc_dir):
+        FLAGS.monitor = True
+        FLAGS.serving_max_inflight = 1
+        srv = InferenceServer(
+            [ModelConfig("m", fc_dir, buckets="1,2", max_wait_ms=1.0)],
+            port=0)
+        srv.start(warmup=True)
+        try:
+            m = srv._models["m"]
+            orig = m.run_batch
+
+            def slow(*a, **kw):
+                time.sleep(0.4)
+                return orig(*a, **kw)
+
+            m.run_batch = slow
+            res = {}
+
+            def client():
+                try:
+                    res["out"] = srv.submit("m", _feed(), timeout=10)
+                except Exception as e:  # noqa: BLE001
+                    res["err"] = e
+
+            t = threading.Thread(target=client)
+            t.start()
+            deadline = time.time() + 5
+            while srv._inflight < 1 and time.time() < deadline:
+                time.sleep(0.005)
+            with pytest.raises(Overloaded) as ei:
+                srv.submit("m", _feed(), timeout=1)
+            assert ei.value.reason == "inflight_cap"
+            t.join(timeout=10)
+            assert "out" in res, res
+            assert default_registry().get(
+                "serving.inflight_shed_total").value == 1
+        finally:
+            srv.stop()
+
+    def test_http_429_carries_retry_after_header(self, fc_dir):
+        FLAGS.serving_max_inflight = 1
+        srv = InferenceServer(
+            [ModelConfig("m", fc_dir, buckets="1,2", max_wait_ms=1.0)],
+            port=0)
+        srv.start(warmup=True)
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            m = srv._models["m"]
+            orig = m.run_batch
+
+            def slow(*a, **kw):
+                time.sleep(0.5)
+                return orig(*a, **kw)
+
+            m.run_batch = slow
+            t = threading.Thread(
+                target=lambda: srv.submit("m", _feed(), timeout=10))
+            t.start()
+            deadline = time.time() + 5
+            while srv._inflight < 1 and time.time() < deadline:
+                time.sleep(0.005)
+            req = urllib.request.Request(
+                f"{url}/v1/models/m:predict",
+                data=json.dumps({"inputs": {"x": [[0.0] * 6]}}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            body = json.loads(ei.value.read())
+            assert body["reason"] == "inflight_cap"
+            assert body["retry_after_s"] > 0
+            t.join(timeout=10)
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation: expired requests never reach the executor
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlinePropagation:
+    def test_expired_request_dropped_before_dispatch(self, fc_dir):
+        FLAGS.monitor = True
+        m = _serving_model(fc_dir)
+        b = DynamicBatcher(m)
+        dispatched = []
+        orig = m.run_batch
+
+        def spy(precision, feed, rows, bucket, sig):
+            dispatched.append(rows)
+            return orig(precision, feed, rows, bucket, sig)
+
+        m.run_batch = spy
+        # queue a request whose deadline passes while the scheduler is
+        # down (the stand-in for "aged out under overload")
+        with pytest.raises(TimeoutError):
+            b.submit(_feed(1), timeout=0.05)
+        time.sleep(0.06)
+        b.start()
+        outs, meta = b.submit(_feed(2), timeout=10)
+        b.stop()
+        # only the live 2-row request was ever dispatched
+        assert dispatched == [2], dispatched
+        assert default_registry().get(
+            "serving.m.expired_dropped_total").value == 1
+        assert default_registry().get(
+            "serving.expired_dropped_total").value == 1
+        assert meta["request_rows"] == 2
+
+    def test_http_timeout_s_becomes_the_deadline(self, fc_dir):
+        """The request body's timeout_s rides the queued request: a
+        server-side 504 (not a silent execute) when it expires."""
+        FLAGS.monitor = True
+        srv = InferenceServer(
+            [ModelConfig("m", fc_dir, buckets="1,2", max_wait_ms=1.0)],
+            port=0)
+        srv.start(warmup=True)
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            m = srv._models["m"]
+            orig = m.run_batch
+
+            def slow(*a, **kw):
+                time.sleep(0.5)
+                return orig(*a, **kw)
+
+            m.run_batch = slow
+            # occupy the scheduler, then send a short-deadline request
+            t = threading.Thread(
+                target=lambda: srv.submit("m", _feed(), timeout=10))
+            t.start()
+            time.sleep(0.1)
+            req = urllib.request.Request(
+                f"{url}/v1/models/m:predict",
+                data=json.dumps({"inputs": {"x": [[0.0] * 6]},
+                                 "timeout_s": 0.2}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 504
+            t.join(timeout=10)
+            # the expired request was dropped pre-dispatch once the
+            # scheduler got to it
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                c = default_registry().get(
+                    "serving.m.expired_dropped_total")
+                if c is not None and c.value >= 1:
+                    break
+                time.sleep(0.02)
+            assert default_registry().get(
+                "serving.m.expired_dropped_total").value >= 1
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# stop()/drain(): queued requests fail with a NAMED 503, never a hang
+# ---------------------------------------------------------------------------
+
+
+class TestStopDrainsQueued:
+    def test_dynamic_stop_fails_queued_with_named_503(self, fc_dir):
+        m = _serving_model(fc_dir)
+        orig = m.run_batch
+
+        def slow(*a, **kw):
+            time.sleep(0.3)
+            return orig(*a, **kw)
+
+        m.run_batch = slow
+        b = DynamicBatcher(m, max_batch=1)
+        b.start()
+        outcomes = []
+
+        def client():
+            try:
+                b.submit(_feed(), timeout=10)
+                outcomes.append("ok")
+            except Exception as e:  # noqa: BLE001
+                outcomes.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        t0 = time.perf_counter()
+        b.stop()
+        for t in threads:
+            t.join(timeout=10)
+        elapsed = time.perf_counter() - t0
+        # waiters resolved promptly — NOT after their 10s client timeout
+        assert elapsed < 5.0, elapsed
+        errs = [o for o in outcomes if o != "ok"]
+        assert errs, outcomes
+        assert all(isinstance(e, Unavailable) for e in errs), outcomes
+        assert all("stopped" in str(e) for e in errs)
+
+    def test_stop_with_dead_scheduler_still_fails_queued(self, fc_dir):
+        """stop() must drain the queue itself when the scheduler thread
+        cannot (here: never started — the dead-thread stand-in)."""
+        b = DynamicBatcher(_serving_model(fc_dir))
+        outcomes = []
+
+        def client():
+            try:
+                b.submit(_feed(), timeout=10)
+                outcomes.append("ok")
+            except Exception as e:  # noqa: BLE001
+                outcomes.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        b.stop()
+        for t in threads:
+            t.join(timeout=5)
+        assert len(outcomes) == 2
+        assert all(isinstance(e, Unavailable) for e in outcomes), outcomes
+
+    def test_continuous_stop_fails_queued_with_named_503(self, gen_model):
+        from paddle_tpu.serving.generation import ContinuousBatcher
+
+        b = ContinuousBatcher(gen_model)  # scheduler NOT started
+        outcomes = []
+
+        def client():
+            try:
+                b.submit([3, 5], max_tokens=2, timeout=10)
+                outcomes.append("ok")
+            except Exception as e:  # noqa: BLE001
+                outcomes.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        t0 = time.perf_counter()
+        b.stop()
+        for t in threads:
+            t.join(timeout=5)
+        assert time.perf_counter() - t0 < 5.0
+        assert len(outcomes) == 2
+        assert all(isinstance(e, Unavailable) for e in outcomes), outcomes
+
+
+# ---------------------------------------------------------------------------
+# generation tier: bounded wait-queue + deadline expiry
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gen_model():
+    from paddle_tpu.serving.generation import build_demo_generation_model
+
+    model = build_demo_generation_model("gdemo", slots=2)
+    model.warmup()  # pre-compile prefill+decode so tests time decode only
+    return model
+
+
+class TestGenerationRobustness:
+    def test_gen_queue_depth_sheds(self, gen_model):
+        from paddle_tpu.serving.generation import ContinuousBatcher
+
+        FLAGS.monitor = True
+        FLAGS.serving_max_queue_depth = 2
+        b = ContinuousBatcher(gen_model)  # NOT started: queue only grows
+        for _ in range(2):
+            with pytest.raises(TimeoutError):
+                b.submit([3, 5], max_tokens=2, timeout=0.01)
+        with pytest.raises(Overloaded) as ei:
+            b.submit([3, 5], max_tokens=2, timeout=0.01)
+        assert ei.value.reason == "gen_queue_depth"
+        assert default_registry().get(
+            "serving.gen.gdemo.shed_total").value == 1
+        b.stop()
+
+    def test_gen_expired_queue_drop_never_admits(self, gen_model):
+        """A request whose deadline passed while waiting for a slot is
+        dropped pre-prefill — crafted directly because a submit() client
+        marks its request cancelled on its own timeout (the cancel path;
+        the deadline path must hold WITHOUT a live client thread)."""
+        from paddle_tpu.serving.generation import (ContinuousBatcher,
+                                                   _GenRequest)
+
+        FLAGS.monitor = True
+        b = ContinuousBatcher(gen_model)
+        prefills = default_registry().counter(
+            "serving.gen.gdemo.prefills").value
+        expired = _GenRequest([3, 5], 4, timeout=0.05)
+        b._queue.put(expired)
+        time.sleep(0.06)
+        b.start()
+        # a live request flows; the expired one was dropped pre-prefill
+        toks, meta = b.submit([4, 6], max_tokens=2, timeout=20)
+        b.stop()
+        assert len(toks) <= 2
+        assert expired.event.is_set()
+        assert isinstance(expired.error, TimeoutError)
+        assert expired.tokens == []
+        assert default_registry().get(
+            "serving.gen.gdemo.expired_dropped_total").value == 1
+        assert default_registry().get(
+            "serving.gen.gdemo.prefills").value == prefills + 1
+
+    def test_gen_breaker_opens_on_step_failures_and_recovers(
+            self, gen_model):
+        """The generation tier wires the same per-model breaker around
+        its prefill/decode steps: a persistently broken generation model
+        fails fast with 503 instead of burning a prefill per request."""
+        from paddle_tpu.serving.generation import ContinuousBatcher
+
+        FLAGS.monitor = True
+        FLAGS.serving_breaker_threshold = 2
+        FLAGS.serving_breaker_cooldown_s = 0.05
+        b = ContinuousBatcher(gen_model)
+        orig = gen_model.session.prefill
+
+        def bad_prefill(*a, **kw):
+            raise RuntimeError("prefill exploded")
+
+        gen_model.session.prefill = bad_prefill
+        try:
+            b.start()
+            for _ in range(2):
+                with pytest.raises(RuntimeError, match="prefill exploded"):
+                    b.submit([3, 5], max_tokens=2, timeout=10)
+            assert b.breaker.state == CircuitBreaker.OPEN
+            with pytest.raises(Unavailable) as ei:
+                b.submit([3, 5], max_tokens=2, timeout=10)
+            assert ei.value.reason == "breaker_open"
+            assert default_registry().get(
+                "serving.gen.gdemo.breaker_state").value \
+                == CircuitBreaker.OPEN
+            assert default_registry().get(
+                "serving.gen.gdemo.breaker_rejected_total").value == 1
+            # recovery: the half-open probe rides the fixed executor
+            gen_model.session.prefill = orig
+            time.sleep(0.06)
+            toks, _ = b.submit([4, 6], max_tokens=2, timeout=20)
+            assert len(toks) == 2
+            assert b.breaker.state == CircuitBreaker.CLOSED
+        finally:
+            gen_model.session.prefill = orig
+            b.stop()
+
+    def test_gen_expired_slot_retires_at_step_boundary(self, gen_model):
+        """Deadline expiry extends the PR-11 cancel path: the slot
+        retires at the next iteration boundary even though the CLIENT
+        thread never timed out (deadline is scheduler-side state)."""
+        from paddle_tpu.serving.generation import (ContinuousBatcher,
+                                                   _GenRequest)
+
+        FLAGS.monitor = True
+        b = ContinuousBatcher(gen_model)
+        orig = gen_model.session.decode_step
+
+        def slow_never_eos(tok, active=None):
+            time.sleep(0.05)
+            out = np.asarray(orig(tok, active=active))
+            # pin non-eos so only max_tokens or the deadline can finish
+            return np.where(out == gen_model.eos_id, 5, out)
+
+        gen_model.session.decode_step = slow_never_eos
+        try:
+            b.start()
+            req = _GenRequest([3, 5], 64, timeout=0.4)
+            b._queue.put(req)
+            assert req.event.wait(30), "expired slot never retired"
+            assert isinstance(req.error, TimeoutError)
+            assert 0 < len(req.tokens) < 64
+            assert default_registry().get(
+                "serving.gen.gdemo.expired_slots_total").value >= 1
+            # the slot is reusable immediately
+            toks, meta = b.submit([4, 6], max_tokens=2, timeout=20)
+            assert len(toks) == 2
+        finally:
+            gen_model.session.decode_step = orig
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_state_machine(self):
+        FLAGS.serving_breaker_threshold = 2
+        FLAGS.serving_breaker_cooldown_s = 0.2
+        cb = CircuitBreaker("m")
+        assert cb.allow()
+        cb.record_failure()
+        assert cb.allow()  # under threshold
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN
+        assert not cb.allow()  # open: fail fast
+        time.sleep(0.25)
+        assert cb.allow()  # cooldown over: ONE half-open probe
+        assert cb.state == CircuitBreaker.HALF_OPEN
+        assert not cb.allow()  # second caller rejected while probing
+        cb.record_failure()  # probe failed -> re-open
+        assert cb.state == CircuitBreaker.OPEN
+        assert not cb.allow()
+        time.sleep(0.25)
+        assert cb.allow()
+        cb.record_success()  # probe succeeded -> closed
+        assert cb.state == CircuitBreaker.CLOSED
+        assert cb.allow() and cb.allow()
+
+    def test_lost_half_open_probe_reclaims(self):
+        """A probe that never reaches the executor (shed, expired, or
+        killed by a scheduler crash — nothing calls record_*) must not
+        wedge the breaker half-open forever: the slot reclaims after a
+        cooldown and the next caller becomes the probe."""
+        FLAGS.serving_breaker_threshold = 1
+        FLAGS.serving_breaker_cooldown_s = 0.1
+        cb = CircuitBreaker("m")
+        cb.record_failure()
+        time.sleep(0.12)
+        assert cb.allow()       # probe admitted... and then lost
+        assert not cb.allow()   # slot held while the probe is live
+        time.sleep(0.12)
+        assert cb.allow()       # reclaimed: a new probe is admitted
+        cb.record_success()
+        assert cb.state == CircuitBreaker.CLOSED
+
+    def test_shed_does_not_consume_probe_slot(self, fc_dir):
+        """Queue-depth admission runs BEFORE the breaker: under the very
+        overload that opened the breaker, sheds are 429s that leave the
+        half-open probe slot for a request that can actually run."""
+        FLAGS.serving_breaker_threshold = 1
+        FLAGS.serving_breaker_cooldown_s = 0.05
+        FLAGS.serving_max_queue_depth = 1
+        b = DynamicBatcher(_serving_model(fc_dir))  # NOT started
+        b.breaker.record_failure()  # open
+        time.sleep(0.06)  # cooldown over: half-open on next allow()
+        with pytest.raises(TimeoutError):  # the probe itself queues...
+            b.submit(_feed(), timeout=0.01)
+        # ...and the NEXT submit is a 429 shed, not a breaker 503 (the
+        # breaker-first ordering would raise Unavailable here)
+        with pytest.raises(Overloaded):
+            b.submit(_feed(), timeout=0.01)
+        time.sleep(0.06)
+        assert b.breaker.allow()  # lost probe reclaimed despite sheds
+        b.stop()
+
+    def test_threshold_zero_disables(self):
+        FLAGS.serving_breaker_threshold = 0
+        cb = CircuitBreaker("m")
+        for _ in range(10):
+            cb.record_failure()
+        assert cb.allow()
+        assert cb.state == CircuitBreaker.CLOSED
+
+    def test_breaker_opens_on_chaos_errors_and_recovers(self, fc_dir):
+        """End to end on the chaos transient-error budget: consecutive
+        executor failures open the breaker (fast 503, breaker_state
+        gauge), the half-open probe rides the exhausted budget back to
+        closed."""
+        FLAGS.monitor = True
+        FLAGS.serving_breaker_threshold = 2
+        FLAGS.serving_breaker_cooldown_s = 0.05
+        FLAGS.chaos = True
+        FLAGS.chaos_serve_errors = 2
+        chaos.reset()
+        b = DynamicBatcher(_serving_model(fc_dir), max_batch=1)
+        b.start()
+        try:
+            for _ in range(2):
+                with pytest.raises(RuntimeError, match="chaos"):
+                    b.submit(_feed(), timeout=10)
+            assert b.breaker.state == CircuitBreaker.OPEN
+            assert default_registry().get(
+                "serving.m.breaker_state").value == CircuitBreaker.OPEN
+            with pytest.raises(Unavailable) as ei:
+                b.submit(_feed(), timeout=10)
+            assert ei.value.reason == "breaker_open"
+            assert default_registry().get(
+                "serving.m.breaker_rejected_total").value == 1
+            time.sleep(0.06)
+            outs, _ = b.submit(_feed(), timeout=10)  # half-open probe
+            assert outs is not None
+            assert b.breaker.state == CircuitBreaker.CLOSED
+            assert default_registry().get(
+                "serving.m.breaker_state").value == CircuitBreaker.CLOSED
+            assert chaos.injected_counts().get("serve_error") == 2
+        finally:
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# scheduler hardening + /health liveness
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerLiveness:
+    def test_scheduler_exception_recovers_and_counts(self, fc_dir):
+        FLAGS.monitor = True
+        m = _serving_model(fc_dir)
+        b = DynamicBatcher(m)
+        b.start()
+        try:
+            boom = [True]
+
+            def bad_pad(feed, rows, target):
+                if boom:
+                    boom.pop()
+                    raise RuntimeError("pad exploded")
+                return ServingModel.pad_feed(feed, rows, target)
+
+            m.pad_feed = bad_pad
+            with pytest.raises(RuntimeError, match="pad exploded"):
+                b.submit(_feed(), timeout=10)
+            # the loop survived: the next request is served normally
+            outs, _ = b.submit(_feed(), timeout=10)
+            assert outs is not None
+            assert b.scheduler_alive
+            assert default_registry().get(
+                "serving.m.scheduler_restarts").value == 1
+            evs = flight.default_recorder().events(
+                kind="serving.scheduler_error")
+            assert evs and evs[-1]["fatal"]
+        finally:
+            b.stop()
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_dead_scheduler_flips_health_503(self, fc_dir):
+        srv = InferenceServer(
+            [ModelConfig("m", fc_dir, buckets="1,2", max_wait_ms=1.0)],
+            port=0)
+        srv.start(warmup=True)
+        try:
+            body, code = mserve.health_body()
+            assert code == 200 and body["status"] == "ok"
+            b = srv._batchers["m"]
+
+            def die(*a, **kw):
+                raise SystemExit("scheduler killed")  # BaseException class
+
+            b._take = die
+            b._thread.join(timeout=10)
+            assert not b._thread.is_alive()
+            assert not b.scheduler_alive
+            body, code = mserve.health_body()
+            assert code == 503
+            assert body["status"] == "scheduler_dead"
+            assert body["serving"]["scheduler_dead"] == ["m"]
+            evs = flight.default_recorder().events(
+                kind="serving.scheduler_dead")
+            assert evs and evs[-1]["model"] == "m"
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestGracefulDrain:
+    def test_drain_completes_admitted_and_503s_new(self, fc_dir):
+        srv = InferenceServer(
+            [ModelConfig("m", fc_dir, buckets="1,2", max_wait_ms=1.0)],
+            port=0)
+        srv.start(warmup=True)
+        stopped = False
+        try:
+            m = srv._models["m"]
+            orig = m.run_batch
+
+            def slow(*a, **kw):
+                time.sleep(0.4)
+                return orig(*a, **kw)
+
+            m.run_batch = slow
+            res = {}
+
+            def client():
+                try:
+                    res["out"] = srv.submit("m", _feed(), timeout=10)
+                except Exception as e:  # noqa: BLE001
+                    res["err"] = e
+
+            t = threading.Thread(target=client)
+            t.start()
+            time.sleep(0.1)
+            dr = {}
+            td = threading.Thread(
+                target=lambda: dr.setdefault(
+                    "ok", srv.drain(timeout_s=10)))
+            td.start()
+            time.sleep(0.1)
+            # mid-drain: /health says draining (503), new work is 503
+            body, code = mserve.health_body()
+            assert code == 503 and body["status"] == "draining"
+            assert body["serving"]["draining"] is True
+            with pytest.raises(Unavailable) as ei:
+                srv.submit("m", _feed(), timeout=1)
+            assert ei.value.reason == "draining"
+            td.join(timeout=20)
+            t.join(timeout=20)
+            stopped = True  # drain() ends in stop()
+            assert dr.get("ok") is True
+            assert "out" in res, res
+            evs = flight.default_recorder().events(kind="serving.drain")
+            assert evs
+        finally:
+            if not stopped:
+                srv.stop()
+
+    def test_drain_timeout_bounds_the_wait(self, fc_dir):
+        """A drain with stuck work returns (False) inside its budget
+        instead of hanging."""
+        srv = InferenceServer(
+            [ModelConfig("m", fc_dir, buckets="1,2", max_wait_ms=1.0)],
+            port=0)
+        srv.start(warmup=True)
+        try:
+            m = srv._models["m"]
+            orig = m.run_batch
+
+            def stuck(*a, **kw):
+                time.sleep(3.0)
+                return orig(*a, **kw)
+
+            m.run_batch = stuck
+            t = threading.Thread(
+                target=lambda: _swallow(
+                    lambda: srv.submit("m", _feed(), timeout=10)))
+            t.start()
+            time.sleep(0.1)
+            t0 = time.monotonic()
+            ok = srv.drain(timeout_s=0.5)
+            assert time.monotonic() - t0 < 2.5
+            assert ok is False
+            t.join(timeout=10)
+        finally:
+            srv.stop()
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except Exception:  # noqa: BLE001 — outcome irrelevant to the test
+        pass
+
+
+# ---------------------------------------------------------------------------
+# subprocess SIGTERM graceful drain (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _http_get(url, data=None, timeout=5):
+    """-> (status, body bytes); HTTP errors return their status+body."""
+    headers = {"Content-Type": "application/json"} if data else {}
+    req = urllib.request.Request(url, data=data, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestSigtermDrainSubprocess:
+    def test_sigterm_drains_inflight_and_exits_zero(self, tmp_path):
+        """The full CLI contract: an in-flight request completes 200
+        through the drain, a request sent DURING the drain gets 503,
+        the flight dump names trigger 'drain', and the process exits 0
+        within the drain timeout."""
+        model_dir = _export_fc_model(str(tmp_path / "fc32"), in_dim=4)
+        flight_dir = str(tmp_path / "flight")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO_ROOT + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""),
+                   FLAGS_chaos="1",
+                   FLAGS_chaos_serve_latency_s="0.5",
+                   FLAGS_serving_drain_timeout_s="10",
+                   FLAGS_flight_dir=flight_dir)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving",
+             "--port", "0", "--model", f"demo={model_dir}",
+             "--buckets", "1,2", "--max-wait-ms", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            cwd=REPO_ROOT, env=env, text=True)
+        try:
+            line = proc.stdout.readline()
+            ready = json.loads(line)
+            url = f"http://127.0.0.1:{ready['port']}"
+            results = []
+
+            def inflight():
+                req = urllib.request.Request(
+                    f"{url}/v1/models/demo:predict",
+                    data=json.dumps({"inputs": {"x": [[0.1] * 4]},
+                                     "timeout_s": 20}).encode(),
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        results.append((r.status, r.read()))
+                except urllib.error.HTTPError as e:
+                    results.append((e.code, e.read()))
+
+            t = threading.Thread(target=inflight)
+            t.start()
+            # wait until the request is ADMITTED (inflight gauge via
+            # /metrics), then SIGTERM mid-execution
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                with urllib.request.urlopen(f"{url}/metrics",
+                                            timeout=5) as r:
+                    text = r.read().decode()
+                if any(ln.startswith("serving_demo_inflight 1")
+                       for ln in text.splitlines()):
+                    break
+                time.sleep(0.02)
+            t_term = time.monotonic()
+            proc.send_signal(signal.SIGTERM)
+            time.sleep(0.15)
+            # during the drain: health says draining, new request 503
+            code, raw = _http_get(f"{url}/health")
+            assert code == 503, (code, raw)
+            assert json.loads(raw)["status"] == "draining"
+            code, raw = _http_get(
+                f"{url}/v1/models/demo:predict",
+                data=json.dumps({"inputs": {"x": [[0.1] * 4]}}).encode())
+            assert code == 503, (code, raw)
+            assert json.loads(raw)["reason"] == "draining"
+            # the admitted in-flight request completes 200
+            t.join(timeout=30)
+            assert results and results[0][0] == 200, results
+            # process exits 0 inside the drain budget
+            rc = proc.wait(timeout=20)
+            assert rc == 0, rc
+            assert time.monotonic() - t_term < 15
+            # the flight dump names the drain trigger
+            dumps = glob.glob(
+                os.path.join(flight_dir, "flight-*-drain.jsonl"))
+            assert dumps, os.listdir(flight_dir)
+            with open(dumps[0]) as f:
+                header = json.loads(f.readline())
+            assert header["trigger"] == "drain"
+            kinds = [json.loads(ln).get("kind")
+                     for ln in open(dumps[0]).read().splitlines()[1:]]
+            assert "serving.drain" in kinds
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# zero-cost-when-off (the PR-1/PR-3 convention)
+# ---------------------------------------------------------------------------
+
+
+class TestZeroCostOff:
+    def test_chaos_hooks_noop_when_off(self):
+        assert not FLAGS.chaos
+        t0 = time.perf_counter()
+        for _ in range(100):
+            chaos.maybe_serve_latency()
+            chaos.maybe_serve_error("site")
+            assert chaos.serve_flood() == 0
+        assert time.perf_counter() - t0 < 0.5
+        assert chaos.injected_counts() == {}
+
+    def test_monitor_off_registers_no_robustness_metrics(self, fc_dir):
+        assert not FLAGS.monitor
+        FLAGS.serving_max_queue_depth = 1
+        FLAGS.serving_breaker_threshold = 1
+        m = _serving_model(fc_dir)
+        b = DynamicBatcher(m)
+        with pytest.raises(TimeoutError):
+            b.submit(_feed(), timeout=0.01)
+        with pytest.raises(Overloaded):  # shed path, no counters
+            b.submit(_feed(), timeout=0.01)
+        time.sleep(0.02)
+        b.start()  # expired-drop path, no counters
+        b.breaker.record_failure()  # breaker open, no gauge
+        with pytest.raises(Unavailable):
+            b.submit(_feed(), timeout=0.01)
+        b.stop()
+        reg = default_registry()
+        for name in ("serving.m.shed_total", "serving.shed_total",
+                     "serving.m.expired_dropped_total",
+                     "serving.expired_dropped_total",
+                     "serving.m.breaker_state",
+                     "serving.m.breaker_rejected_total",
+                     "serving.m.scheduler_restarts"):
+            assert reg.get(name) is None, name
+        assert not flight.default_recorder().events()
+
+    def test_flags_off_restores_legacy_admission(self, fc_dir):
+        """Queue depth 0 + breaker 0 + inflight 0 = today's semantics:
+        every validated request is admitted, breaker never consulted."""
+        FLAGS.serving_max_queue_depth = 0
+        FLAGS.serving_breaker_threshold = 0
+        FLAGS.serving_max_inflight = 0
+        m = _serving_model(fc_dir)
+        b = DynamicBatcher(m)
+        for _ in range(5):
+            b.breaker.record_failure()  # ignored while disabled
+        b.start()
+        outcomes = []
+
+        def client():
+            try:
+                outs, _ = b.submit(_feed(), timeout=10)
+                outcomes.append("ok")
+            except Exception as e:  # noqa: BLE001
+                outcomes.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        b.stop()
+        assert outcomes == ["ok"] * 12, outcomes

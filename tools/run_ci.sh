@@ -16,9 +16,14 @@
 #   6. serving smoke gate: export a model, boot the inference server,
 #      drive tools/loadgen.py — p99/batch-fill histograms on /metrics,
 #      zero recompiles across a shape-varying stream, the dynamic-
-#      batching A/B (batched >= 2x batch-size-1 QPS), and the generation
-#      continuous-batching gate (late joins without retrace/stall,
-#      concurrent streams >= 2x batch-1 decode tokens/sec)
+#      batching A/B (batched >= 2x batch-size-1 QPS), the OVERLOAD gate
+#      (open-loop flood at ~4x measured capacity vs a chaos-armed
+#      server: 429 shedding + Retry-After, expired-deadline drops before
+#      dispatch, zero crash-5xx, bounded accepted p99, flat compile
+#      counter, and a mid-load SIGTERM graceful drain exiting 0 with a
+#      drain-trigger flight dump — overload_smoke.json), and the
+#      generation continuous-batching gate (late joins without
+#      retrace/stall, concurrent streams >= 2x batch-1 decode tokens/sec)
 #   7. compile-check + multichip dryrun (the driver's graft contract)
 # Usage: tools/run_ci.sh [fast]   — "fast" skips the bench smoke.
 set -euo pipefail
@@ -237,8 +242,16 @@ if [[ "${1:-}" != "fast" ]]; then
   #     request-latency p99 / batch-fill histograms on /metrics;
   #   * the A/B: dynamic batching must serve >= 2x the QPS of
   #     batch-size-1 mode on the same single-row stream (interleaved
-  #     trial pairs absorb noisy-neighbour CI variance).
-  # Artifacts: ci_artifacts/serving/loadgen_*.json + ab_summary.json.
+  #     trial pairs absorb noisy-neighbour CI variance);
+  #   * the overload gate: ~4x-capacity open-loop flood vs a
+  #     chaos-latency-armed bounded-queue server — shedding engaged
+  #     (429 + Retry-After), expired_dropped_total > 0 (deadline drops
+  #     before dispatch, asserted via /metrics delta), zero crash-5xx,
+  #     accepted p99 under the stated bound, compile counter FLAT; then
+  #     SIGTERM mid-load drains in-flight work and exits 0 with a
+  #     drain-trigger flight dump.
+  # Artifacts: ci_artifacts/serving/loadgen_*.json + ab_summary.json
+  #            + overload_smoke.json (+ flight/ drain dump).
   rm -rf ci_artifacts/serving && mkdir -p ci_artifacts/serving
   JAX_PLATFORMS=cpu python tools/serving_smoke.py \
     --out-dir ci_artifacts/serving
