@@ -1429,9 +1429,13 @@ class Executor:
         from ..monitor import flight as _flight
 
         dt = _time.perf_counter() - t0
-        # span start on the wall clock (flight events ride the unified
-        # timeline, which bridges to the xplane trace clock via epoch)
-        t0_epoch = _time.time() - dt
+        # span start bridged to the epoch clock the unified timeline and
+        # request traces ride (perf_counter + the import-time offset —
+        # `time.time() - dt` would drift off the other spans' stamps
+        # under NTP slew)
+        from ..monitor import tracing as _tracing
+
+        t0_epoch = _tracing.pc_to_epoch(t0)
         monitor.counter(f"executor.{mode}.calls").inc()
         if compiled_now:
             # the miss call's wall time IS trace+compile(+first run);
@@ -1455,6 +1459,11 @@ class Executor:
         if np_outs:
             monitor.counter("executor.fetch_bytes").inc(
                 sum(int(getattr(o, "nbytes", 0) or 0) for o in np_outs))
+        # request-tracing hook: when a serving batcher armed this thread's
+        # executor context (monitor/tracing.py), the call's compile-vs-run
+        # wall time lands as a sub-span in every participating request
+        # trace; one thread-local read otherwise
+        _tracing.note_executor(mode, t0_epoch, dt, compiled_now)
 
     # -- internals -------------------------------------------------------
     def _maybe_verify(self, program, feed_names, fetch_names, scope):

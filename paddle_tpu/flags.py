@@ -306,6 +306,37 @@ FLAGS.define(
     "(jax compilation cache): warmup compiles of the bucket ladder are "
     "reused across server restarts; empty disables persistence")
 FLAGS.define(
+    "trace_requests", bool, False,
+    "request-scoped distributed tracing for the serving tier "
+    "(monitor/tracing.py): every serving request gets a trace id "
+    "(accepting/emitting a W3C traceparent header) and a span tree "
+    "decomposing its latency — queue wait, batch form, pad-to-bucket "
+    "overhead, executor compile/run, de-batch, and per-token decode "
+    "iterations for generation; traces land in the bounded trace store "
+    "(/v1/traces endpoints), the flight ring, and the unified chrome "
+    "timeline.  Off = zero cost: no trace objects, no registry entries, "
+    "no flight events on the request path")
+FLAGS.define(
+    "trace_store", int, 256,
+    "capacity of the in-memory finished-trace store behind /v1/traces "
+    "(bounded memory; oldest traces evicted first)")
+FLAGS.define(
+    "serving_slo_ms", str, "",
+    "per-model serving latency objective in milliseconds, e.g. '50' "
+    "(every model) or 'demo=50,gendemo=500' (per model; a bare number "
+    "entry is the default for unlisted models).  When set, every "
+    "finished/shed request counts as a good or bad SLO event "
+    "(serving.<model>.slo_good_total / slo_bad_total) and multi-window "
+    "burn-rate gauges (slo_burn_rate_5m/30m/1h — observed bad fraction "
+    "over the window divided by the 1-FLAGS_serving_slo_target error "
+    "budget; 1.0 = burning exactly at budget) refresh on every /metrics "
+    "scrape.  Empty disables the SLO engine")
+FLAGS.define(
+    "serving_slo_target", float, 0.999,
+    "availability objective behind the burn-rate gauges: the error "
+    "budget is 1 - this fraction of requests allowed to miss "
+    "FLAGS_serving_slo_ms")
+FLAGS.define(
     "record_lowered_ops", bool, False,
     "test/debug flag: the executor trace records every lowered op type "
     "into the flight recorder (monitor/flight.py lowered_op_types) — the "

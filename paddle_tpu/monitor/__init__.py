@@ -48,8 +48,11 @@ from .registry import (  # noqa: F401
     default_registry,
     enabled,
 )
+from .registry import SloTracker  # noqa: F401
 from .step import StepMonitor  # noqa: F401
 from . import flight  # noqa: F401
 from .flight import FlightRecorder  # noqa: F401
 from .watchdog import Watchdog, WatchdogError  # noqa: F401
 from . import serve  # noqa: F401
+from . import tracing  # noqa: F401
+from .tracing import RequestTrace, TraceStore  # noqa: F401

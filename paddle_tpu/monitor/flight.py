@@ -158,6 +158,17 @@ class FlightRecorder:
             hdr["jax_device_count"] = jax.device_count()
         except Exception:
             pass
+        # header providers: subsystems with in-flight state worth a
+        # postmortem line (monitor/tracing.py reports OPEN request traces
+        # — what the process was serving when it died).  Best-effort: a
+        # broken provider must not block a crash dump.
+        for cb in list(_header_providers):
+            try:
+                more = cb()
+                if more:
+                    hdr.update(more)
+            except Exception:
+                pass
         if extra:
             hdr.update(extra)
         return hdr
@@ -219,6 +230,28 @@ def note_step(step: int, loss: Optional[float] = None) -> None:
 def dump(path: Optional[str] = None, trigger: str = "manual",
          extra: Optional[dict] = None) -> Optional[str]:
     return _default.dump(path, trigger, extra)
+
+
+# ---------------------------------------------------------------------------
+# Header providers (in-flight state for the dump header)
+# ---------------------------------------------------------------------------
+
+_header_providers: List = []
+
+
+def add_header_provider(cb) -> None:
+    """Register `cb() -> dict` to merge into every dump header — the hook
+    tracing uses so crash dumps carry the requests that were IN FLIGHT
+    when the process died.  Idempotent per callback object."""
+    if cb not in _header_providers:
+        _header_providers.append(cb)
+
+
+def remove_header_provider(cb) -> None:
+    try:
+        _header_providers.remove(cb)
+    except ValueError:
+        pass
 
 
 # ---------------------------------------------------------------------------

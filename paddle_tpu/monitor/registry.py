@@ -15,6 +15,7 @@ telemetry is off.
 from __future__ import annotations
 
 import bisect
+import collections
 import json
 import threading
 import time
@@ -101,7 +102,7 @@ class Histogram:
 
     kind = "histogram"
     __slots__ = ("name", "help", "buckets", "_lock", "_counts", "_sum",
-                 "_count")
+                 "_count", "_max")
 
     def __init__(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
                  help: str = ""):
@@ -116,6 +117,7 @@ class Histogram:
         self._counts = [0] * (len(bs) + 1)  # +1: the +Inf tail
         self._sum = 0.0
         self._count = 0
+        self._max = 0.0  # largest observed value (the +Inf bucket's clamp)
 
     def observe(self, v: float):
         i = bisect.bisect_left(self.buckets, v)
@@ -123,6 +125,8 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if v > self._max:
+                self._max = v
 
     @property
     def count(self) -> int:
@@ -132,16 +136,24 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    @property
+    def max(self) -> float:
+        """Largest observed value (0.0 before any observation)."""
+        return self._max
+
     def quantile(self, q: float) -> Optional[float]:
         """Bucket-resolution quantile estimate (the upper bound of the
         bucket holding the q-th observation, Prometheus histogram_quantile
-        style).  Returns None with no observations; observations past the
-        top bucket return +Inf — widen the buckets if that matters."""
+        style).  Returns None with no observations.  The +Inf tail bucket
+        clamps to the LARGEST OBSERVED value instead of returning inf —
+        a single outlier past the top bound must not make a p99 report
+        `inf` in /v1/models info."""
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile {q} outside [0, 1]")
         with self._lock:
             counts = list(self._counts)
             total = self._count
+            vmax = self._max
         if total == 0:
             return None
         target = q * total
@@ -149,19 +161,83 @@ class Histogram:
         for le, c in zip(self.buckets + (float("inf"),), counts):
             cum += c
             if cum >= target:
-                return le
-        return float("inf")
+                return vmax if le == float("inf") else le
+        return vmax
 
     def snapshot(self) -> dict:
         with self._lock:
             counts = list(self._counts)
-            total, s = self._count, self._sum
+            total, s, vmax = self._count, self._sum, self._max
         cum, cum_counts = 0, []
         for le, c in zip(self.buckets + (float("inf"),), counts):
             cum += c
             cum_counts.append([le, cum])
         return {"metric": self.name, "type": self.kind, "count": total,
-                "sum": s, "buckets": cum_counts}
+                "sum": s, "max": vmax, "buckets": cum_counts}
+
+
+class SloTracker:
+    """Good/bad SLO event accounting behind the serving burn-rate gauges.
+
+    A request is GOOD when it completed inside its latency objective, BAD
+    when it missed it, errored, or was shed.  Events land in coarse
+    fixed-width time buckets (bounded memory: one [start, good, bad] row
+    per BUCKET_S over the horizon), so the multi-window burn rates the
+    SRE playbook asks for — observed bad fraction over the window divided
+    by the error budget (1 - target); 1.0 means burning the budget
+    exactly at the sustainable rate — come from one deque walk at scrape
+    time, not a per-request histogram."""
+
+    BUCKET_S = 10.0
+
+    __slots__ = ("name", "objective_ms", "target", "_lock", "_buckets",
+                 "good_total", "bad_total")
+
+    def __init__(self, name: str, objective_ms: float,
+                 target: float = 0.999, horizon_s: float = 3600.0):
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"slo target must be in (0, 1), got {target}")
+        self.name = name
+        self.objective_ms = float(objective_ms)
+        self.target = float(target)
+        self._lock = threading.Lock()
+        self._buckets: "collections.deque" = collections.deque(
+            maxlen=int(horizon_s / self.BUCKET_S) + 2)
+        self.good_total = 0
+        self.bad_total = 0
+
+    def observe(self, good: bool, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        start = now - (now % self.BUCKET_S)
+        with self._lock:
+            if not self._buckets or self._buckets[-1][0] != start:
+                self._buckets.append([start, 0, 0])
+            self._buckets[-1][1 if good else 2] += 1
+            if good:
+                self.good_total += 1
+            else:
+                self.bad_total += 1
+
+    def window_counts(self, window_s: float,
+                      now: Optional[float] = None) -> tuple:
+        """(good, bad) over the trailing window (bucket resolution)."""
+        now = time.time() if now is None else now
+        cut = now - float(window_s)
+        good = bad = 0
+        with self._lock:
+            for start, g, b in self._buckets:
+                if start + self.BUCKET_S > cut:
+                    good += g
+                    bad += b
+        return good, bad
+
+    def burn_rate(self, window_s: float,
+                  now: Optional[float] = None) -> float:
+        good, bad = self.window_counts(window_s, now)
+        n = good + bad
+        if n == 0:
+            return 0.0
+        return (bad / n) / max(1.0 - self.target, 1e-9)
 
 
 class MetricsRegistry:
@@ -170,6 +246,11 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
+        # collect hooks run at the top of every snapshot() (and therefore
+        # every /metrics scrape) OUTSIDE the registry lock — the place to
+        # refresh derived gauges (SLO burn rates) lazily instead of per
+        # request.  Exception-proof: a broken hook must not fail a scrape.
+        self._collect_hooks: List = []
 
     def _get_or_create(self, name, cls, **kwargs):
         with self._lock:
@@ -216,8 +297,25 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
 
+    def add_collect_hook(self, fn) -> None:
+        """Register `fn()` to run before every snapshot()/scrape (derived-
+        gauge refresh).  Idempotent per callable; hooks survive reset()."""
+        if fn not in self._collect_hooks:
+            self._collect_hooks.append(fn)
+
+    def remove_collect_hook(self, fn) -> None:
+        try:
+            self._collect_hooks.remove(fn)
+        except ValueError:
+            pass
+
     # -- exposition ------------------------------------------------------
     def snapshot(self) -> List[dict]:
+        for fn in list(self._collect_hooks):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a hook must not fail a scrape
+                pass
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         return [m.snapshot() for m in metrics]

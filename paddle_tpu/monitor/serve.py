@@ -12,7 +12,10 @@ ROADMAP north star) needs scrape-based monitoring.  Three endpoints:
     trainer; a process with zero steps is NOT stalled) and SERVING
     READINESS (503 "not_ready" until a registered readiness provider —
     the paddle_tpu/serving server — reports its models warmed);
-  * /flight  — last-N flight-recorder events as JSONL (?n=100, ?kind=...).
+  * /flight  — last-N flight-recorder events as JSONL (?n=100, ?kind=...);
+  * /v1/traces — last-N finished request traces (?last=20) and
+    /v1/traces/<id> one full trace with its span tree + latency
+    decomposition (monitor/tracing.py; empty unless FLAGS.trace_requests).
 
 Start with `start(port)` (FLAGS.monitor_port; port 0 picks an ephemeral
 port — tests read it from the return value).  The server runs daemon
@@ -137,7 +140,8 @@ class MonitorHandler(BaseHTTPRequestHandler):
         try:
             url = urlparse(self.path)
             if not self._route_get(url):
-                self._send(404, "not found: try /metrics /health /flight\n")
+                self._send(404, "not found: try /metrics /health /flight "
+                                "/v1/traces\n")
         except Exception as e:  # serving must not kill the run
             try:
                 self._send(500, f"error: {type(e).__name__}: {e}\n")
@@ -163,6 +167,34 @@ class MonitorHandler(BaseHTTPRequestHandler):
                       for e in rec.events(n=n, kind=kind)]
             self._send(200, "\n".join(lines) + "\n",
                        "application/jsonl")
+        elif url.path == "/v1/traces":
+            from . import tracing as _tracing
+
+            q = parse_qs(url.query)
+            n = int(q.get("last", ["20"])[0])
+            body = {"traces": [t.to_json()
+                               for t in _tracing.default_store().last(n)],
+                    "stored": len(_tracing.default_store()),
+                    "enabled": _tracing.enabled()}
+            self._send(200, json.dumps(_registry._json_safe(body)) + "\n",
+                       "application/json")
+        elif url.path.startswith("/v1/traces/"):
+            from . import tracing as _tracing
+
+            tid = url.path[len("/v1/traces/"):]
+            # read-your-writes: a client fetching the trace named by the
+            # response it JUST read may beat the handler's finish() by
+            # microseconds — wait briefly for in-flight ids
+            tr = _tracing.wait_for(tid)
+            if tr is None:
+                self._send(404, json.dumps(
+                    {"error": f"no trace {tid!r} "
+                              "(bounded store — FLAGS_trace_store)"})
+                    + "\n", "application/json")
+            else:
+                self._send(200, json.dumps(
+                    _registry._json_safe(tr.to_json())) + "\n",
+                    "application/json")
         else:
             return False
         return True

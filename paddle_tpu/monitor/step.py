@@ -27,8 +27,11 @@ import itertools as _itertools
 # perf_counter -> wall-clock bridge: step() stamps with perf_counter (so
 # timed loops can replay cheaply), but flight spans live on the epoch
 # clock the unified timeline uses; both clocks tick at the same rate, so
-# one offset sampled at import converts
-_EPOCH_OFFSET = time.time() - time.perf_counter()
+# one offset sampled at import converts.  Public: monitor/tracing.py and
+# the serving batchers convert their perf_counter request stamps through
+# the SAME offset so every span rides one clock.
+EPOCH_OFFSET = time.time() - time.perf_counter()
+_EPOCH_OFFSET = EPOCH_OFFSET
 
 # distinguishes records when several StepMonitors append to one JSONL
 # file (bench workloads, run_guarded retries restarting step numbers)

@@ -1,0 +1,673 @@
+"""Request-scoped distributed tracing + SLO burn-rate accounting.
+
+The process-level observability of PRs 1-2 (metrics registry, flight
+recorder, unified chrome timeline) answers "is the server healthy?"; this
+tier answers "why was THIS request slow?".  It is the Dapper span-
+propagation pattern (and Orca's iteration-level accounting for the decode
+path) rebuilt dependency-free in the repo's stdlib idiom:
+
+  * every serving request gets a TRACE — a W3C `traceparent`-compatible
+    id accepted from (and echoed to) the client, generated otherwise —
+    that rides the queued request object through BOTH batchers;
+  * SPANS record the full latency decomposition: HTTP parse, admission
+    decision, queue wait, batch forming (with FAN-IN: one executor-run
+    span is parented by N coalesced request spans — the dynamic-batching
+    analogue of an RPC fan-out), pad-to-bucket overhead (rows padded vs
+    real, the wasted-compute metric the batch-fill histogram cannot
+    attribute per request), executor compile vs run wall time (hooked
+    per invocation in core/executor.py), de-batch slice, response write;
+    for generation: prefill, per-token decode-iteration spans with slot
+    occupancy, and the TTFT linkage;
+  * finished traces land in a BOUNDED store (FLAGS_trace_store) served at
+    /v1/traces[?last=N] and /v1/traces/<id>, in the flight ring (kinds
+    `trace.span` / `trace.request`, so crash dumps carry request state
+    and the unified chrome timeline renders request spans next to the
+    xplane device ops on one clock), and in the response itself
+    (`meta.trace` decomposition block + `traceparent` response header);
+  * the SLO engine (FLAGS_serving_slo_ms) counts every finished/shed
+    request as a good or bad event per model and refreshes multi-window
+    BURN-RATE gauges on every /metrics scrape via the registry's collect
+    hook (registry.SloTracker).
+
+Zero-cost contract (the FLAGS_monitor discipline): with
+FLAGS_trace_requests off, `start()` returns None after ONE flag read —
+no trace objects, no spans, no flight events, no registry entries exist
+on the request path.  The SLO engine is gated the same way on its own
+flag (empty FLAGS_serving_slo_ms = off).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .registry import SloTracker, default_registry
+from .registry import enabled as _monitor_enabled
+from .step import EPOCH_OFFSET
+
+# hard per-trace span cap: a long generation (one span per decode
+# iteration) must not grow a trace without bound; drops are counted on
+# the trace (`dropped_spans`)
+MAX_SPANS = 512
+
+# open-trace registry cap (crash-dump header state): leaked traces (a
+# caller that never finishes) evict oldest-first instead of growing
+MAX_OPEN = 1024
+
+
+def enabled() -> bool:
+    """Whether request-path call sites should trace (one flag read)."""
+    from ..flags import FLAGS
+
+    return FLAGS.trace_requests
+
+
+def pc_to_epoch(pc: float) -> float:
+    """perf_counter stamp -> epoch seconds (the span clock; the same
+    offset StepMonitor bridges flight spans with, so request spans,
+    executor spans and xplane device ops share one timeline)."""
+    return pc + EPOCH_OFFSET
+
+
+# ---------------------------------------------------------------------------
+# W3C traceparent (https://www.w3.org/TR/trace-context/)
+# ---------------------------------------------------------------------------
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]):
+    """-> (trace_id, parent_span_id) or None on anything malformed (an
+    unparseable header starts a fresh trace instead of failing the
+    request — propagation is best-effort by contract)."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, _flags = parts
+    if (len(version) != 2 or version == "ff"
+            or len(trace_id) != 32 or len(span_id) != 16):
+        return None
+    try:
+        if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+            return None
+        int(version, 16)
+    except ValueError:
+        return None
+    return trace_id, span_id
+
+
+# ---------------------------------------------------------------------------
+# Spans and traces
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    __slots__ = ("name", "span_id", "parent_id", "t0", "dur", "attrs")
+
+    def __init__(self, name, span_id, parent_id, t0, dur, attrs):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = float(t0)      # epoch seconds
+        self.dur = float(dur)    # seconds
+        self.attrs = attrs
+
+    def to_json(self) -> dict:
+        d = {"name": self.name, "span_id": self.span_id,
+             "parent_id": self.parent_id, "t0": round(self.t0, 6),
+             "dur_ms": round(self.dur * 1e3, 3)}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        return d
+
+
+# component span names summed into the decomposition, per trace kind, in
+# pipeline order.  These TILE the request window: queue.wait ends where
+# batch.form starts, batch.form where batch.exec starts, ... — so
+# sum(components) + unattributed == total by construction, and the
+# acceptance gate asserts unattributed <= 5% of wall clock.  Sub-spans
+# (batch.pad inside batch.form, executor.* inside batch.exec/prefill/
+# decode.step) are reported separately, never double-counted.
+_COMPONENTS = {
+    "predict": ("parse", "admission", "queue.wait", "batch.form",
+                "batch.exec", "debatch", "respond"),
+    "generate": ("parse", "admission", "queue.wait", "prefill",
+                 "decode", "deliver", "respond"),
+}
+_SUB_SPANS = ("batch.pad", "executor.compile", "executor.run",
+              "decode.step")
+
+
+class RequestTrace:
+    """One request's span tree; thread-safe (the HTTP handler thread and
+    the batcher scheduler thread both append)."""
+
+    __slots__ = ("trace_id", "kind", "model", "root", "spans", "status",
+                 "dropped_spans", "decomp", "client_parent", "_lock",
+                 "_done")
+
+    def __init__(self, kind: str, model: str,
+                 trace_id: Optional[str] = None,
+                 client_parent: Optional[str] = None,
+                 t0: Optional[float] = None):
+        self.trace_id = trace_id or new_trace_id()
+        self.client_parent = client_parent
+        self.kind = kind
+        self.model = model
+        self.status = "open"
+        self.dropped_spans = 0
+        self.decomp: Optional[dict] = None
+        self._lock = threading.Lock()
+        self._done = False
+        self.root = Span(kind, new_span_id(), client_parent,
+                         pc_to_epoch(time.perf_counter())
+                         if t0 is None else t0, 0.0, {})
+        self.spans: List[Span] = [self.root]
+
+    @property
+    def root_span_id(self) -> str:
+        return self.root.span_id
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def traceparent(self) -> str:
+        """The header value echoed to the client (root span id as the
+        parent of any further client-side spans)."""
+        return format_traceparent(self.trace_id, self.root.span_id)
+
+    def add_span(self, name: str, t0: float, t1: Optional[float] = None,
+                 dur: Optional[float] = None,
+                 parent_id: Optional[str] = None,
+                 span_id: Optional[str] = None,
+                 record_flight: bool = True, **attrs) -> Optional[Span]:
+        """Append one completed span (epoch t0; t1 or dur).  Shared spans
+        (a batch executed for N requests) pass ONE span_id into every
+        participating trace and record_flight only once — each trace owns
+        a copy whose parent is its own root, with the full parent list in
+        attrs (the fan-in contract).  No-op after finish()."""
+        if dur is None:
+            dur = 0.0 if t1 is None else (t1 - t0)
+        dur = max(0.0, float(dur))
+        sp = Span(name, span_id or new_span_id(),
+                  parent_id or self.root.span_id, t0, dur, attrs)
+        with self._lock:
+            if self._done:
+                return None
+            if len(self.spans) >= MAX_SPANS:
+                self.dropped_spans += 1
+                return None
+            self.spans.append(sp)
+        if record_flight and _monitor_enabled():
+            from . import flight
+
+            flight.record("trace.span", trace=self.trace_id,
+                          span=sp.span_id, name=name, model=self.model,
+                          t0=round(sp.t0, 6), dur=round(sp.dur, 6),
+                          **{k: v for k, v in attrs.items()
+                             if isinstance(v, (int, float, str, bool))})
+        return sp
+
+    def set_attr(self, **attrs) -> None:
+        self.root.attrs.update(attrs)
+
+    def finish(self, status: str = "ok",
+               t_end: Optional[float] = None) -> None:
+        """Close the root span, compute the decomposition, land the trace
+        in the store + flight ring.  Idempotent — the first caller wins
+        (a batcher-side error finish beats the handler's epilogue).
+        Stamps ride the bridged perf_counter clock like every span —
+        time.time() would drift off it under NTP slew on a long-lived
+        server."""
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+        self.status = status
+        self.root.dur = max(
+            0.0, (pc_to_epoch(time.perf_counter())
+                  if t_end is None else t_end) - self.root.t0)
+        self.decomp = self.decomposition()
+        _unregister_open(self)
+        _store.add(self)
+        if _monitor_enabled():
+            from . import flight
+
+            d = self.decomp
+            pad = d.get("padding") or {}
+            flight.record(
+                "trace.request", trace=self.trace_id, model=self.model,
+                trace_kind=self.kind, status=status,
+                t0=round(self.root.t0, 6), dur=round(self.root.dur, 6),
+                total_ms=d["total_ms"], decomposition=d,
+                padded_rows=pad.get("rows_padded", 0))
+
+    # -- decomposition ---------------------------------------------------
+    def decomposition(self) -> dict:
+        """Per-request latency decomposition from the span tree.  Before
+        finish() the total (and unattributed remainder) are omitted —
+        that partial form is what rides the response's meta.trace block
+        (the respond span cannot be measured before the response is
+        serialized)."""
+        if self.decomp is not None:
+            return self.decomp
+        comp_names = _COMPONENTS.get(self.kind, ())
+        by: Dict[str, float] = {}
+        exec_ms = {"compile": 0.0, "run": 0.0}
+        decode_ms, decode_steps = 0.0, 0
+        pad = None
+        with self._lock:
+            spans = list(self.spans)
+        for sp in spans[1:]:
+            if sp.name == "decode.step":
+                decode_ms += sp.dur * 1e3
+                decode_steps += 1
+            elif sp.name == "executor.compile":
+                exec_ms["compile"] += sp.dur * 1e3
+            elif sp.name == "executor.run":
+                exec_ms["run"] += sp.dur * 1e3
+            elif sp.name == "batch.pad":
+                pad = dict(sp.attrs, pad_ms=round(sp.dur * 1e3, 3))
+            elif sp.name in comp_names:
+                by[sp.name] = by.get(sp.name, 0.0) + sp.dur * 1e3
+        if decode_steps:
+            by["decode"] = decode_ms
+        out = {"components_ms": {k: round(v, 3)
+                                 for k, v in by.items()}}
+        if self._done:
+            total = self.root.dur * 1e3
+            out["total_ms"] = round(total, 3)
+            out["unattributed_ms"] = round(
+                max(0.0, total - sum(by.values())), 3)
+        if exec_ms["compile"] or exec_ms["run"]:
+            out["executor_ms"] = {k: round(v, 3)
+                                  for k, v in exec_ms.items()}
+        if decode_steps:
+            out["decode_steps"] = decode_steps
+        if pad is not None:
+            out["padding"] = pad
+        return out
+
+    def meta_block(self) -> dict:
+        """The in-response `meta.trace` block (partial decomposition —
+        the respond span and total are not measurable pre-response; the
+        full record is at /v1/traces/<id>)."""
+        return {"trace_id": self.trace_id,
+                "traceparent": self.traceparent(),
+                **self.decomposition()}
+
+    def to_json(self) -> dict:
+        d = {"trace_id": self.trace_id, "kind": self.kind,
+             "model": self.model, "status": self.status,
+             "t0": round(self.root.t0, 6),
+             "dur_ms": round(self.root.dur * 1e3, 3),
+             "traceparent": self.traceparent(),
+             "decomposition": self.decomposition(),
+             "spans": [s.to_json() for s in list(self.spans)]}
+        if self.dropped_spans:
+            d["dropped_spans"] = self.dropped_spans
+        if self.client_parent:
+            d["client_parent"] = self.client_parent
+        return d
+
+
+def add_shared_span(traces, name: str, t0: float, t1: float,
+                    floors=None, parent_id=None, per_attrs=None,
+                    fan_in_attrs=True, **attrs) -> Optional[str]:
+    """One logical span shared by N traces (the coalesced-batch fan-in):
+    every trace gets a copy under ONE span id; the flight ring sees it
+    once — via the first trace that ACCEPTS it, since a finished member
+    (waiter timed out before the batch ran) no-ops its add_span and
+    blindly electing traces[0] would drop the span from the ring for
+    the whole batch.
+
+    `floors` (parallel to traces, epoch seconds) clamps each copy's
+    START — a late joiner must not receive span time from before it
+    arrived, or its components would sum past its own wall clock (the
+    tiling contract the CI sum-gate asserts).  `per_attrs` (parallel
+    dicts) carries per-member attrs (slot, token index); `fan_in_attrs`
+    False drops the fan_in/parents bookkeeping for high-frequency spans
+    (per-token decode iterations)."""
+    items = [(t,
+              None if floors is None else floors[i],
+              {} if per_attrs is None else per_attrs[i])
+             for i, t in enumerate(traces) if t is not None]
+    if not items:
+        return None
+    sid = new_span_id()
+    if fan_in_attrs:
+        attrs = dict(attrs, fan_in=len(items),
+                     parents=[t.root_span_id for t, _, _ in items])
+    recorded = False
+    for tr, floor, extra in items:
+        t0_eff = t0 if floor is None else min(max(t0, floor), t1)
+        sp = tr.add_span(name, t0_eff, t1, span_id=sid,
+                         parent_id=parent_id,
+                         record_flight=not recorded,
+                         **dict(attrs, **extra))
+        recorded = recorded or sp is not None
+    return sid
+
+
+# ---------------------------------------------------------------------------
+# Trace store (/v1/traces) + open-trace registry (crash-dump state)
+# ---------------------------------------------------------------------------
+
+
+class TraceStore:
+    """Bounded id -> finished RequestTrace store, newest-wins eviction
+    (capacity FLAGS_trace_store read at insert so tests can shrink it)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._traces: "collections.OrderedDict[str, RequestTrace]" = \
+            collections.OrderedDict()
+
+    def add(self, trace: RequestTrace) -> None:
+        from ..flags import FLAGS
+
+        cap = max(1, int(FLAGS.trace_store))
+        with self._lock:
+            self._traces.pop(trace.trace_id, None)
+            self._traces[trace.trace_id] = trace
+            while len(self._traces) > cap:
+                self._traces.popitem(last=False)
+
+    def get(self, trace_id: str) -> Optional[RequestTrace]:
+        with self._lock:
+            return self._traces.get(trace_id)
+
+    def last(self, n: int = 20) -> List[RequestTrace]:
+        """Most recent first."""
+        with self._lock:
+            traces = list(self._traces.values())
+        return traces[::-1][:max(0, int(n))]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+_store = TraceStore()
+_open_lock = threading.Lock()
+_open_traces: "collections.OrderedDict[str, RequestTrace]" = \
+    collections.OrderedDict()
+_provider_registered = [False]
+
+
+def default_store() -> TraceStore:
+    return _store
+
+
+def _register_open(trace: RequestTrace) -> None:
+    with _open_lock:
+        _open_traces[trace.trace_id] = trace
+        while len(_open_traces) > MAX_OPEN:
+            _open_traces.popitem(last=False)
+    if not _provider_registered[0]:
+        _provider_registered[0] = True
+        from . import flight
+
+        flight.add_header_provider(_open_trace_header)
+
+
+def _unregister_open(trace: RequestTrace) -> None:
+    with _open_lock:
+        _open_traces.pop(trace.trace_id, None)
+
+
+def _open_trace_header() -> dict:
+    """Flight dump-header provider: the requests IN FLIGHT when the dump
+    fired — the first question of a serving postmortem."""
+    now = pc_to_epoch(time.perf_counter())
+    with _open_lock:
+        open_now = list(_open_traces.values())
+    if not open_now:
+        return {}
+    return {
+        "open_trace_count": len(open_now),
+        "open_traces": [
+            {"trace": t.trace_id, "model": t.model, "kind": t.kind,
+             "age_s": round(max(0.0, now - t.root.t0), 3),
+             "spans": len(t.spans)}
+            for t in open_now[-32:]
+        ],
+    }
+
+
+def wait_for(trace_id: str, timeout: float = 0.25) -> \
+        Optional[RequestTrace]:
+    """Read-your-writes for /v1/traces/<id>: a client that just read its
+    response can race the handler's trace.finish() by microseconds —
+    when the id is OPEN (in flight), wait briefly for it to land in the
+    store; an unknown id returns None immediately."""
+    deadline = time.monotonic() + timeout
+    while True:
+        tr = _store.get(trace_id)
+        if tr is not None:
+            return tr
+        with _open_lock:
+            is_open = trace_id in _open_traces
+        if not is_open or time.monotonic() >= deadline:
+            return None
+        time.sleep(0.005)
+
+
+def start(kind: str, model: str, traceparent: Optional[str] = None,
+          t0: Optional[float] = None) -> Optional[RequestTrace]:
+    """Begin a request trace, or None when FLAGS_trace_requests is off
+    (the zero-cost gate: every call site is `trace = tracing.start(...)`
+    + `if trace is not None` guards)."""
+    if not enabled():
+        return None
+    parsed = parse_traceparent(traceparent)
+    tr = RequestTrace(kind, model,
+                      trace_id=parsed[0] if parsed else None,
+                      client_parent=parsed[1] if parsed else None, t0=t0)
+    _register_open(tr)
+    return tr
+
+
+def reject(trace: Optional[RequestTrace], reason: str,
+           t0: Optional[float] = None) -> None:
+    """Close a trace that never reached the executor (shed / draining /
+    breaker-open / stopped): one `admission` span naming the outcome,
+    status `rejected:<reason>`.  A trace that ALREADY carries an
+    admission span was admitted and failed later (the batcher stop()
+    path) — only the status closes then; a second admission span with a
+    contradictory outcome would misreport where the request died."""
+    if trace is None:
+        return
+    now = pc_to_epoch(time.perf_counter())
+    with trace._lock:
+        admitted = any(s.name == "admission" for s in trace.spans)
+    if not admitted:
+        trace.add_span("admission", now if t0 is None else t0, now,
+                       outcome=reason)
+    trace.finish(status=f"rejected:{reason}", t_end=now)
+
+
+# ---------------------------------------------------------------------------
+# Executor span hook (core/executor.py _record_run_metrics)
+# ---------------------------------------------------------------------------
+
+_exec_ctx = threading.local()
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def executor_context(traces):
+    """Arm the current thread so executor compile/run wall times land as
+    sub-spans in every participating trace — the batchers wrap their
+    model calls in this."""
+    traces = [t for t in traces if t is not None]
+    prev = getattr(_exec_ctx, "traces", None)
+    _exec_ctx.traces = traces or None
+    try:
+        yield
+    finally:
+        _exec_ctx.traces = prev
+
+
+def note_executor(mode: str, t0_epoch: float, dur: float,
+                  compiled: bool) -> None:
+    """Called by the executor telemetry epilogue for every monitored run:
+    one thread-local read when no trace context is armed.  The executor
+    already flight-records its own span, so these copies skip the ring."""
+    traces = getattr(_exec_ctx, "traces", None)
+    if not traces:
+        return
+    name = "executor.compile" if compiled else "executor.run"
+    sid = new_span_id()
+    for tr in traces:
+        tr.add_span(name, t0_epoch, dur=dur, span_id=sid,
+                    record_flight=False, mode=mode)
+
+
+# ---------------------------------------------------------------------------
+# SLO engine (FLAGS_serving_slo_ms; burn-rate gauges via collect hook)
+# ---------------------------------------------------------------------------
+
+BURN_WINDOWS = (("5m", 300.0), ("30m", 1800.0), ("1h", 3600.0))
+
+_slo_lock = threading.Lock()
+_slo_trackers: Dict[str, SloTracker] = {}
+_slo_cfg_cache = [None, None]  # [raw string, parsed dict]
+_slo_hook_registered = [False]
+
+
+def parse_slo_config(raw: str) -> Dict[str, float]:
+    """"50" -> {"*": 50.0}; "demo=50,gen=500" -> per-model objectives
+    (a bare number entry is the default for unlisted models).  Malformed
+    entries are dropped — config must not fail a serving process."""
+    out: Dict[str, float] = {}
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            if "=" in part:
+                name, _, val = part.partition("=")
+                out[name.strip()] = float(val)
+            else:
+                out["*"] = float(part)
+        except ValueError:
+            from ..log import warning
+
+            warning("FLAGS_serving_slo_ms: ignoring malformed entry %r",
+                    part)
+    return out
+
+
+def slo_objective(model: str) -> Optional[float]:
+    """The model's latency objective in ms, or None when the SLO engine
+    is off for it (empty/unmatched FLAGS_serving_slo_ms)."""
+    from ..flags import FLAGS
+
+    raw = FLAGS.serving_slo_ms
+    if not raw:
+        return None
+    if _slo_cfg_cache[0] != raw:
+        _slo_cfg_cache[1] = parse_slo_config(raw)
+        _slo_cfg_cache[0] = raw
+    cfg = _slo_cfg_cache[1]
+    return cfg.get(model, cfg.get("*"))
+
+
+def slo_tracker(model: str) -> Optional[SloTracker]:
+    return _slo_trackers.get(model)
+
+
+def slo_observe(model: str, seconds: float, ok: bool = True) -> None:
+    """Count one finished/failed/shed request against the model's
+    objective.  Call sites gate on monitor.enabled(); this adds one flag
+    read and returns immediately when no objective is configured."""
+    obj = slo_objective(model)
+    if obj is None:
+        return
+    good = bool(ok) and seconds * 1e3 <= obj
+    tr = _slo_trackers.get(model)
+    if tr is None:
+        from ..flags import FLAGS
+
+        with _slo_lock:
+            tr = _slo_trackers.get(model)
+            if tr is None:
+                tr = SloTracker(model, obj,
+                                target=FLAGS.serving_slo_target)
+                _slo_trackers[model] = tr
+            if not _slo_hook_registered[0]:
+                _slo_hook_registered[0] = True
+                default_registry().add_collect_hook(_slo_collect)
+    tr.observe(good)
+    from .registry import counter
+
+    counter(f"serving.{model}.slo_good_total" if good
+            else f"serving.{model}.slo_bad_total").inc()
+
+
+def _slo_collect() -> None:
+    """Registry collect hook: refresh the burn-rate gauges lazily at
+    scrape time instead of per request."""
+    from .registry import gauge
+
+    for model, tr in list(_slo_trackers.items()):
+        gauge(f"serving.{model}.slo_objective_ms").set(tr.objective_ms)
+        for label, window in BURN_WINDOWS:
+            gauge(f"serving.{model}.slo_burn_rate_{label}").set(
+                tr.burn_rate(window))
+
+
+def slo_info(model: str) -> Optional[dict]:
+    """The /v1/models info block for a model's SLO state."""
+    obj = slo_objective(model)
+    if obj is None:
+        return None
+    from ..flags import FLAGS
+
+    out = {"objective_ms": obj, "target": FLAGS.serving_slo_target}
+    tr = _slo_trackers.get(model)
+    if tr is not None:
+        out["good_total"] = tr.good_total
+        out["bad_total"] = tr.bad_total
+        out["burn_rate"] = {label: round(tr.burn_rate(window), 4)
+                            for label, window in BURN_WINDOWS}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# test hygiene
+# ---------------------------------------------------------------------------
+
+
+def reset() -> None:
+    """Clear every module-level accumulator (trace store, open-trace
+    registry, SLO trackers + config cache) — test-fixture hygiene; the
+    registry collect hook stays registered (it no-ops with no trackers)."""
+    _store.clear()
+    with _open_lock:
+        _open_traces.clear()
+    with _slo_lock:
+        _slo_trackers.clear()
+        _slo_cfg_cache[0] = _slo_cfg_cache[1] = None

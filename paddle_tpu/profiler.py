@@ -297,9 +297,13 @@ def export_chrome_trace(trace_dir: str, out_path: str, max_events=50000):
 # ---------------------------------------------------------------------------
 
 # flight-event kind prefix -> stable tid on the host process (chrome sorts
-# tids numerically; keep executor on top)
+# tids numerically; keep executor on top).  "trace" carries the request-
+# scoped serving spans (monitor/tracing.py trace.span / trace.request) —
+# their own track next to the executor spans and xplane device ops, all
+# on the one bridged clock.
 _HOST_TIDS = (
     ("executor", 0), ("step", 1), ("feed", 2), ("collective", 3),
+    ("trace", 4),
 )
 
 
@@ -325,16 +329,23 @@ def _flight_chrome_events(flight_events, trace_start_epoch, pid=1):
         args = {k: v for k, v in ev.items()
                 if k not in ("kind", "t0", "dur", "seq", "ts")
                 and isinstance(v, (int, float, str, bool))}
+        # request-trace events carry their span/request identity — name
+        # the chrome slice after it, not the generic event kind
+        name = kind
+        if kind == "trace.span":
+            name = f"trace:{ev.get('name', 'span')}"
+        elif kind == "trace.request":
+            name = f"request:{ev.get('model', '?')}"
         if "t0" in ev and "dur" in ev:  # span
             events.append({
-                "name": kind, "ph": "X", "pid": pid, "tid": tid,
+                "name": name, "ph": "X", "pid": pid, "tid": tid,
                 "ts": (ev["t0"] - trace_start_epoch) * 1e6,
                 "dur": float(ev["dur"]) * 1e6,
                 "args": args,
             })
         else:  # instant (recompile, watchdog trip, signal, ...)
             events.append({
-                "name": kind, "ph": "i", "s": "p", "pid": pid, "tid": tid,
+                "name": name, "ph": "i", "s": "p", "pid": pid, "tid": tid,
                 "ts": (ev.get("ts", trace_start_epoch)
                        - trace_start_epoch) * 1e6,
                 "args": args,

@@ -54,6 +54,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..flags import FLAGS
+from ..monitor import tracing
 from .model import ServingModel, item_signature
 
 # batch-fill is a fraction of the executed bucket: fixed 0..1 ladder
@@ -126,6 +127,21 @@ def _record_shed(counter_name: str, reason: str, retry_after_s: float,
                   retry_after_s=round(retry_after_s, 4), **flight_fields)
 
 
+def _slo_bad(model_name: str) -> None:
+    """One bad SLO event for a model (shed / timeout / expiry / error) —
+    shared by both batcher kinds; no-op unless FLAGS_serving_slo_ms names
+    the model.  Counted exactly ONCE per request, always on the path
+    that delivers the failure to the caller (the submit waiter, or the
+    admission check that raises) — scheduler-side failure paths set
+    `req.error` and let the waiter count, so a request that both times
+    out client-side and later expires scheduler-side is one bad event,
+    not two."""
+    from .. import monitor
+
+    if monitor.enabled():
+        tracing.slo_observe(model_name, 0.0, ok=False)
+
+
 def _fail_waiters(q: "queue.Queue", pending, message: str) -> None:
     """Fail every request still in `pending` (a deque) or `q` with the
     NAMED 503 and set their events — the shared stop()/scheduler-death
@@ -142,6 +158,7 @@ def _fail_waiters(q: "queue.Queue", pending, message: str) -> None:
             leftovers.append(r)
     for r in leftovers:
         r.error = Unavailable(message, reason="stopped")
+        tracing.reject(getattr(r, "trace", None), "stopped")
         r.event.set()
 
 
@@ -234,9 +251,11 @@ class CircuitBreaker:
 
 class _Request:
     __slots__ = ("feed", "rows", "sig", "precision", "t_enqueue",
-                 "deadline", "event", "outputs", "meta", "error")
+                 "deadline", "event", "outputs", "meta", "error",
+                 "trace", "t_exec_end")
 
-    def __init__(self, feed, rows, sig, precision, timeout=None):
+    def __init__(self, feed, rows, sig, precision, timeout=None,
+                 trace=None):
         self.feed = feed
         self.rows = rows
         self.sig = sig
@@ -251,6 +270,11 @@ class _Request:
         self.outputs = None
         self.meta = None
         self.error = None
+        # request-scoped trace (monitor/tracing.py): None unless
+        # FLAGS_trace_requests — the trace id rides the queued request
+        # through the scheduler so queue/form/exec/debatch spans attach
+        self.trace = trace
+        self.t_exec_end = None  # scheduler exec-done stamp (trace only)
 
 
 class DynamicBatcher:
@@ -347,11 +371,17 @@ class DynamicBatcher:
 
     # -- client side -----------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
-               precision: str = "fp32", timeout: float = 30.0):
+               precision: str = "fp32", timeout: float = 30.0,
+               trace=None):
         """Block until the batch containing this request executes; returns
-        (outputs list parallel to fetch_names, batch meta dict)."""
+        (outputs list parallel to fetch_names, batch meta dict).  `trace`
+        is the request's RequestTrace (or None, the no-tracing fast
+        path): the batcher attaches the queue/form/exec/debatch spans and
+        closes the trace on rejection."""
         from .. import monitor
 
+        if trace is not None:
+            t_submit0 = time.perf_counter()
         self.model.predictor(precision)  # validate precision early
         missing = [n for n in self.model.feed_names if n not in feed]
         if missing:
@@ -378,6 +408,8 @@ class DynamicBatcher:
         # -- admission control (after validation: a malformed request is
         # a 4xx, not a shed) ---------------------------------------------
         if self._draining:
+            _slo_bad(self.model.name)
+            tracing.reject(trace, "draining")
             raise Unavailable(
                 f"model {self.model.name!r} is draining", reason="draining")
         # queue depth BEFORE the breaker: a shed must not consume the
@@ -387,12 +419,14 @@ class DynamicBatcher:
         if depth > 0 and self._queue.qsize() + len(self._spill) >= depth:
             self._shed("queue_depth",
                        f"model {self.model.name!r}: request queue full "
-                       f"({depth} queued)")
+                       f"({depth} queued)", trace=trace)
         if not self.breaker.allow():
             if monitor.enabled():
                 monitor.counter(
                     f"serving.{self.model.name}.breaker_rejected_total"
                 ).inc()
+            _slo_bad(self.model.name)
+            tracing.reject(trace, "breaker_open")
             raise Unavailable(
                 f"model {self.model.name!r}: circuit breaker open "
                 f"({FLAGS.serving_breaker_threshold} consecutive executor "
@@ -400,7 +434,13 @@ class DynamicBatcher:
                 retry_after_s=FLAGS.serving_breaker_cooldown_s,
                 reason="breaker_open")
         req = _Request(feed, n_rows, item_signature(feed), precision,
-                       timeout=timeout)
+                       timeout=timeout, trace=trace)
+        if trace is not None:
+            # the admitted decision as a span: validation + admission
+            # checks, ending where the queue wait begins
+            trace.add_span("admission", tracing.pc_to_epoch(t_submit0),
+                           tracing.pc_to_epoch(req.t_enqueue),
+                           outcome="admitted", rows=n_rows)
 
         mon = monitor.enabled()
         inflight = (monitor.gauge(f"serving.{self.model.name}.inflight")
@@ -417,6 +457,9 @@ class DynamicBatcher:
                 if mon:
                     monitor.counter(
                         f"serving.{self.model.name}.timeouts").inc()
+                    _slo_bad(self.model.name)
+                if trace is not None:
+                    trace.finish(status="timeout")
                 raise req.error
         finally:
             if inflight is not None:
@@ -425,7 +468,16 @@ class DynamicBatcher:
             if mon:
                 monitor.counter(
                     f"serving.{self.model.name}.request_errors").inc()
+                _slo_bad(self.model.name)
             raise req.error
+        if trace is not None and req.t_exec_end is not None:
+            # de-batch + hand-off back to this thread: exec done (the
+            # scheduler's stamp) -> the waiter waking here.  Measured on
+            # the WAITER side so the thread-wakeup gap is attributed, not
+            # unaccounted.
+            trace.add_span("debatch", tracing.pc_to_epoch(req.t_exec_end),
+                           tracing.pc_to_epoch(time.perf_counter()),
+                           rows=req.rows)
         if mon:
             dt = time.perf_counter() - t0
             monitor.counter(f"serving.{self.model.name}.requests").inc()
@@ -434,6 +486,7 @@ class DynamicBatcher:
             monitor.histogram(
                 f"serving.{self.model.name}.request_seconds").observe(dt)
             monitor.histogram("serving.request_seconds").observe(dt)
+            tracing.slo_observe(self.model.name, dt, ok=True)
         return req.outputs, req.meta
 
     def retry_after(self) -> float:
@@ -443,12 +496,14 @@ class DynamicBatcher:
         return min(30.0, max(self.max_wait_s, 2.0 * self._queue_ewma_s,
                              0.05))
 
-    def _shed(self, reason: str, message: str) -> None:
+    def _shed(self, reason: str, message: str, trace=None) -> None:
         """Count + flight-tag one shed admission, then raise Overloaded
         (HTTP 429 + Retry-After)."""
         ra = self.retry_after()
         _record_shed(f"serving.{self.model.name}.shed_total", reason, ra,
                      model=self.model.name)
+        _slo_bad(self.model.name)
+        tracing.reject(trace, reason)
         raise Overloaded(message, retry_after_s=ra, reason=reason)
 
     # -- scheduler side --------------------------------------------------
@@ -497,8 +552,15 @@ class DynamicBatcher:
         r.error = TimeoutError(
             f"request expired before dispatch (deadline passed while "
             f"queued; model {self.model.name!r})")
+        if r.trace is not None:
+            r.trace.add_span("queue.wait",
+                             tracing.pc_to_epoch(r.t_enqueue),
+                             tracing.pc_to_epoch(time.perf_counter()))
+            r.trace.finish(status="expired")
         r.event.set()
         if monitor.enabled():
+            # no SLO count here: the waiter sees req.error and counts
+            # the bad event once (or already counted its own timeout)
             monitor.counter(
                 f"serving.{self.model.name}.expired_dropped_total").inc()
             monitor.counter("serving.expired_dropped_total").inc()
@@ -554,9 +616,10 @@ class DynamicBatcher:
                         break
                     continue
                 group = [first]
+                t_pickup = time.perf_counter()
                 try:
                     rows = self._collect(first, group)
-                    self._execute(group, rows)
+                    self._execute(group, rows, t_pickup)
                 except Exception as e:  # noqa: BLE001 — a scheduler
                     # crash would strand every current AND future
                     # caller behind a healthy-looking server: fail this
@@ -584,12 +647,15 @@ class DynamicBatcher:
             monitor.counter(
                 f"serving.{self.model.name}.scheduler_restarts").inc()
 
-    def _execute(self, group, rows: int) -> None:
+    def _execute(self, group, rows: int,
+                 t_pickup: Optional[float] = None) -> None:
         from .. import monitor
 
         model = self.model
         mon = monitor.enabled()
         t_start = time.perf_counter()
+        if t_pickup is None:
+            t_pickup = t_start
         # queue-latency EWMA (scheduler-thread-only write): the basis of
         # the Retry-After a shed response suggests
         self._queue_ewma_s += 0.2 * (
@@ -607,24 +673,78 @@ class DynamicBatcher:
             if mon:
                 monitor.counter(
                     f"serving.{model.name}.oversize_batches").inc()
+        traces = [r.trace for r in group if r.trace is not None]
+        if traces:
+            # queue.wait per request: enqueue -> the scheduler picking up
+            # this batch (late joiners clamp to zero — the batch formed
+            # around them while they arrived)
+            e_pickup = tracing.pc_to_epoch(t_pickup)
+            for r in group:
+                if r.trace is not None:
+                    e_enq = tracing.pc_to_epoch(r.t_enqueue)
+                    r.trace.add_span("queue.wait", e_enq,
+                                     max(e_enq, e_pickup))
+            t_pad0 = time.perf_counter()
         feed = {
             n: (np.concatenate([r.feed[n] for r in group], axis=0)
                 if len(group) > 1 else group[0].feed[n])
             for n in model.feed_names
         }
         feed = model.pad_feed(feed, rows, bucket)
+        t_exec0 = time.perf_counter()
+        if traces:
+            # batch.form: pickup -> dispatch (coalescing + concat + pad),
+            # the fan-in span parented by every member request; batch.pad
+            # attributes the wasted-compute rows the batch-fill histogram
+            # cannot pin on a request.  Each member's copy is FLOORED at
+            # its own enqueue stamp: a late joiner (arrived mid-collect)
+            # must not be handed span time from before it existed, or
+            # its components would sum past its own wall clock
+            form_sid = tracing.add_shared_span(
+                traces, "batch.form", tracing.pc_to_epoch(t_pickup),
+                tracing.pc_to_epoch(t_exec0),
+                floors=[tracing.pc_to_epoch(r.t_enqueue)
+                        for r in group if r.trace is not None],
+                rows=rows, bucket=bucket, coalesced=len(group))
+            tracing.add_shared_span(
+                traces, "batch.pad", tracing.pc_to_epoch(t_pad0),
+                tracing.pc_to_epoch(t_exec0), parent_id=form_sid,
+                fan_in_attrs=False, rows_real=rows,
+                rows_padded=bucket - rows, bucket=bucket,
+                fill=round(rows / bucket, 4))
         try:
-            outs = model.run_batch(group[0].precision, feed, rows, bucket,
-                                   group[0].sig)
+            if traces:
+                with tracing.executor_context(traces):
+                    outs = model.run_batch(group[0].precision, feed, rows,
+                                           bucket, group[0].sig)
+            else:
+                outs = model.run_batch(group[0].precision, feed, rows,
+                                       bucket, group[0].sig)
         except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
             self.breaker.record_failure()
             for r in group:
                 r.error = e
+                if r.trace is not None:
+                    r.trace.finish(status="error:batch")
                 r.event.set()
             if mon:
+                # SLO bad events land waiter-side (each member's submit
+                # sees req.error) — counting here too would double them
                 monitor.counter(f"serving.{model.name}.batch_errors").inc()
             return
         self.breaker.record_success()
+        if traces:
+            t_exec1 = time.perf_counter()
+            # the executor-run fan-in span: ONE batch execution parented
+            # by N request spans (executor.compile/run sub-spans landed
+            # via the executor_context hook)
+            tracing.add_shared_span(
+                traces, "batch.exec", tracing.pc_to_epoch(t_exec0),
+                tracing.pc_to_epoch(t_exec1), rows=rows, bucket=bucket,
+                precision=group[0].precision)
+            for r in group:
+                if r.trace is not None:
+                    r.t_exec_end = t_exec1
         if mon:
             monitor.counter(f"serving.{model.name}.batches").inc()
             monitor.counter(f"serving.{model.name}.padded_rows").inc(

@@ -37,8 +37,9 @@ from typing import List, Optional
 import numpy as np
 
 from ..flags import FLAGS
-from .batcher import (_STOP, _fail_waiters, _record_shed, CircuitBreaker,
-                      Overloaded, Unavailable)
+from ..monitor import tracing
+from .batcher import (_STOP, _fail_waiters, _record_shed, _slo_bad,
+                      CircuitBreaker, Overloaded, Unavailable)
 
 # TTFT is dominated by queue wait + one prefill + one decode step: a
 # finer-than-default ladder at the low end keeps p50 informative
@@ -70,9 +71,9 @@ class GenerationConfig:
 class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "t_enqueue", "deadline",
                  "t_first_token", "event", "tokens", "error", "meta",
-                 "cancelled")
+                 "cancelled", "trace", "t_done", "t_joined")
 
-    def __init__(self, prompt, max_tokens, timeout=None):
+    def __init__(self, prompt, max_tokens, timeout=None, trace=None):
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.t_enqueue = time.perf_counter()
@@ -90,6 +91,11 @@ class _GenRequest:
         # the next step instead of decoding the abandoned sequence to
         # its full budget (repeated timeouts must not starve the slots)
         self.cancelled = False
+        # request trace (None unless FLAGS_trace_requests): the prefill
+        # + per-iteration decode spans attach as the slot is scheduled
+        self.trace = trace
+        self.t_done = None    # scheduler finish stamp (trace only)
+        self.t_joined = None  # prefill-done stamp (trace only)
 
 
 class GenerationServingModel:
@@ -174,6 +180,9 @@ class GenerationServingModel:
             info["ttft_s"] = {"p50": ttft.quantile(0.5),
                               "p99": ttft.quantile(0.99),
                               "count": ttft.count}
+        slo = tracing.slo_info(self.name)
+        if slo is not None:
+            info["slo"] = slo
         return info
 
 
@@ -200,6 +209,12 @@ class ContinuousBatcher:
             [None] * model.slots
         self._slot_token = np.full((model.slots,), model.bos_id, np.int64)
         self._pending_join: collections.deque = collections.deque()
+        # iteration clock anchor (tracing only): each decode.step span
+        # starts where the previous iteration's span ENDED, so the
+        # scheduler's between-iteration overhead (queue poll, span
+        # bookkeeping, counters) is attributed to the iteration instead
+        # of leaking into the unattributed remainder; reset while idle
+        self._t_anchor: Optional[float] = None
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -262,11 +277,13 @@ class ContinuousBatcher:
 
     # -- client side -----------------------------------------------------
     def submit(self, prompt, max_tokens: Optional[int] = None,
-               timeout: float = 60.0):
+               timeout: float = 60.0, trace=None):
         """Block until the sequence finishes; returns (tokens, meta)."""
         from .. import monitor
 
         model = self.model
+        if trace is not None:
+            t_submit0 = time.perf_counter()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -289,6 +306,8 @@ class ContinuousBatcher:
         # -- admission control (validated requests only: bad input is a
         # 4xx, not a shed) ------------------------------------------------
         if self._draining:
+            _slo_bad(self.model.name)
+            tracing.reject(trace, "draining")
             raise Unavailable(
                 f"generation model {model.name!r} is draining",
                 reason="draining")
@@ -300,6 +319,8 @@ class ContinuousBatcher:
             ra = self.retry_after()
             _record_shed(f"serving.gen.{model.name}.shed_total",
                          "gen_queue_depth", ra, model=model.name)
+            _slo_bad(self.model.name)
+            tracing.reject(trace, "gen_queue_depth")
             raise Overloaded(
                 f"generation model {model.name!r}: slot wait-queue full "
                 f"({depth} waiting)",
@@ -309,13 +330,20 @@ class ContinuousBatcher:
                 monitor.counter(
                     f"serving.gen.{model.name}.breaker_rejected_total"
                 ).inc()
+            _slo_bad(self.model.name)
+            tracing.reject(trace, "breaker_open")
             raise Unavailable(
                 f"generation model {model.name!r}: circuit breaker open "
                 f"({FLAGS.serving_breaker_threshold} consecutive "
                 "prefill/decode failures; half-open probe pending)",
                 retry_after_s=FLAGS.serving_breaker_cooldown_s,
                 reason="breaker_open")
-        req = _GenRequest(prompt, mt, timeout=timeout)
+        req = _GenRequest(prompt, mt, timeout=timeout, trace=trace)
+        if trace is not None:
+            trace.add_span("admission", tracing.pc_to_epoch(t_submit0),
+                           tracing.pc_to_epoch(req.t_enqueue),
+                           outcome="admitted",
+                           prompt_len=len(prompt), max_tokens=mt)
         self._queue.put(req)
         if not req.event.wait(timeout):
             req.cancelled = True  # scheduler retires the slot next step
@@ -325,14 +353,33 @@ class ContinuousBatcher:
             if monitor.enabled():
                 monitor.counter(
                     f"serving.gen.{model.name}.timeouts").inc()
+                _slo_bad(self.model.name)
+            if trace is not None:
+                trace.finish(status="timeout")
             raise req.error
         if req.error is not None:
+            _slo_bad(self.model.name)
             raise req.error
+        if trace is not None:
+            # the scheduler-side finish -> this waiter waking (the last
+            # hand-off, measured waiter-side so the wakeup gap is
+            # attributed); plus the TTFT linkage on the root span
+            t_wake = time.perf_counter()
+            if req.t_done is not None:
+                trace.add_span("deliver",
+                               tracing.pc_to_epoch(req.t_done),
+                               tracing.pc_to_epoch(t_wake))
+            meta = req.meta or {}
+            trace.set_attr(tokens=len(req.tokens),
+                           ttft_ms=meta.get("ttft_ms"),
+                           finished=meta.get("finished"),
+                           slot=meta.get("slot"))
         if monitor.enabled():
             dt = time.perf_counter() - req.t_enqueue
             monitor.counter(f"serving.gen.{model.name}.requests").inc()
             monitor.histogram(
                 f"serving.gen.{model.name}.request_seconds").observe(dt)
+            tracing.slo_observe(model.name, dt, ok=True)
         return req.tokens, req.meta
 
     def retry_after(self) -> float:
@@ -369,6 +416,8 @@ class ContinuousBatcher:
         while free and self._pending_join:
             req = self._pending_join.popleft()
             if req.cancelled:  # timed out while still queued
+                if req.trace is not None:
+                    req.trace.finish(status="cancelled")
                 continue
             if req.deadline is not None and now >= req.deadline:
                 # expired while waiting for a slot: never admitted, never
@@ -376,8 +425,15 @@ class ContinuousBatcher:
                 req.error = TimeoutError(
                     f"request expired before a cache slot freed "
                     f"(model {model.name!r})")
+                if req.trace is not None:
+                    req.trace.add_span(
+                        "queue.wait", tracing.pc_to_epoch(req.t_enqueue),
+                        tracing.pc_to_epoch(now))
+                    req.trace.finish(status="expired")
                 req.event.set()
                 if monitor.enabled():
+                    # no SLO count here: the waiter sees req.error
+                    # and counts the bad event once
                     monitor.counter(
                         f"serving.gen.{model.name}.expired_dropped_total"
                     ).inc()
@@ -397,7 +453,38 @@ class ContinuousBatcher:
         for slot, req in joining:
             src[slot, :len(req.prompt), 0] = req.prompt
             active[slot] = 1.0
-        model.session.prefill(src, active=active)
+        traces = [req.trace for _, req in joining
+                  if req.trace is not None]
+        if traces:
+            t_pre0 = time.perf_counter()
+            for slot, req in joining:
+                if req.trace is not None:
+                    # slot wait: enqueue -> this admission round
+                    req.trace.add_span(
+                        "queue.wait", tracing.pc_to_epoch(req.t_enqueue),
+                        tracing.pc_to_epoch(t_pre0), slot=slot)
+            with tracing.executor_context(traces):
+                model.session.prefill(src, active=active)
+            # ONE masked prefill joins N sequences — the generation
+            # tier's fan-in span
+            t_pre1 = time.perf_counter()
+            tracing.add_shared_span(
+                traces, "prefill", tracing.pc_to_epoch(t_pre0),
+                tracing.pc_to_epoch(t_pre1), joined=len(joining))
+            for _, req in joining:
+                if req.trace is not None:
+                    # first decode.step span clamps to this: a joiner's
+                    # iteration accounting must not overlap its prefill
+                    req.t_joined = t_pre1
+            if self._t_anchor is None:
+                # start the iteration clock here (fresh/untraced slots):
+                # the first decode.step then covers the admit tail too.
+                # With the clock already running (traced sequences in
+                # flight), leave it — their next iteration span must
+                # keep the prefill stall they just sat through.
+                self._t_anchor = time.perf_counter()
+        else:
+            model.session.prefill(src, active=active)
         if monitor.enabled():
             monitor.counter(
                 f"serving.gen.{model.name}.prefills").inc(len(joining))
@@ -414,11 +501,29 @@ class ContinuousBatcher:
             np.float32)
         if not active.any():
             return False
+        # iteration-level accounting (the Orca pattern): one decode.step
+        # span per scheduled iteration in EVERY occupied slot's trace,
+        # carrying the slot + occupancy; covers the whole iteration
+        # (mask build, executor call, bookkeeping) so the per-token
+        # decomposition tiles the request window
+        traced = [(slot, r) for slot, r in enumerate(self._slot_req)
+                  if r is not None and r.trace is not None]
+        occupancy = int(active.sum())
+        if traced:
+            t_it0 = (self._t_anchor if self._t_anchor is not None
+                     else time.perf_counter())
         chaos.maybe_serve_latency()
-        nxt = model.session.decode_step(self._slot_token, active=active)
+        if traced:
+            with tracing.executor_context([r.trace for _, r in traced]):
+                nxt = model.session.decode_step(self._slot_token,
+                                                active=active)
+        else:
+            nxt = model.session.decode_step(self._slot_token,
+                                            active=active)
         now = time.perf_counter()
         mon = monitor.enabled()
         emitted = 0
+        finished: List[_GenRequest] = []
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
@@ -430,12 +535,16 @@ class ContinuousBatcher:
                 # iteration boundary instead of decoding the rest of
                 # its budget
                 self._slot_req[slot] = None
+                if req.trace is not None:
+                    req.trace.finish(
+                        status="expired" if expired else "cancelled")
                 if expired and not req.cancelled:
                     req.error = TimeoutError(
                         f"generation deadline passed mid-decode "
                         f"(model {model.name!r}, slot {slot})")
                     req.event.set()
                     if mon:
+                        # SLO bad event lands waiter-side via req.error
                         monitor.counter(
                             f"serving.gen.{model.name}."
                             "expired_slots_total").inc()
@@ -462,7 +571,31 @@ class ContinuousBatcher:
                                  else "max_tokens"),
                 }
                 self._slot_req[slot] = None  # retire the slot
-                req.event.set()
+                finished.append(req)
+        if traced:
+            t_it1 = time.perf_counter()
+            e_it0, e_it1 = (tracing.pc_to_epoch(t_it0),
+                            tracing.pc_to_epoch(t_it1))
+            # one shared iteration span, floored per trace at its OWN
+            # prefill end (the shared anchor may predate a late join)
+            tracing.add_shared_span(
+                [req.trace for _, req in traced], "decode.step",
+                e_it0, max(e_it0, e_it1),
+                floors=[None if req.t_joined is None
+                        else tracing.pc_to_epoch(req.t_joined)
+                        for _, req in traced],
+                per_attrs=[{"slot": slot,
+                            "token_index": max(0, len(req.tokens) - 1)}
+                           for slot, req in traced],
+                fan_in_attrs=False, occupancy=occupancy)
+            self._t_anchor = time.perf_counter()
+        # wake the finished waiters only AFTER the iteration's spans are
+        # recorded — a waiter closing its trace must not race the final
+        # decode.step span out of the decomposition
+        for req in finished:
+            if req.trace is not None:
+                req.t_done = time.perf_counter()
+            req.event.set()
         if mon:
             monitor.counter(f"serving.gen.{model.name}.tokens").inc(
                 emitted)
@@ -485,7 +618,11 @@ class ContinuousBatcher:
                 continue
             self._slot_req[slot] = None
             req.error = exc
+            if req.trace is not None:
+                req.trace.finish(status="error:step")
             req.event.set()
+        # SLO bad events land waiter-side (each waiter sees req.error) —
+        # counting per slot here would double them
         if monitor.enabled():
             monitor.counter(
                 f"serving.gen.{self.model.name}.step_errors").inc()
@@ -494,6 +631,8 @@ class ContinuousBatcher:
         try:
             while self._running:
                 idle = not any(r is not None for r in self._slot_req)
+                if idle:
+                    self._t_anchor = None  # iteration clock stops
                 if not self._drain_queue(block=idle):
                     break
                 try:
@@ -514,6 +653,7 @@ class ContinuousBatcher:
                 r.error = Unavailable(
                     f"generation batcher for {self.model.name!r} stopped",
                     reason="stopped")
+                tracing.reject(r.trace, "stopped")
                 r.event.set()
             self._fail_queued()
 

@@ -305,4 +305,11 @@ class ServingModel:
             info["latency_s"] = {"p50": lat.quantile(0.5),
                                  "p99": lat.quantile(0.99),
                                  "count": lat.count}
+        # SLO state (FLAGS_serving_slo_ms): objective + good/bad totals +
+        # the multi-window burn rates the /metrics gauges expose
+        from ..monitor import tracing
+
+        slo = tracing.slo_info(self.name)
+        if slo is not None:
+            info["slo"] = slo
         return info

@@ -43,9 +43,10 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from ..monitor import serve as mserve
+from ..monitor import tracing
 from ..monitor.registry import _json_safe
 from .batcher import (DynamicBatcher, Overloaded, Unavailable,
-                      _record_shed)
+                      _record_shed, _slo_bad)
 from .model import ModelConfig, ServingModel
 
 
@@ -143,11 +144,13 @@ class ServingHandler(mserve.MonitorHandler):
 
     # -- POST: prediction ------------------------------------------------
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        trace = None
         try:
+            t_req0 = time.perf_counter()
             url = urlparse(self.path)
             gen_name = self._generate_target(url.path)
             if gen_name is not None:
-                self._do_generate(gen_name)
+                self._do_generate(gen_name, t_req0)
                 return
             name = self._predict_target(url.path)
             if name is None:
@@ -160,6 +163,13 @@ class ServingHandler(mserve.MonitorHandler):
             if model is None:
                 self._send_json(404, {"error": f"no model {name!r}"})
                 return
+            # request trace: accept the client's W3C traceparent (the
+            # id correlates client and server records), generate one
+            # otherwise; the root span opens at request arrival
+            trace = tracing.start(
+                "predict", name,
+                traceparent=self.headers.get("traceparent"),
+                t0=tracing.pc_to_epoch(t_req0))
             length = int(self.headers.get("Content-Length", 0))
             if length <= 0:
                 raise RequestError(411, "request body required")
@@ -168,6 +178,10 @@ class ServingHandler(mserve.MonitorHandler):
                      or "application/json").lower()
             specs = model.feed_specs
             feed, opts = _decode_inputs(body, ctype, specs)
+            if trace is not None:
+                trace.add_span("parse", tracing.pc_to_epoch(t_req0),
+                               tracing.pc_to_epoch(time.perf_counter()),
+                               bytes=length)
             q = parse_qs(url.query)
             precision = str(opts.get(
                 "precision", q.get("precision", ["fp32"])[0]))
@@ -183,11 +197,17 @@ class ServingHandler(mserve.MonitorHandler):
                          f'{opts.get("timeout_s")!r}')
             try:
                 outs, meta = srv.submit(name, feed, precision=precision,
-                                        timeout=timeout)
+                                        timeout=timeout, trace=trace)
             except (KeyError, ValueError) as e:
                 raise RequestError(400, str(e))
             except TimeoutError as e:
                 raise RequestError(504, str(e))
+            if trace is not None:
+                # the in-response decomposition block (partial: the
+                # respond span lands in the stored trace, which the
+                # traceparent header points the client at)
+                meta = dict(meta, trace=trace.meta_block())
+            t_resp0 = time.perf_counter()
             want_npz = ("npz" in q.get("format", [""])[0]
                         or "npz" in (self.headers.get("Accept") or ""))
             data, out_ctype = _encode_outputs(
@@ -195,22 +215,40 @@ class ServingHandler(mserve.MonitorHandler):
             self.send_response(200)
             self.send_header("Content-Type", out_ctype)
             self.send_header("Content-Length", str(len(data)))
+            if trace is not None:
+                self.send_header("traceparent", trace.traceparent())
             self.end_headers()
             self.wfile.write(data)
+            if trace is not None:
+                t_done = time.perf_counter()
+                trace.add_span("respond", tracing.pc_to_epoch(t_resp0),
+                               tracing.pc_to_epoch(t_done),
+                               bytes=len(data))
+                trace.finish(status="ok",
+                             t_end=tracing.pc_to_epoch(t_done))
         except RequestError as e:
+            if trace is not None:
+                trace.finish(status=f"error:client:{e.code}")
             self._send_json(e.code, {"error": str(e)})
         except Overloaded as e:
             # admission control shed: fail fast, tell the client when a
-            # retry would realistically be served (queue-latency EWMA)
+            # retry would realistically be served (queue-latency EWMA).
+            # The batcher already closed the trace with the shed reason.
+            if trace is not None:
+                trace.finish(status=f"rejected:{e.reason}")
             self._send_json(
                 429, {"error": str(e), "reason": e.reason,
                       "retry_after_s": round(e.retry_after_s, 4)},
                 headers={"Retry-After": e.retry_after_header})
         except Unavailable as e:
+            if trace is not None:
+                trace.finish(status=f"rejected:{e.reason}")
             hdr = e.retry_after_header
             self._send_json(503, {"error": str(e), "reason": e.reason},
                             headers={"Retry-After": hdr} if hdr else None)
         except Exception as e:  # noqa: BLE001 — a request must not kill serving
+            if trace is not None:
+                trace.finish(status="error:server")
             try:
                 self._send_json(500, {
                     "error": f"{type(e).__name__}: {e}"})
@@ -238,7 +276,8 @@ class ServingHandler(mserve.MonitorHandler):
                 return rest[:-len(suffix)]
         return None
 
-    def _do_generate(self, name: str) -> None:
+    def _do_generate(self, name: str,
+                     t_req0: Optional[float] = None) -> None:
         """POST /v1/models/<name>:generate — continuous-batched
         autoregressive generation.  JSON body:
             {"prompt": [token ids...], "max_tokens": N,
@@ -248,12 +287,19 @@ class ServingHandler(mserve.MonitorHandler):
         (no retrace, no stall of other sequences) and returns when its
         sequence emits eos or exhausts its token budget."""
         srv = self.server.inference_server
+        trace = None
+        if t_req0 is None:
+            t_req0 = time.perf_counter()
         try:
             gen = srv.generation_model(name)
             if gen is None:
                 raise RequestError(
                     404, f"no generation model {name!r} "
                          f"(served: {sorted(srv._gen_models)})")
+            trace = tracing.start(
+                "generate", name,
+                traceparent=self.headers.get("traceparent"),
+                t0=tracing.pc_to_epoch(t_req0))
             length = int(self.headers.get("Content-Length", 0))
             if length <= 0:
                 raise RequestError(411, "request body required")
@@ -264,6 +310,10 @@ class ServingHandler(mserve.MonitorHandler):
             if not isinstance(payload, dict) or "prompt" not in payload:
                 raise RequestError(
                     400, 'JSON body must carry a "prompt" id list')
+            if trace is not None:
+                trace.add_span("parse", tracing.pc_to_epoch(t_req0),
+                               tracing.pc_to_epoch(time.perf_counter()),
+                               bytes=length)
             try:
                 timeout = float(payload.get("timeout_s", 60.0))
             except (TypeError, ValueError):
@@ -272,15 +322,43 @@ class ServingHandler(mserve.MonitorHandler):
                 tokens, meta = srv.submit_generate(
                     name, payload["prompt"],
                     max_tokens=payload.get("max_tokens"),
-                    timeout=timeout)
+                    timeout=timeout, trace=trace)
             except (TypeError, ValueError) as e:
                 raise RequestError(400, str(e))
             except TimeoutError as e:
                 raise RequestError(504, str(e))
-            self._send_json(200, {"tokens": [int(t) for t in tokens],
-                                  "meta": meta})
+            if trace is not None:
+                meta = dict(meta or {}, trace=trace.meta_block())
+            t_resp0 = time.perf_counter()
+            body = json.dumps(_json_safe(
+                {"tokens": [int(t) for t in tokens],
+                 "meta": meta})) + "\n"
+            self._send(200, body, "application/json",
+                       extra_headers=({"traceparent": trace.traceparent()}
+                                      if trace is not None else None))
+            if trace is not None:
+                t_done = time.perf_counter()
+                trace.add_span("respond", tracing.pc_to_epoch(t_resp0),
+                               tracing.pc_to_epoch(t_done),
+                               bytes=len(body))
+                trace.finish(status="ok",
+                             t_end=tracing.pc_to_epoch(t_done))
         except RequestError as e:
+            if trace is not None:
+                trace.finish(status=f"error:client:{e.code}")
             self._send_json(e.code, {"error": str(e)})
+        except (Overloaded, Unavailable) as e:
+            if trace is not None:
+                trace.finish(status=f"rejected:{e.reason}")
+            raise
+        except Exception:
+            # anything else (e.g. BrokenPipeError writing the response)
+            # escapes to do_POST's generic 500 path, whose own `trace`
+            # local is None — close THIS trace here or it leaks open
+            # (never stored, never flight-recorded) until evicted
+            if trace is not None:
+                trace.finish(status="error:server")
+            raise
 
     def _send_json(self, code: int, body: dict,
                    headers: Optional[dict] = None) -> None:
@@ -530,42 +608,77 @@ class InferenceServer:
 
     # -- serving ---------------------------------------------------------
     def submit(self, name: str, feed, precision: str = "fp32",
-               timeout: float = 30.0):
+               timeout: float = 30.0, trace=None):
         """Programmatic entry (the HTTP handler and in-process callers
-        share the same batcher path)."""
+        share the same batcher path).  `trace` is the HTTP handler's
+        RequestTrace; an in-process caller with tracing on gets a root
+        trace of its own (finished here — there is no respond phase)."""
         batcher = self._batchers.get(name)
         if batcher is None:
             raise KeyError(f"no model {name!r} "
                            f"(served: {self.model_names})")
+        own_trace = None
+        if trace is None:
+            trace = own_trace = tracing.start("predict", name)
         if self._draining:
+            # server-level rejects are SLO bad events like batcher-level
+            # ones — burn rates must not read healthy mid-outage
+            _slo_bad(name)
+            tracing.reject(trace, "draining")
             raise Unavailable("server draining", reason="draining")
         self._chaos_flood(name, feed, precision)
-        self._admit_inflight(batcher.retry_after)
+        self._admit_inflight(batcher.retry_after, trace=trace, model=name)
         try:
-            return batcher.submit(feed, precision=precision,
-                                  timeout=timeout)
+            outs, meta = batcher.submit(feed, precision=precision,
+                                        timeout=timeout, trace=trace)
+        except Exception:
+            # in-process root: close it even on paths the batcher never
+            # saw (validation 4xx) — idempotent past a batcher finish
+            if own_trace is not None:
+                own_trace.finish(status="error")
+            raise
         finally:
             self._release_inflight()
+        if own_trace is not None:
+            # no respond phase in-process: finish first so the meta block
+            # carries the FULL decomposition (total + unattributed)
+            own_trace.finish(status="ok")
+            meta = dict(meta, trace=own_trace.meta_block())
+        return outs, meta
 
     def submit_generate(self, name: str, prompt, max_tokens=None,
-                        timeout: float = 60.0):
+                        timeout: float = 60.0, trace=None):
         """Programmatic generation entry (the HTTP :generate handler and
         in-process callers share the same continuous batcher)."""
         batcher = self._gen_batchers.get(name)
         if batcher is None:
             raise KeyError(f"no generation model {name!r} "
                            f"(served: {sorted(self._gen_models)})")
+        own_trace = None
+        if trace is None:
+            trace = own_trace = tracing.start("generate", name)
         if self._draining:
+            _slo_bad(name)
+            tracing.reject(trace, "draining")
             raise Unavailable("server draining", reason="draining")
-        self._admit_inflight(batcher.retry_after)
+        self._admit_inflight(batcher.retry_after, trace=trace, model=name)
         try:
-            return batcher.submit(prompt, max_tokens=max_tokens,
-                                  timeout=timeout)
+            tokens, meta = batcher.submit(prompt, max_tokens=max_tokens,
+                                          timeout=timeout, trace=trace)
+        except Exception:
+            if own_trace is not None:
+                own_trace.finish(status="error")
+            raise
         finally:
             self._release_inflight()
+        if own_trace is not None:
+            own_trace.finish(status="ok")
+            meta = dict(meta or {}, trace=own_trace.meta_block())
+        return tokens, meta
 
     # -- admission (server-level) ----------------------------------------
-    def _admit_inflight(self, retry_after) -> None:
+    def _admit_inflight(self, retry_after, trace=None,
+                        model: Optional[str] = None) -> None:
         """Count one admitted request; at the FLAGS_serving_max_inflight
         cap, shed with 429 instead (Retry-After from the target
         batcher's queue-latency EWMA).  The count always runs (it is the
@@ -583,6 +696,9 @@ class InferenceServer:
             ra = retry_after()
             _record_shed("serving.inflight_shed_total", "inflight_cap",
                          ra, cap=cap)
+            if model is not None:
+                _slo_bad(model)
+            tracing.reject(trace, "inflight_cap")
             raise Overloaded(
                 f"server in-flight cap reached ({cap} admitted)",
                 retry_after_s=ra, reason="inflight_cap")

@@ -96,6 +96,79 @@ class TestRegistry:
         assert by_name["n.depth"]["value"] == 2
         assert all("ts" in r for r in lines)
 
+    def test_quantile_inf_bucket_clamps_to_max_observed(self):
+        """Regression (ISSUE 14 satellite): one outlier past the top
+        bucket bound used to make quantile() return +Inf — /v1/models
+        then reported "p99": Infinity.  The +Inf tail now clamps to the
+        largest OBSERVED value."""
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+        for _ in range(99):
+            h.observe(0.005)
+        h.observe(50.0)  # single outlier beyond the last bound
+        assert h.quantile(0.5) == 0.01
+        p99 = h.quantile(0.999)
+        assert p99 == 50.0 and p99 != float("inf")
+        assert h.max == 50.0
+        # every observation past the top bound: still finite
+        h2 = reg.histogram("lat2", buckets=(0.01,))
+        h2.observe(3.0)
+        h2.observe(7.0)
+        assert h2.quantile(0.5) == 7.0
+        assert h2.quantile(0.99) == 7.0
+        # in-range behavior unchanged: bucket upper bound
+        h3 = reg.histogram("lat3", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5):
+            h3.observe(v)
+        assert h3.quantile(0.99) == 1.0
+        # max rides the snapshot for artifact consumers
+        assert h3.snapshot()["max"] == 0.5
+
+    def test_collect_hooks_run_at_snapshot(self):
+        reg = MetricsRegistry()
+        calls = []
+
+        def hook():
+            calls.append(1)
+            reg.gauge("derived.g").set(42)
+
+        reg.add_collect_hook(hook)
+        reg.add_collect_hook(hook)  # idempotent
+        text = reg.prometheus_text()
+        assert calls == [1]
+        assert "derived_g 42" in text
+
+        def broken():
+            raise RuntimeError("must not fail the scrape")
+
+        reg.add_collect_hook(broken)
+        assert "derived_g" in reg.prometheus_text()
+        reg.remove_collect_hook(hook)
+        reg.remove_collect_hook(broken)
+        calls.clear()
+        reg.snapshot()
+        assert calls == []
+
+    def test_slo_tracker_windows_and_burn_rate(self):
+        from paddle_tpu.monitor import SloTracker
+
+        tr = SloTracker("m", objective_ms=100.0, target=0.9)
+        t0 = 1_000_000.0
+        for _ in range(8):
+            tr.observe(True, now=t0)
+        for _ in range(2):
+            tr.observe(False, now=t0)
+        # 20% bad against a 10% budget -> burn rate 2.0
+        assert tr.burn_rate(300, now=t0 + 5) == pytest.approx(2.0)
+        assert tr.good_total == 8 and tr.bad_total == 2
+        # the bad events age out of the 5m window but stay in the 1h one
+        for _ in range(10):
+            tr.observe(True, now=t0 + 1000)
+        assert tr.burn_rate(300, now=t0 + 1000) == pytest.approx(0.0)
+        assert tr.burn_rate(3600, now=t0 + 1000) == pytest.approx(1.0)
+        # empty window burns nothing
+        assert tr.burn_rate(300, now=t0 + 10_000) == 0.0
+
     def test_thread_safety_smoke(self):
         reg = MetricsRegistry()
         c = reg.counter("smoke.calls")

@@ -1,0 +1,677 @@
+"""Request-scoped distributed tracing + SLO burn-rate accounting
+(ISSUE 14: monitor/tracing.py threaded through serving -> batcher ->
+executor -> decode).
+
+Covers the acceptance criteria: a dynamically-batched predict request
+and a multi-token generation both yield traces whose component sum
+matches wall clock within 5%; FLAGS_trace_requests off is zero-cost (no
+trace objects, no flight events, no registry entries); burn-rate gauges
+and /v1/traces ride the /metrics server; the chrome-trace export renders
+request spans on the shared flight/xplane clock.  Plus the satellites:
+W3C traceparent round-trip, fan-in span sharing across coalesced
+requests, pad-waste attribution, bounded trace-store memory under
+concurrent scrape load, crash dumps carrying in-flight request state,
+and the trace_report "Requests" section.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, profiler
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.monitor import default_registry, flight, tracing
+from paddle_tpu.monitor import serve as mserve
+from paddle_tpu.serving import InferenceServer, ModelConfig, Unavailable
+from paddle_tpu.serving.generation import build_demo_generation_model
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Default flags + empty registry/trace store around every test."""
+    FLAGS.reset()
+    default_registry().reset()
+    tracing.reset()
+    flight.default_recorder().clear()
+    yield
+    mserve.set_readiness_provider(None)
+    FLAGS.reset()
+    default_registry().reset()
+    tracing.reset()
+    flight.default_recorder().clear()
+
+
+def _export_fc_model(dirname, in_dim=6, out_dim=3, seed=3):
+    prog, startup = pt.Program(), pt.Program()
+    prog.random_seed = startup.random_seed = seed
+    with pt.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[in_dim], dtype="float32")
+        h = layers.fc(x, size=8, act="relu")
+        out = layers.fc(h, size=out_dim)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        pt.io.save_inference_model(dirname, ["x"], [out], exe,
+                                   main_program=prog, scope=scope)
+    return dirname
+
+
+@pytest.fixture(scope="module")
+def fc_dir(tmp_path_factory):
+    return _export_fc_model(str(tmp_path_factory.mktemp("tracing") / "fc"))
+
+
+def _server(fc_dir, buckets="1,2,4", trace=True, warmup=True, **flag_kw):
+    if trace:
+        FLAGS.trace_requests = True
+    for k, v in flag_kw.items():
+        FLAGS.set(k, v)
+    srv = InferenceServer(
+        [ModelConfig("demo", fc_dir, buckets=buckets)], port=0)
+    srv.start(warmup=warmup)
+    return srv
+
+
+def _predict(srv, rows=3, traceparent=None, timeout=30):
+    body = json.dumps(
+        {"inputs": {"x": [[0.1] * 6] * rows}}).encode()
+    headers = {"Content-Type": "application/json"}
+    if traceparent:
+        headers["traceparent"] = traceparent
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/models/demo:predict",
+        data=body, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.getheaders()), json.loads(r.read())
+
+
+def _get_json(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _components_ok(dec, label="", tol_frac=0.05, tol_abs_ms=0.5):
+    """The acceptance sum contract: components + unattributed == total,
+    and the unattributed remainder stays under 5% (+ jitter floor)."""
+    total = dec["total_ms"]
+    s = sum(dec["components_ms"].values())
+    tol = tol_frac * total + tol_abs_ms
+    assert abs(s + dec["unattributed_ms"] - total) <= tol, (label, dec)
+    assert dec["unattributed_ms"] <= tol, (label, dec)
+
+
+def _retry_timing(fn, attempts=3):
+    """Run one request-and-assert attempt up to `attempts` times.  The
+    5% sum contract is a TIMING gate: thread-handoff gaps between spans
+    inflate under CI CPU contention (a noisy neighbour can add ms-scale
+    scheduler delay to a ~15ms request), the same reason the serving A/B
+    gates run interleaved trials.  Structural assertions inside `fn`
+    stay strict — they pass or fail identically on every attempt."""
+    for i in range(attempts):
+        try:
+            return fn(i)
+        except AssertionError:
+            if i == attempts - 1:
+                raise
+            time.sleep(0.2)
+
+
+# ---------------------------------------------------------------------------
+# traceparent
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_parse_and_format():
+    tid, sid = "ab" * 16, "cd" * 8
+    hdr = tracing.format_traceparent(tid, sid)
+    assert hdr == f"00-{tid}-{sid}-01"
+    assert tracing.parse_traceparent(hdr) == (tid, sid)
+    assert tracing.parse_traceparent(hdr.upper()) == (tid, sid)
+    # malformed headers start a fresh trace instead of failing
+    for bad in (None, "", "garbage", "00-short-cdcdcdcdcdcdcdcd-01",
+                f"00-{tid}-{sid}",            # 3 segments
+                f"ff-{tid}-{sid}-01",         # reserved version
+                f"00-{'0' * 32}-{sid}-01",    # zero trace id
+                f"00-{tid}-{'0' * 16}-01",    # zero span id
+                f"00-{'zz' * 16}-{sid}-01"):  # non-hex
+        assert tracing.parse_traceparent(bad) is None, bad
+    # generated ids are valid by construction
+    t2 = tracing.new_trace_id()
+    s2 = tracing.new_span_id()
+    assert tracing.parse_traceparent(
+        tracing.format_traceparent(t2, s2)) == (t2, s2)
+
+
+def test_slo_config_parsing():
+    assert tracing.parse_slo_config("") == {}
+    assert tracing.parse_slo_config("50") == {"*": 50.0}
+    assert tracing.parse_slo_config("a=50, b=2.5") == {"a": 50.0,
+                                                      "b": 2.5}
+    assert tracing.parse_slo_config("25,a=50") == {"*": 25.0, "a": 50.0}
+    # malformed entries are dropped, not fatal
+    assert tracing.parse_slo_config("a=oops,b=3") == {"b": 3.0}
+    FLAGS.serving_slo_ms = "a=50,10"
+    assert tracing.slo_objective("a") == 50.0
+    assert tracing.slo_objective("other") == 10.0
+    FLAGS.serving_slo_ms = ""
+    assert tracing.slo_objective("a") is None
+
+
+# ---------------------------------------------------------------------------
+# zero-cost-off contract
+# ---------------------------------------------------------------------------
+
+
+def test_zero_cost_with_tracing_off(fc_dir):
+    """FLAGS_trace_requests off: no trace objects on the request path,
+    no trace store entries, no trace.* flight events, no SLO registry
+    entries — monitor itself stays on (the serving default)."""
+    srv = _server(fc_dir, trace=False)
+    try:
+        assert tracing.start("predict", "demo") is None
+        status, headers, payload = _predict(srv, rows=2)
+        assert status == 200
+        assert "traceparent" not in {k.lower() for k in headers}
+        assert "trace" not in payload["batch"]
+        outs, meta = srv.submit("demo", {"x": np.ones((1, 6), "f4")})
+        assert "trace" not in meta
+    finally:
+        srv.stop()
+    assert len(tracing.default_store()) == 0
+    assert tracing._open_traces == {}
+    evs = flight.default_recorder().events(kind="trace")
+    assert evs == []
+    assert not [n for n in default_registry().names() if "slo" in n]
+
+
+# ---------------------------------------------------------------------------
+# predict-path traces
+# ---------------------------------------------------------------------------
+
+
+def test_predict_trace_decomposition_and_header_echo(fc_dir):
+    srv = _server(fc_dir)
+
+    def attempt(i):
+        tid = f"{0xabababababababababababababababab + i:032x}"
+        t0 = time.perf_counter()
+        status, headers, payload = _predict(
+            srv, rows=3, traceparent=f"00-{tid}-{'cd' * 8}-01")
+        client_ms = (time.perf_counter() - t0) * 1e3
+        assert status == 200
+        hdr = {k.lower(): v for k, v in headers.items()}
+        # the client's trace id is echoed with OUR root span as parent
+        parsed = tracing.parse_traceparent(hdr["traceparent"])
+        assert parsed is not None and parsed[0] == tid
+        meta_trace = payload["batch"]["trace"]
+        assert meta_trace["trace_id"] == tid
+        assert "batch.exec" in meta_trace["components_ms"]
+
+        tr = _get_json(srv, f"/v1/traces/{tid}")
+        assert tr["status"] == "ok" and tr["kind"] == "predict"
+        assert tr["client_parent"] == "cd" * 8
+        kinds = {s["name"] for s in tr["spans"]}
+        assert {"parse", "admission", "queue.wait", "batch.form",
+                "batch.pad", "batch.exec", "debatch",
+                "respond"} <= kinds
+        # executor sub-span (warm ladder -> run, not compile)
+        assert "executor.run" in kinds
+        dec = tr["decomposition"]
+        _components_ok(dec, "predict")
+        # server window nests inside the client-measured wall clock
+        assert dec["total_ms"] <= client_ms + 1.0
+        # pad-to-bucket waste attributed per request: 3 rows -> bucket 4
+        pad = dec["padding"]
+        assert (pad["rows_real"], pad["rows_padded"],
+                pad["bucket"]) == (3, 1, 4)
+        assert pad["fill"] == pytest.approx(0.75)
+
+    try:
+        _retry_timing(attempt)
+    finally:
+        srv.stop()
+
+
+def test_fan_in_one_exec_span_parented_by_n_requests(fc_dir):
+    """Two coalesced requests share ONE batch.exec span id whose parents
+    list BOTH request root spans — the dynamic-batching fan-in."""
+    srv = _server(fc_dir)
+    try:
+        # widen the coalescing window so both submits land in one batch
+        batcher = srv._batchers["demo"]
+        batcher.max_wait_s = 0.25
+        results = {}
+
+        def go(name):
+            results[name] = srv.submit(
+                "demo", {"x": np.full((1, 6), 0.5, "f4")})
+
+        ts = [threading.Thread(target=go, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        metas = [results[i][1] for i in range(2)]
+        tids = [m["trace"]["trace_id"] for m in metas]
+        traces = [tracing.default_store().get(t).to_json() for t in tids]
+        execs = [next(s for s in tr["spans"]
+                      if s["name"] == "batch.exec") for tr in traces]
+        assert execs[0]["span_id"] == execs[1]["span_id"]
+        assert execs[0]["attrs"]["fan_in"] == 2
+        roots = {tr["spans"][0]["span_id"] for tr in traces}
+        assert set(execs[0]["attrs"]["parents"]) == roots
+        # each copy hangs off its OWN trace's root
+        for tr, ex in zip(traces, execs):
+            assert ex["parent_id"] == tr["spans"][0]["span_id"]
+        assert metas[0]["coalesced"] == 2
+    finally:
+        srv.stop()
+
+
+def test_inprocess_submit_gets_full_decomposition(fc_dir):
+    srv = _server(fc_dir)
+
+    def attempt(i):
+        outs, meta = srv.submit("demo", {"x": np.ones((2, 6), "f4")})
+        block = meta["trace"]
+        assert block["total_ms"] > 0
+        _components_ok(block, "in-process predict")
+        assert tracing.default_store().get(block["trace_id"]) is not None
+
+    try:
+        _retry_timing(attempt)
+    finally:
+        srv.stop()
+
+
+def test_rejected_request_trace_names_the_shed(fc_dir):
+    srv = _server(fc_dir)
+    try:
+        srv._batchers["demo"].begin_drain()
+        with pytest.raises(Unavailable):
+            srv.submit("demo", {"x": np.ones((1, 6), "f4")})
+    finally:
+        srv.stop()
+    rejected = [t for t in tracing.default_store().last(10)
+                if t.status.startswith("rejected:")]
+    assert rejected, [t.status for t in tracing.default_store().last(10)]
+    tr = rejected[0].to_json()
+    assert tr["status"] == "rejected:draining"
+    adm = [s for s in tr["spans"] if s["name"] == "admission"]
+    assert adm and adm[0]["attrs"]["outcome"] == "draining"
+
+
+def test_executor_compile_span_on_cold_signature(fc_dir):
+    """A cold-signature request traces the COMPILE wall time; the next
+    request on the warm signature traces a run span."""
+    srv = _server(fc_dir, warmup=False)
+    try:
+        _, meta1 = srv.submit("demo", {"x": np.ones((1, 6), "f4")})
+        tr1 = tracing.default_store().get(
+            meta1["trace"]["trace_id"]).to_json()
+        kinds1 = {s["name"] for s in tr1["spans"]}
+        assert "executor.compile" in kinds1
+        assert meta1["trace"]["executor_ms"]["compile"] > 0
+        _, meta2 = srv.submit("demo", {"x": np.ones((1, 6), "f4")})
+        tr2 = tracing.default_store().get(
+            meta2["trace"]["trace_id"]).to_json()
+        kinds2 = {s["name"] for s in tr2["spans"]}
+        assert "executor.run" in kinds2
+        assert "executor.compile" not in kinds2
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# generation traces
+# ---------------------------------------------------------------------------
+
+
+def _gen_server(**flag_kw):
+    FLAGS.trace_requests = True
+    for k, v in flag_kw.items():
+        FLAGS.set(k, v)
+    srv = InferenceServer([], port=0)
+    srv.add_generation_model(
+        build_demo_generation_model("gendemo", slots=4))
+    srv.start()
+    return srv
+
+
+def test_generation_trace_decode_iterations(fc_dir):
+    srv = _gen_server()
+
+    def attempt(i):
+        tid = f"{0x12121212121212121212121212121212 + i:032x}"
+        body = json.dumps({"prompt": [3, 5, 7],
+                           "max_tokens": 10}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/models/gendemo:generate",
+            data=body,
+            headers={"Content-Type": "application/json",
+                     "traceparent": f"00-{tid}-{'ef' * 8}-01"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=60) as r:
+            headers = dict(r.getheaders())
+            payload = json.loads(r.read())
+        client_ms = (time.perf_counter() - t0) * 1e3
+        assert tid in headers.get("traceparent", "")
+        tr = _get_json(srv, f"/v1/traces/{tid}")
+        assert tr["kind"] == "generate" and tr["status"] == "ok"
+        kinds = {s["name"] for s in tr["spans"]}
+        assert {"parse", "admission", "queue.wait", "prefill",
+                "decode.step", "deliver", "respond"} <= kinds
+        dec = tr["decomposition"]
+        # iteration accounting: one decode.step span per generated token
+        assert dec["decode_steps"] == len(payload["tokens"])
+        steps = [s for s in tr["spans"] if s["name"] == "decode.step"]
+        assert [s["attrs"]["token_index"] for s in steps] == \
+            list(range(len(steps)))
+        assert all(s["attrs"]["occupancy"] >= 1 for s in steps)
+        # TTFT linkage on the root span
+        root = tr["spans"][0]
+        assert root["attrs"]["ttft_ms"] == payload["meta"]["ttft_ms"]
+        assert root["attrs"]["tokens"] == len(payload["tokens"])
+        _components_ok(dec, "generation")
+        assert dec["total_ms"] <= client_ms + 1.0
+
+    try:
+        _retry_timing(attempt)
+    finally:
+        srv.stop()
+
+
+def test_generation_late_join_spans_do_not_overlap_prefill():
+    """A request joining mid-flight: its first decode.step span starts
+    AFTER its own prefill ends, while the in-flight sequence's iteration
+    span keeps the prefill stall it sat through."""
+    srv = _gen_server()
+
+    def attempt(i):
+        done = {}
+
+        def long_req():
+            done["long"] = srv.submit_generate(
+                "gendemo", [3, 5, 7], max_tokens=48)
+
+        t = threading.Thread(target=long_req)
+        t.start()
+        time.sleep(0.03)  # let the long request start decoding
+        _, meta_short = srv.submit_generate("gendemo", [9, 2],
+                                            max_tokens=2)
+        t.join(timeout=60)
+        short = tracing.default_store().get(
+            meta_short["trace"]["trace_id"]).to_json()
+        prefill = next(s for s in short["spans"]
+                       if s["name"] == "prefill")
+        steps = [s for s in short["spans"] if s["name"] == "decode.step"]
+        pre_end = prefill["t0"] + prefill["dur_ms"] / 1e3
+        assert steps and all(s["t0"] >= pre_end - 1e-4 for s in steps)
+        _components_ok(short["decomposition"], "late joiner")
+        long_tr = tracing.default_store().get(
+            done["long"][1]["trace"]["trace_id"]).to_json()
+        _components_ok(long_tr["decomposition"], "long generation")
+
+    try:
+        _retry_timing(attempt)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# /v1/traces endpoints + bounded store
+# ---------------------------------------------------------------------------
+
+
+def test_traces_endpoints_last_n_and_404(fc_dir):
+    srv = _server(fc_dir)
+    try:
+        ids = []
+        for i in range(3):
+            _, meta = srv.submit("demo", {"x": np.ones((1, 6), "f4")})
+            ids.append(meta["trace"]["trace_id"])
+        body = _get_json(srv, "/v1/traces?last=2")
+        assert body["enabled"] is True and body["stored"] == 3
+        got = [t["trace_id"] for t in body["traces"]]
+        assert got == [ids[2], ids[1]]  # most recent first
+        one = _get_json(srv, f"/v1/traces/{ids[0]}")
+        assert one["trace_id"] == ids[0]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(srv, "/v1/traces/" + "0" * 32)
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_trace_store_bounded_eviction(fc_dir):
+    FLAGS.trace_store = 4
+    srv = _server(fc_dir)
+    try:
+        ids = []
+        for _ in range(7):
+            _, meta = srv.submit("demo", {"x": np.ones((1, 6), "f4")})
+            ids.append(meta["trace"]["trace_id"])
+        store = tracing.default_store()
+        assert len(store) == 4
+        assert store.get(ids[0]) is None  # oldest evicted
+        assert store.get(ids[-1]) is not None
+    finally:
+        srv.stop()
+
+
+def test_concurrent_metrics_and_traces_scrapes_under_load(fc_dir):
+    """Satellite: the MonitorHandler shares the stdlib server with
+    predict traffic — concurrent /metrics + /v1/traces scrapes during
+    active load must return parseable payloads (no interleaving
+    corruption) and the trace store must stay bounded."""
+    FLAGS.trace_store = 16
+    srv = _server(fc_dir, serving_slo_ms="demo=250")
+    try:
+        stop = threading.Event()
+        errors = []
+
+        def submitter():
+            i = 0
+            while not stop.is_set():
+                try:
+                    srv.submit("demo",
+                               {"x": np.full((1 + i % 3, 6), 0.1, "f4")})
+                except Exception as e:  # noqa: BLE001 — recorded
+                    errors.append(("submit", repr(e)))
+                i += 1
+
+        def scraper(path, check):
+            while not stop.is_set():
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{srv.port}{path}",
+                            timeout=10) as r:
+                        assert r.status == 200
+                        check(r.read())
+                except Exception as e:  # noqa: BLE001 — recorded
+                    errors.append((path, repr(e)))
+
+        def check_metrics(raw):
+            text = raw.decode()
+            assert "serving_demo_request_seconds_bucket" in text or \
+                "executor_" in text
+
+        def check_traces(raw):
+            body = json.loads(raw)
+            assert isinstance(body["traces"], list)
+            assert body["stored"] <= 16
+
+        threads = ([threading.Thread(target=submitter)
+                    for _ in range(3)]
+                   + [threading.Thread(target=scraper,
+                                       args=("/metrics", check_metrics))
+                      for _ in range(2)]
+                   + [threading.Thread(
+                       target=scraper,
+                       args=("/v1/traces?last=10", check_traces))
+                      for _ in range(2)])
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors[:5]
+        assert len(tracing.default_store()) <= 16
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+
+def test_slo_engine_burn_rates_on_metrics(fc_dir):
+    # an objective every request MISSES: all events bad, burn > 0
+    srv = _server(fc_dir, serving_slo_ms="demo=0.0001")
+    try:
+        for _ in range(4):
+            srv.submit("demo", {"x": np.ones((1, 6), "f4")})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "serving_demo_slo_bad_total 4" in text
+        burn = [ln for ln in text.splitlines()
+                if ln.startswith("serving_demo_slo_burn_rate_5m ")]
+        assert burn and float(burn[0].split()[1]) > 1.0
+        assert "serving_demo_slo_objective_ms 0.0001" in text
+        # /v1/models surfaces the SLO block (finite p99 via the
+        # quantile clamp rides the same info payload)
+        info = _get_json(srv, "/v1/models/demo")
+        assert info["slo"]["bad_total"] == 4
+        assert info["slo"]["burn_rate"]["5m"] > 1.0
+        # a generous objective counts good and burns nothing
+        FLAGS.serving_slo_ms = "demo=60000"
+        tracing.reset()
+        srv.submit("demo", {"x": np.ones((1, 6), "f4")})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "serving_demo_slo_good_total 5" in text or \
+            "serving_demo_slo_good_total 1" in text
+        burn = [ln for ln in text.splitlines()
+                if ln.startswith("serving_demo_slo_burn_rate_5m ")]
+        assert burn and float(burn[0].split()[1]) == 0.0
+    finally:
+        srv.stop()
+
+
+def test_slo_shed_counts_bad(fc_dir):
+    srv = _server(fc_dir, serving_slo_ms="demo=1000")
+    try:
+        srv._batchers["demo"].begin_drain()
+        with pytest.raises(Unavailable):
+            srv.submit("demo", {"x": np.ones((1, 6), "f4")})
+    finally:
+        srv.stop()
+    tr = tracing.slo_tracker("demo")
+    assert tr is not None and tr.bad_total == 1 and tr.good_total == 0
+
+
+# ---------------------------------------------------------------------------
+# flight ring, crash dumps, unified timeline, trace_report
+# ---------------------------------------------------------------------------
+
+
+def test_flight_events_and_unified_timeline(fc_dir, tmp_path):
+    srv = _server(fc_dir)
+    try:
+        _, meta = srv.submit("demo", {"x": np.ones((3, 6), "f4")})
+    finally:
+        srv.stop()
+    evs = flight.default_recorder().events(kind="trace")
+    kinds = {e["kind"] for e in evs}
+    assert kinds == {"trace.span", "trace.request"}
+    req_ev = [e for e in evs if e["kind"] == "trace.request"][-1]
+    assert req_ev["trace"] == meta["trace"]["trace_id"]
+    assert req_ev["trace_kind"] == "predict"
+    assert req_ev["decomposition"]["components_ms"]
+    assert req_ev["padded_rows"] == 1  # 3 rows -> bucket 4
+
+    # the unified chrome export puts request spans on their own host
+    # track, on the SAME bridged clock as the executor spans
+    out = str(tmp_path / "merged.json")
+    profiler.export_unified_chrome_trace(out, trace_dir="")
+    doc = json.load(open(out))
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    trace_spans = [e for e in spans if e["name"].startswith("trace:")]
+    request_spans = [e for e in spans
+                     if e["name"].startswith("request:")]
+    exec_spans = [e for e in spans
+                  if e["name"].startswith("executor.")]
+    assert trace_spans and request_spans and exec_spans
+    assert {e["name"] for e in trace_spans} >= {
+        "trace:queue.wait", "trace:batch.exec", "trace:debatch"}
+    # one clock: every span inside a narrow shared window
+    all_ts = [e["ts"] for e in trace_spans + exec_spans]
+    assert max(all_ts) - min(all_ts) < 60e6
+    # the trace track is its own tid, separate from the executor's
+    assert {e["tid"] for e in trace_spans} == {4}
+    assert {e["tid"] for e in exec_spans} == {0}
+
+
+def test_crash_dump_carries_inflight_requests(tmp_path):
+    FLAGS.trace_requests = True
+    FLAGS.monitor = True
+    tr = tracing.start("predict", "demo")
+    tr.add_span("queue.wait", time.time(), time.time() + 0.01)
+    path = str(tmp_path / "dump.jsonl")
+    flight.default_recorder().dump(path=path, trigger="manual")
+    header = json.loads(open(path).readline())
+    assert header["open_trace_count"] == 1
+    (entry,) = header["open_traces"]
+    assert entry["trace"] == tr.trace_id
+    assert entry["model"] == "demo" and entry["spans"] == 2
+    # finishing clears the in-flight state
+    tr.finish()
+    flight.default_recorder().dump(path=path, trigger="manual")
+    header = json.loads(open(path).readline())
+    assert "open_trace_count" not in header
+
+
+def test_trace_report_requests_section(fc_dir, tmp_path):
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+
+    srv = _server(fc_dir)
+    try:
+        _, meta = srv.submit("demo", {"x": np.ones((3, 6), "f4")})
+    finally:
+        srv.stop()
+    out = str(tmp_path / "merged.json")
+    profiler.export_unified_chrome_trace(out, trace_dir="")
+    text = trace_report.report(json.load(open(out)))
+    assert "Requests (request-scoped traces" in text
+    assert meta["trace"]["trace_id"][:16] in text
+    assert "Padding waste" in text
+    assert "demo:predict: 1" in text
+
+
+def test_span_cap_bounds_trace_memory():
+    FLAGS.trace_requests = True
+    tr = tracing.start("predict", "demo")
+    for i in range(tracing.MAX_SPANS + 40):
+        tr.add_span("queue.wait", time.time(), dur=0.001)
+    assert len(tr.spans) == tracing.MAX_SPANS
+    assert tr.dropped_spans == 41  # +1: the root occupies a slot
+    tr.finish()
+    assert tr.to_json()["dropped_spans"] == 41

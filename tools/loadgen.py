@@ -145,8 +145,10 @@ class _Stats:
         self.lag = []  # open loop: send lateness vs schedule
         self.ttfts_ms = []  # generation mode: server-side TTFT per req
         self.tokens = 0     # generation mode: tokens received
+        self.traced = []    # --trace: (latency_s, trace_id) per success
 
-    def ok(self, dt: float, lag: float = 0.0, ttft_ms=None, tokens=0):
+    def ok(self, dt: float, lag: float = 0.0, ttft_ms=None, tokens=0,
+           trace_id=None):
         with self.lock:
             self.latencies.append(dt)
             if lag:
@@ -154,6 +156,8 @@ class _Stats:
             if ttft_ms is not None:
                 self.ttfts_ms.append(float(ttft_ms))
             self.tokens += tokens
+            if trace_id is not None:
+                self.traced.append((dt, trace_id))
 
     def saw_status(self, code: int):
         with self.lock:
@@ -185,17 +189,19 @@ class _Conn:
         self.timeout = timeout
         self.conn = None
 
-    def request_raw(self, target: str, body: bytes):
+    def request_raw(self, target: str, body: bytes, headers=None):
         """POST; returns (status, headers dict, body bytes), or None on
         a transport failure (one transparent reconnect)."""
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
         for attempt in (0, 1):
             try:
                 if self.conn is None:
                     self.conn = http.client.HTTPConnection(
                         self.host, self.port, timeout=self.timeout)
-                self.conn.request(
-                    "POST", target, body=body,
-                    headers={"Content-Type": "application/json"})
+                self.conn.request("POST", target, body=body,
+                                  headers=hdrs)
                 r = self.conn.getresponse()
                 data = r.read()
                 return r.status, dict(r.getheaders()), data
@@ -229,8 +235,18 @@ def _retry_after_hint(headers: dict, data: bytes):
         return None
 
 
+def make_traceparent(nonce: str, i: int) -> str:
+    """Client-generated W3C traceparent for request `i`: the client KNOWS
+    the trace id before sending, so the artifact can fetch the server's
+    decomposition for its own slowest requests afterwards (the client <->
+    server correlation loop)."""
+    return (f"00-{nonce}{i & 0xFFFFFFFFFFFFFFFF:016x}"
+            f"-{(i % 0xFFFFFFFFFFFFFFF) + 1:016x}-01")
+
+
 def _send_with_retry(conn: _Conn, target: str, body: bytes,
-                     stats: _Stats, retries: int, seed: int):
+                     stats: _Stats, retries: int, seed: int,
+                     headers=None):
     """POST with jittered exponential backoff (utils/retry.backoff_delays
     — the shared production policy) on transport failures and 429/503,
     honoring the server's Retry-After: the sleep is
@@ -243,7 +259,7 @@ def _send_with_retry(conn: _Conn, target: str, body: bytes,
                                    max_delay=2.0, seed=seed)
     while True:
         t0 = time.perf_counter()
-        resp = conn.request_raw(target, body)
+        resp = conn.request_raw(target, body, headers=headers)
         dt = time.perf_counter() - t0
         if resp is None:
             kind, retryable, hint = "transport", True, None
@@ -277,23 +293,26 @@ def _send_with_retry(conn: _Conn, target: str, body: bytes,
 
 def _fire(conn: _Conn, model: str, body: bytes, precision: str,
           stats: _Stats, lag: float = 0.0, retries: int = 0,
-          seed: int = 0) -> None:
+          seed: int = 0, trace_id=None, headers=None) -> None:
     target = f"/v1/models/{model}:predict"
     if precision != "fp32":
         target += f"?precision={precision}"
-    data, dt = _send_with_retry(conn, target, body, stats, retries, seed)
+    data, dt = _send_with_retry(conn, target, body, stats, retries, seed,
+                                headers=headers)
     if data is not None:
-        stats.ok(dt, lag)
+        stats.ok(dt, lag, trace_id=trace_id)
 
 
 def _fire_generate(conn: _Conn, model: str, body: bytes,
-                   stats: _Stats, retries: int = 0, seed: int = 0) -> None:
+                   stats: _Stats, retries: int = 0, seed: int = 0,
+                   trace_id=None, headers=None) -> None:
     """Prompt-in/tokens-out request: records the server-side TTFT from
     the response meta (the continuous batcher stamps time-to-first-token
     at the decode step that produced it) and the generated token count
     (client tokens/sec = sum(tokens) / wall)."""
     data, dt = _send_with_retry(conn, f"/v1/models/{model}:generate",
-                                body, stats, retries, seed)
+                                body, stats, retries, seed,
+                                headers=headers)
     if data is None:
         return
     try:
@@ -301,7 +320,8 @@ def _fire_generate(conn: _Conn, model: str, body: bytes,
         meta = payload.get("meta") or {}
         stats.ok(dt,
                  ttft_ms=meta.get("ttft_ms"),
-                 tokens=len(payload.get("tokens") or ()))
+                 tokens=len(payload.get("tokens") or ()),
+                 trace_id=trace_id)
     except ValueError:
         stats.terminal("bad_json")
 
@@ -349,6 +369,17 @@ def main(argv=None) -> int:
                         "(errors after retries / requests) exceeds this "
                         "(CI-gate consumable; 429s retried to success "
                         "are not errors)")
+    p.add_argument("--trace", action="store_true",
+                   help="send a client-generated W3C traceparent header "
+                        "per request (the server must run with "
+                        "FLAGS_trace_requests=1) and, after the run, "
+                        "fetch the server-side latency decomposition of "
+                        "the slowest requests from /v1/traces/<id> into "
+                        "the artifact's slow_traces field — the client<->"
+                        "server correlation loop")
+    p.add_argument("--trace-top", type=int, default=5,
+                   help="how many slowest requests to resolve against "
+                        "/v1/traces (with --trace)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default="",
                    help="write the JSON artifact here (always printed to "
@@ -400,6 +431,17 @@ def main(argv=None) -> int:
             for b in sizes
         ]
 
+    # --trace: client-generated trace ids (one nonce per run keeps ids
+    # unique against a long-lived server's bounded trace store)
+    trace_nonce = os.urandom(8).hex() if args.trace else None
+
+    def _trace_of(i):
+        """(trace_id, headers) for request i, or (None, None)."""
+        if trace_nonce is None:
+            return None, None
+        tp = make_traceparent(trace_nonce, i)
+        return tp.split("-")[1], {"traceparent": tp}
+
     prom_before = parse_prometheus(_get(f"{args.url}/metrics").decode())
     stats = _Stats()
     t_start = time.perf_counter()
@@ -417,14 +459,17 @@ def main(argv=None) -> int:
                         if i >= args.requests:
                             return
                         counter[0] += 1
+                    tid, hdrs = _trace_of(i)
                     if args.generate:
                         _fire_generate(conn, args.model,
                                        bodies[i % len(bodies)], stats,
-                                       retries=args.max_retries, seed=i)
+                                       retries=args.max_retries, seed=i,
+                                       trace_id=tid, headers=hdrs)
                     else:
                         _fire(conn, args.model, bodies[i % len(bodies)],
                               args.precision, stats,
-                              retries=args.max_retries, seed=i)
+                              retries=args.max_retries, seed=i,
+                              trace_id=tid, headers=hdrs)
             finally:
                 conn.close()
 
@@ -450,9 +495,11 @@ def main(argv=None) -> int:
                     if due > now:
                         time.sleep(due - now)
                     lag = max(0.0, time.perf_counter() - due)
+                    tid, hdrs = _trace_of(i)
                     _fire(conn, args.model, bodies[i % len(bodies)],
                           args.precision, stats, lag,
-                          retries=args.max_retries, seed=i)
+                          retries=args.max_retries, seed=i,
+                          trace_id=tid, headers=hdrs)
             finally:
                 conn.close()
 
@@ -467,6 +514,27 @@ def main(argv=None) -> int:
 
     prom_after = parse_prometheus(_get(f"{args.url}/metrics").decode())
     lat = np.asarray(sorted(stats.latencies)) if stats.latencies else None
+
+    # --trace: resolve the slowest requests' SERVER-side decomposition
+    # (the ids are client-generated, so this closes the correlation loop:
+    # "my p99 request spent X ms in the queue, Y padded rows, Z in exec")
+    slow_traces = None
+    if args.trace:
+        slow_traces = []
+        for dt, tid in sorted(stats.traced, reverse=True)[:args.trace_top]:
+            entry = {"trace_id": tid,
+                     "client_ms": round(dt * 1e3, 3)}
+            try:
+                server = _get_json(f"{args.url}/v1/traces/{tid}")
+                entry["server"] = {
+                    "total_ms": server.get("dur_ms"),
+                    "status": server.get("status"),
+                    "model": server.get("model"),
+                    "decomposition": server.get("decomposition"),
+                }
+            except Exception as e:  # noqa: BLE001 — evicted/disabled: say so
+                entry["server"] = {"error": f"{type(e).__name__}: {e}"}
+            slow_traces.append(entry)
 
     def delta(name):
         return (prom_after[0].get(name, 0.0)
@@ -530,6 +598,8 @@ def main(argv=None) -> int:
             round(float(np.percentile(stats.lag, 99)) * 1e3, 3)
             if stats.lag else None),
         "generation": generation,
+        "trace": bool(args.trace),
+        "slow_traces": slow_traces,
         "policy": {
             "buckets": info.get("buckets"),
             "max_batch": info.get("max_batch"),

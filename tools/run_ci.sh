@@ -241,20 +241,52 @@ if [[ "${1:-}" != "fast" ]]; then
   #     counter FLAT (warm bucket ladder, zero recompiles) and the
   #     request-latency p99 / batch-fill histograms on /metrics;
   #   * the A/B: dynamic batching must serve >= 2x the QPS of
-  #     batch-size-1 mode on the same single-row stream (interleaved
-  #     trial pairs absorb noisy-neighbour CI variance);
+  #     batch-size-1 mode on the same single-row stream — BOTH servers
+  #     chaos-latency-pinned (FLAGS_chaos_serve_latency_s) so capacity
+  #     is set by the injected per-batch cost, not the CI box
+  #     (box-independent gate; interleaved trial pairs still absorb
+  #     noisy-neighbour variance);
   #   * the overload gate: ~4x-capacity open-loop flood vs a
   #     chaos-latency-armed bounded-queue server — shedding engaged
   #     (429 + Retry-After), expired_dropped_total > 0 (deadline drops
   #     before dispatch, asserted via /metrics delta), zero crash-5xx,
   #     accepted p99 under the stated bound, compile counter FLAT; then
   #     SIGTERM mid-load drains in-flight work and exits 0 with a
-  #     drain-trigger flight dump.
+  #     drain-trigger flight dump;
+  #   * the tracing gate: a FLAGS_trace_requests server echoes the
+  #     client traceparent, serves /v1/traces span trees for predict +
+  #     generation, exposes SLO burn-rate gauges, and closes the
+  #     loadgen --trace correlation loop (trace_sample.json).
   # Artifacts: ci_artifacts/serving/loadgen_*.json + ab_summary.json
-  #            + overload_smoke.json (+ flight/ drain dump).
+  #            + overload_smoke.json + trace_sample.json (+ flight/).
   rm -rf ci_artifacts/serving && mkdir -p ci_artifacts/serving
   JAX_PLATFORMS=cpu python tools/serving_smoke.py \
     --out-dir ci_artifacts/serving
+  # Trace-sample contract: every span kind present across the archived
+  # predict+generate traces, and each decomposition must SUM to the
+  # measured end-to-end latency within tolerance (5% + 0.5ms jitter
+  # floor) — the "why was this request slow" story stays trustworthy.
+  python - <<'PY'
+import json
+d = json.load(open("ci_artifacts/serving/trace_sample.json"))
+kinds = set()
+for key in ("predict", "generate"):
+    tr = d[key]
+    dec = tr["decomposition"]
+    total = dec["total_ms"]
+    s = sum(dec["components_ms"].values())
+    tol = 0.05 * total + 0.5
+    assert abs(s + dec["unattributed_ms"] - total) <= tol, (key, dec)
+    assert dec["unattributed_ms"] <= tol, (key, dec)
+    kinds |= {sp["name"] for sp in tr["spans"]}
+need = {"parse", "admission", "queue.wait", "batch.form", "batch.pad",
+        "batch.exec", "debatch", "respond", "prefill", "decode.step",
+        "deliver", "executor.run"}
+missing = need - kinds
+assert not missing, f"span kinds missing from trace sample: {missing}"
+print(f"trace sample OK: decompositions sum within tolerance; "
+      f"{len(kinds)} span kinds present")
+PY
   echo "-- serving artifacts:"
   ls ci_artifacts/serving/
 fi

@@ -7,6 +7,10 @@
   * compile vs run vs feed-stall host time (the "where did the wall
     clock go" breakdown, from the flight spans),
   * recompile causes (which cache-key component churned, aggregated),
+  * a "Requests" section from the request-scoped traces
+    (monitor/tracing.py trace.request events): slowest traces with their
+    latency decomposition, and the padding-waste top-K (rows padded vs
+    real — wasted compute attributed per request),
   * watchdog trips and the last completed step (from the embedded
     flight header).
 
@@ -111,6 +115,17 @@ def watchdog_trips(doc: dict):
             if ev.get("kind") == "watchdog.trip"]
 
 
+def request_traces(doc: dict, k: int = 10):
+    """(all trace.request events, slowest-K, padding-waste top-K) from
+    the request-scoped tracing tier (monitor/tracing.py)."""
+    reqs = [ev for ev in doc.get("flight", {}).get("events", [])
+            if ev.get("kind") == "trace.request"]
+    slowest = sorted(reqs, key=lambda e: -float(e.get("dur", 0.0)))[:k]
+    padded = sorted((e for e in reqs if e.get("padded_rows")),
+                    key=lambda e: -int(e.get("padded_rows", 0)))[:k]
+    return reqs, slowest, padded
+
+
 def pipeline_stages(doc: dict):
     """Per-stage span aggregation + the last schedule summary from the
     pipeline tier's flight events (parallel/pipeline/trainer.py:
@@ -206,6 +221,51 @@ def report(doc: dict, k: int = 20) -> str:
                 f"{p}: {t:.4f}s/{c}" for p, (t, c) in
                 sorted(stages[ctx].items()))
             lines.append(f"  {ctx:<16} {parts}")
+
+    reqs, slowest, padded = request_traces(doc, k)
+    if reqs:
+        lines.append("")
+        kinds = {}
+        for ev in reqs:
+            key = f"{ev.get('model', '?')}:{ev.get('trace_kind', '?')}"
+            kinds[key] = kinds.get(key, 0) + 1
+        lines.append(
+            "Requests (request-scoped traces; "
+            + ", ".join(f"{k_}: {n}" for k_, n in sorted(kinds.items()))
+            + ")")
+        lines.append(
+            f"{'trace':<18} {'model':<12} {'status':<14} {'total':>9} "
+            f"{'queue':>8} {'exec':>8} {'decode':>8} {'unattr':>8}")
+
+        def ms(v):
+            return "-" if v is None else f"{float(v):.2f}"
+
+        for ev in slowest:
+            comp = (ev.get("decomposition") or {}).get(
+                "components_ms", {})
+            unattr = (ev.get("decomposition") or {}).get(
+                "unattributed_ms")
+            lines.append(
+                f"{str(ev.get('trace', '?'))[:16]:<18} "
+                f"{str(ev.get('model', '?'))[:12]:<12} "
+                f"{str(ev.get('status', '?'))[:14]:<14} "
+                f"{ms(ev.get('total_ms')):>9} "
+                f"{ms(comp.get('queue.wait')):>8} "
+                f"{ms(comp.get('batch.exec')):>8} "
+                f"{ms(comp.get('decode')):>8} "
+                f"{ms(unattr):>8}")
+        if padded:
+            lines.append("")
+            lines.append("Padding waste (rows padded to reach the "
+                         "bucket — top requests)")
+            for ev in padded:
+                pad = (ev.get("decomposition") or {}).get("padding", {})
+                lines.append(
+                    f"  {str(ev.get('trace', '?'))[:16]:<18} "
+                    f"model={ev.get('model', '?')} "
+                    f"padded={ev.get('padded_rows')} "
+                    f"bucket={pad.get('bucket')} "
+                    f"fill={pad.get('fill')}")
 
     trips = watchdog_trips(doc)
     if trips:
