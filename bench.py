@@ -211,6 +211,52 @@ def timed_steps(exe, prog, feed, fetch, scope, warmup, calls, mon=None,
     return dts, first_loss, float(np.asarray(losses).reshape(-1)[-1])
 
 
+def memory_probe(exe, prog, feed, fetch_list, scope, batch_size):
+    """The ISSUE-15 memory fields for a dense-workload record:
+    `activation_peak_bytes` (the static planner over the one-step
+    program, paddle_tpu/memory) and `memory_analysis_peak_bytes` (XLA
+    ground truth: the executed run_steps entry re-lowered AOT and its
+    CompiledMemoryStats read — one extra compile per workload, after the
+    timed region).  Telemetry must never fail a measured bench: each
+    probe degrades to a stderr note."""
+    fields = {}
+    feed_names = sorted(feed)
+    fetch_names = [getattr(v, "name", v) for v in fetch_list]
+    try:
+        from paddle_tpu import memory as M
+
+        plan = M.plan_program(prog, feed_names, fetch_names,
+                              batch_size=batch_size)
+        fields["activation_peak_bytes"] = int(plan.activation_peak_bytes)
+        fields["planner_peak_bytes"] = int(plan.peak_bytes)
+        if plan.warnings:
+            fields["planner_warnings"] = len(plan.warnings)
+        M.publish_plan(plan, name="bench")
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] planner probe failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        import jax
+
+        from paddle_tpu.core.executor import latest_jitted_entry
+        from paddle_tpu.memory import xla_memory_stats
+
+        entry = latest_jitted_entry(exe)
+        feed_vals = [exe._to_device_array(prog, n, feed[n])
+                     for n in feed_names]
+        rw = [scope.find_var(n) for n in entry.rw_state]
+        ro = [scope.find_var(n) for n in entry.ro_state]
+        args = [feed_vals, rw, ro]
+        if entry.needs_key:
+            args.append(jax.random.key(0, impl="rbg"))
+        stats = xla_memory_stats(entry.jitted.lower(*args).compile())
+        fields["memory_analysis_peak_bytes"] = int(stats["peak_bytes"])
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] memory_analysis probe failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    return fields
+
+
 def emit_metric(metric, value, unit, vs_baseline, mfu, loss, config,
                 loss_first=None):
     """One-json-line contract, extended with the self-validation fields:
@@ -365,13 +411,14 @@ def bench_resnet50(batch_size=256, scan_steps=16, calls=2, warmup=1,
             (losses,) = exe.run_steps(prog, feed=feed,
                                       fetch_list=[avg_cost], scope=scope)
         dt = time.perf_counter() - t0
+    mem = memory_probe(exe, prog, feed, [avg_cost], scope, batch_size)
     ips = batch_size * scan_steps * calls / dt
-    return ips, first_loss, float(np.asarray(losses)[-1])
+    return ips, first_loss, float(np.asarray(losses)[-1]), mem
 
 
 def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
                       warmup=1, amp=True, tiny=False, use_flash=True,
-                      repeats=1):
+                      repeats=1, recompute=False):
     import paddle_tpu as pt
     from paddle_tpu.models import transformer as T
 
@@ -392,6 +439,25 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
         pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
     if amp:
         pt.amp.enable(prog)
+    rc_fields = {}
+    if recompute:
+        # the r12 A/B leg: activation-recompute pass applied to the
+        # trained program (auto sqrt(N)-segment policy); the record
+        # carries the planner's before/after peaks + est FLOPs factor
+        from paddle_tpu import memory as M
+
+        rep = M.apply_recompute(prog, list(feeds),
+                                fetch_names=[avg_cost.name],
+                                batch_size=batch_size)
+        rc_fields = {
+            "recompute_segments": rep["n_segments"],
+            "recompute_cloned_ops": rep["cloned_ops"],
+            "recompute_activation_peak_before": rep[
+                "activation_peak_before"],
+            "recompute_activation_peak_after": rep[
+                "activation_peak_after"],
+            "recompute_flops_ratio": round(rep["flops_ratio"], 4),
+        }
     scope = pt.Scope()
     exe = pt.Executor()
     exe.run(startup, scope=scope)
@@ -413,9 +479,11 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
     dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
                                             scope, warmup, calls, mon=mon,
                                             ckpt=ckpt, repeats=repeats)
+    mem = memory_probe(exe, prog, feed, [avg_cost], scope, batch_size)
+    mem.update(rc_fields)
     # tokens counted on the decoded (trg) stream, the convention for MT
     toks = batch_size * seq_len * scan_steps * calls
-    return [toks / d for d in dt], flops_tok, first_loss, last_loss
+    return [toks / d for d in dt], flops_tok, first_loss, last_loss, mem
 
 
 def bench_decode(batch_size=1, max_tokens=64, tiny=False, repeats=1,
@@ -748,8 +816,9 @@ def bench_bert(batch_size=32, seq_len=128, scan_steps=8, calls=4, warmup=1,
     dt, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_loss],
                                             scope, warmup, calls, mon=mon,
                                             ckpt=ckpt, repeats=repeats)
+    mem = memory_probe(exe, prog, feed, [avg_loss], scope, batch_size)
     toks = batch_size * seq_len * scan_steps * calls
-    return [toks / d for d in dt], flops_tok, first_loss, last_loss
+    return [toks / d for d in dt], flops_tok, first_loss, last_loss, mem
 
 
 def bench_deepfm(batch_size=4096, scan_steps=8, calls=4, warmup=1,
@@ -815,8 +884,9 @@ def bench_mnist(batch_size=512, scan_steps=16, calls=2, warmup=1, amp=True):
     dts, first_loss, last_loss = timed_steps(exe, prog, feed, [avg_cost],
                                              scope, warmup, calls, mon=mon,
                                              ckpt=ckpt)
+    mem = memory_probe(exe, prog, feed, [avg_cost], scope, batch_size)
     ips = batch_size * scan_steps * calls / dts[0]
-    return ips, first_loss, last_loss
+    return ips, first_loss, last_loss, mem
 
 
 def run_bert(args, peak):
@@ -825,7 +895,7 @@ def run_bert(args, peak):
     bs = args.batch_size or (4 if args.smoke else 128)
     seq = 64 if args.smoke else 128
     repeats = _repeats(args)
-    tps_runs, flops_tok, loss0, loss = bench_bert(
+    tps_runs, flops_tok, loss0, loss, mem = bench_bert(
         batch_size=bs, seq_len=seq,
         scan_steps=args.scan_steps or (2 if args.smoke else 16),
         calls=args.calls or (1 if args.smoke else 2),
@@ -836,13 +906,15 @@ def run_bert(args, peak):
     # BASELINE.json north star (50% MFU on this chip)
     from paddle_tpu.flags import FLAGS as _FLAGS
 
+    config = {"bf16": args.amp, "batch": bs, "seq_len": seq,
+              "tiny": args.smoke,
+              "fused_qkv_attention": bool(_FLAGS.fused_qkv_attention),
+              "runs": [round(r, 1) for r in runs],
+              "spread": round(spread, 1)}
+    config.update(mem)
     emit_metric("bert_base_train_tokens_per_sec_per_chip", tps, "tokens/sec",
                 mfu / 0.50 if mfu is not None else None, mfu, loss,
-                {"bf16": args.amp, "batch": bs, "seq_len": seq,
-                 "tiny": args.smoke,
-                 "fused_qkv_attention": bool(_FLAGS.fused_qkv_attention),
-                 "runs": [round(r, 1) for r in runs],
-                 "spread": round(spread, 1)}, loss_first=loss0)
+                config, loss_first=loss0)
 
 
 def run_deepfm(args, peak):
@@ -889,22 +961,24 @@ def run_deepfm(args, peak):
 
 def run_mnist(args, peak):
     bs = args.batch_size or (64 if args.smoke else 512)
-    ips, loss0, loss = bench_mnist(
+    ips, loss0, loss, mem = bench_mnist(
         batch_size=bs,
         scan_steps=args.scan_steps or (2 if args.smoke else 16),
         calls=args.calls or (1 if args.smoke else 2),
         amp=args.amp)
     # no reference MNIST throughput number exists: vs_baseline is the
     # ratio to the committed round-4 target (no-regression contract)
+    config = {"bf16": args.amp, "batch": bs}
+    config.update(mem)
     emit_metric("mnist_lenet5_train_images_per_sec_per_chip", ips,
                 "images/sec", ips / MNIST_TARGET_IMGS_PER_SEC, None, loss,
-                {"bf16": args.amp, "batch": bs}, loss_first=loss0)
+                config, loss_first=loss0)
 
 
 def run_resnet50(args, peak):
         if args.smoke:
             bs = args.batch_size or 8
-            ips, loss0, loss = bench_resnet50(
+            ips, loss0, loss, mem = bench_resnet50(
                 batch_size=bs, scan_steps=2, calls=1, warmup=1,
                 image_size=64, depth=18, amp=args.amp, stream=args.stream,
                 data_format=args.data_format)
@@ -913,7 +987,7 @@ def run_resnet50(args, peak):
                       "depth": 18, "data_format": args.data_format}
         else:
             bs = args.batch_size or 256
-            ips, loss0, loss = bench_resnet50(
+            ips, loss0, loss, mem = bench_resnet50(
                 batch_size=bs, scan_steps=args.scan_steps or 16,
                 calls=args.calls or 2, amp=args.amp, stream=args.stream,
                 data_format=args.data_format)
@@ -921,6 +995,7 @@ def run_resnet50(args, peak):
             config = {"bf16": args.amp, "batch": bs, "image": 224,
                       "depth": 50, "stream": args.stream,
                       "data_format": args.data_format}
+        config.update(mem)
         emit_metric("resnet50_train_images_per_sec_per_chip", ips,
                     "images/sec", ips / REFERENCE_RESNET50_IMGS_PER_SEC,
                     mfu, loss, config, loss_first=loss0)
@@ -930,11 +1005,12 @@ def run_transformer(args, peak):
         bs = args.batch_size or (2 if args.smoke else 64)
         seq = 64 if args.smoke else 256
         repeats = _repeats(args)
-        tps_runs, flops_tok, loss0, loss = bench_transformer(
+        tps_runs, flops_tok, loss0, loss, mem = bench_transformer(
             batch_size=bs, seq_len=seq,
             scan_steps=args.scan_steps or (2 if args.smoke else 32),
             calls=args.calls or (1 if args.smoke else 2),
-            amp=args.amp, tiny=args.smoke, repeats=repeats)
+            amp=args.amp, tiny=args.smoke, repeats=repeats,
+            recompute=args.recompute)
         tps, spread, runs = _mean_spread(tps_runs)
         # flops_tok matches the model actually run (tiny config in smoke)
         mfu = (tps * flops_tok / peak) if peak else None
@@ -942,18 +1018,22 @@ def run_transformer(args, peak):
         # the ratio to the BASELINE.json north star (50% MFU on this chip)
         from paddle_tpu.flags import FLAGS as _FLAGS
 
+        config = {"bf16": args.amp, "batch": bs, "seq_len": seq,
+                  "tiny": args.smoke,
+                  # the r09 A/B knob: run once with
+                  # FLAGS_fused_qkv_attention=0 for the unfused-
+                  # composition baseline record (tools/run_ci.sh does)
+                  "fused_qkv_attention": bool(
+                      _FLAGS.fused_qkv_attention),
+                  # the r12 A/B knob: --recompute pairs a rewritten
+                  # record next to this one (tools/run_ci.sh does)
+                  "recompute": bool(args.recompute),
+                  "runs": [round(r, 1) for r in runs],
+                  "spread": round(spread, 1)}
+        config.update(mem)
         emit_metric("transformer_base_train_tokens_per_sec_per_chip", tps,
                     "tokens/sec", mfu / 0.50 if mfu is not None else None,
-                    mfu, loss,
-                    {"bf16": args.amp, "batch": bs, "seq_len": seq,
-                     "tiny": args.smoke,
-                     # the r09 A/B knob: run once with
-                     # FLAGS_fused_qkv_attention=0 for the unfused-
-                     # composition baseline record (tools/run_ci.sh does)
-                     "fused_qkv_attention": bool(
-                         _FLAGS.fused_qkv_attention),
-                     "runs": [round(r, 1) for r in runs],
-                     "spread": round(spread, 1)}, loss_first=loss0)
+                    mfu, loss, config, loss_first=loss0)
 
 
 def run_pipeline(args, peak):
@@ -1087,6 +1167,12 @@ def main():
                         "asserted) instead of the dense bench")
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for a fast correctness pass")
+    p.add_argument("--recompute", action="store_true",
+                   help="with --model transformer: apply the activation-"
+                        "recompute pass (paddle_tpu/memory, auto sqrt(N) "
+                        "segments) to the trained program before timing — "
+                        "the r12 A/B leg; the record carries the planner's "
+                        "before/after activation peaks + est FLOPs factor")
     p.add_argument("--no-amp", dest="amp", action="store_false")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--scan-steps", type=int, default=None)

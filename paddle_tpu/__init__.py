@@ -68,6 +68,7 @@ from . import monitor  # noqa: F401
 from . import interop  # noqa: F401
 from .interop import to_dlpack, from_dlpack  # noqa: F401
 from . import amp  # noqa: F401
+from . import memory  # noqa: F401
 from . import inference  # noqa: F401
 from . import serving  # noqa: F401
 from . import contrib  # noqa: F401

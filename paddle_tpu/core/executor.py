@@ -1616,8 +1616,23 @@ class Executor:
         return _CompiledEntry(
             jitted, rw_state, ro_state, state_writes, probe_random,
             nan_check_ops=nan_check_ops if check else None,
-            run_lock=self._stateful_lock,
+            jitted=jitted, run_lock=self._stateful_lock,
         )
+
+
+def latest_jitted_entry(exe: "Executor") -> _CompiledEntry:
+    """The most recently compiled cache entry that kept its AOT handle
+    (`entry.jitted`) — the ONE introspection hook for re-lowering an
+    executed computation to optimized-HLO text or CompiledMemoryStats
+    (tools/hlo_diag.py, bench.py memory_probe, memory.xla_cross_check,
+    the kernel-fusion tests).  Dict insertion order is compile order, so
+    the last entry is the caller's most recent run/run_steps compile."""
+    entries = [e for e in exe._cache.values() if e.jitted is not None]
+    if not entries:
+        raise RuntimeError(
+            "no compiled jitted entry in the executor cache — run the "
+            "program once before AOT introspection")
+    return entries[-1]
 
 
 # ---------------------------------------------------------------------------

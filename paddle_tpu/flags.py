@@ -206,6 +206,38 @@ FLAGS.define(
     "from scalar seeds in the backward, no mask or random-bits tensor in "
     "HBM; off = the separate graph-level hash dropout + add ops")
 FLAGS.define(
+    "recompute", str, "",
+    "activation-recompute (gradient checkpointing) policy for the memory "
+    "tier (paddle_tpu/memory/recompute.py), applied by "
+    "memory.maybe_optimize_memory consumers (bench.py --recompute, user "
+    "training scripts): '' = off (the rewrite never runs; graphs are "
+    "byte-identical to today — the zero-cost contract), 'auto' = "
+    "sqrt(N)-segment boundaries chosen over the planner's activation "
+    "watermark to minimize estimated peak, or a comma-separated list of "
+    "checkpoint var names (the reference's checkpoints= annotation).  "
+    "Each segment's forward ops are cloned in front of their grad ops "
+    "instead of stashing intermediates; RNG-deriving ops replay the SAME "
+    "step key via their static rng_id (dropout masks bit-identical "
+    "between stash and recompute, asserted)")
+FLAGS.define(
+    "recompute_segments", int, 0,
+    "with FLAGS_recompute=auto: explicit segment count; 0 = the "
+    "sqrt(N)-over-forward-ops default (Chen et al., sublinear memory)")
+FLAGS.define(
+    "offload_activations", bool, False,
+    "host offload for long-lived stash vars (memory/offload.py): vars "
+    "the planner proves have a long fwd->bwd gap and large size get "
+    "paired memcpy_d2h/memcpy_h2d ops at their liveness edges — parked "
+    "in host memory across the gap, fetched back at the backward's "
+    "first read.  Off (default) = the rewrite never runs")
+FLAGS.define(
+    "offload_min_mb", float, 1.0,
+    "offload candidate threshold: minimum var size in MB")
+FLAGS.define(
+    "offload_min_gap", float, 0.25,
+    "offload candidate threshold: minimum fwd->bwd liveness gap as a "
+    "fraction of the program's op count")
+FLAGS.define(
     "verify_program", bool, True,
     "run the static program verifier (paddle_tpu/analysis) before every "
     "executor compile: def-before-use/SSA across blocks, shape+dtype "

@@ -1,0 +1,36 @@
+"""Memory-optimization tier: static HBM planner + graph-level memory
+rewrites (the Fluid memory_optimization transpiler class, rebuilt for
+XLA — PAPER.md's "memory optimization" transpiler bullet).
+
+  * `planner`   — static liveness analysis over the Program IR: per-op
+    live sets, peak watermark, per-var lifetime table, footprint split
+    (params / opt state / activations / workspace), cross-checked
+    against `compiled.memory_analysis()` ground truth.
+  * `recompute` — activation-recompute (gradient checkpointing) pass:
+    segment forwards re-run in front of their grad ops instead of
+    stashing intermediates; FLAGS_recompute.
+  * `offload`   — host offload for long-lived stash vars via paired
+    memcpy_d2h/memcpy_h2d ops at liveness edges;
+    FLAGS_offload_activations.
+"""
+
+from .planner import (  # noqa: F401
+    CLASSES,
+    MemoryPlan,
+    PLANNER_XLA_TOLERANCE,
+    VarLife,
+    plan_accumulated,
+    plan_program,
+    plan_stages,
+    publish_plan,
+    var_bytes,
+    xla_cross_check,
+    xla_memory_stats,
+)
+from .recompute import (  # noqa: F401
+    RecomputeError,
+    apply_recompute,
+    auto_checkpoints,
+    maybe_optimize_memory,
+)
+from .offload import apply_offload, select_offload_vars  # noqa: F401

@@ -1,0 +1,151 @@
+"""Host offload for long-lived stash vars (the ZeRO-Offload /
+activation-offload class, as a graph rewrite over the planner's lifetime
+table).
+
+For vars the planner proves have a LONG fwd->bwd liveness gap and a
+LARGE size (pipeline stash, checkpoint-segment boundaries), the pass
+emits a paired `memcpy_d2h` / `memcpy_h2d` (ops/memory_ops.py) at the
+var's liveness edges:
+
+  * d2h immediately after the last FORWARD read — the value parks in
+    host memory across the gap, so its HBM buffer frees inside the
+    forward;
+  * h2d immediately before the first BACKWARD read, Gate-tied to the
+    earliest backward value there so XLA cannot hoist the fetch back
+    into the forward;
+  * every backward reader is rewritten to the fetched name.
+
+Value parity is exact (the memcpys are identity ops; asserted in
+tests/test_memory.py on CPU, where jax's pinned_host memory kind
+round-trips in-jit) and the planner's post-offload plan subtracts the
+offloaded bytes from the device peak (the `host` class is excluded from
+the watermark).  Behind FLAGS_offload_activations (default off — the
+rewrite never runs; zero-cost contract).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..core import framework as fw
+from . import planner as P
+from .recompute import RecomputeError, _check_single_block, _grad_name
+
+_HOST_SUFFIX = "@HOST"
+_FETCHED_SUFFIX = "@HBM"
+
+
+def select_offload_vars(plan: P.MemoryPlan, min_bytes: Optional[int] = None,
+                        min_gap_frac: Optional[float] = None) -> List[str]:
+    """Offload candidates from a MemoryPlan: activation-class vars whose
+    fwd->bwd gap spans at least `min_gap_frac` of the program and whose
+    size clears `min_bytes` (FLAGS_offload_min_mb / _min_gap defaults)."""
+    from ..flags import FLAGS
+
+    if min_bytes is None:
+        min_bytes = int(FLAGS.offload_min_mb * 1e6)
+    if min_gap_frac is None:
+        min_gap_frac = FLAGS.offload_min_gap
+    min_gap = max(1, int(plan.n_ops * min_gap_frac))
+    out = []
+    for lf in plan.lifetimes.values():
+        if (lf.klass == "activations" and lf.bytes >= min_bytes
+                and lf.first_bwd_use is not None
+                and lf.fwd_bwd_gap >= min_gap):
+            out.append(lf.name)
+    return sorted(out, key=lambda n: -plan.lifetimes[n].bytes)
+
+
+def apply_offload(
+    program: fw.Program,
+    feed_names: Sequence[str] = (),
+    offload_vars: Optional[Sequence[str]] = None,
+    fetch_names: Sequence[str] = (),
+    batch_size: Optional[int] = None,
+    compute_plans: bool = True,
+) -> dict:
+    """Rewrite `program` IN PLACE; returns the report (offloaded names +
+    bytes, plans before/after)."""
+    block = _check_single_block(program, "apply_offload")
+    plan_before = P.plan_program(program, feed_names, fetch_names,
+                                 batch_size=batch_size)
+    if offload_vars is None:
+        offload_vars = select_offload_vars(plan_before)
+    chosen: List[str] = []
+    offloaded_bytes = 0
+    fetch_set = set(
+        v.name if isinstance(v, fw.Variable) else v for v in fetch_names)
+    for n in offload_vars:
+        lf = plan_before.lifetimes.get(n)
+        if lf is None:
+            raise RecomputeError(
+                f"apply_offload: var {n!r} is not in the plan's lifetime "
+                f"table (not produced by this program)")
+        if lf.first_bwd_use is None or n in fetch_set:
+            continue
+        chosen.append(n)
+        offloaded_bytes += lf.bytes
+    if not chosen:
+        return {"offloaded": [], "offloaded_bytes": 0,
+                "plan_before": plan_before, "plan_after": plan_before}
+
+    ops = block.ops
+    # per-var edges from the plan (op indices in the CURRENT op list)
+    d2h_after: Dict[int, List[str]] = {}
+    h2d_before: Dict[int, List[str]] = {}
+    for n in chosen:
+        lf = plan_before.lifetimes[n]
+        park = lf.last_fwd_use if lf.last_fwd_use is not None \
+            else lf.def_idx
+        d2h_after.setdefault(park, []).append(n)
+        h2d_before.setdefault(lf.first_bwd_use, []).append(n)
+
+    def _mk(name: str, like: str):
+        v = block._find_var_recursive(like)
+        block.create_var(
+            name=name,
+            shape=(list(v.shape) if v is not None and v.shape is not None
+                   else None),
+            dtype=v.dtype if v is not None else "float32",
+            stop_gradient=True)
+
+    new_ops: List[fw.Operator] = []
+    renames: Dict[str, str] = {}
+    for i, op in enumerate(ops):
+        for n in h2d_before.get(i, ()):
+            fetched = n + _FETCHED_SUFFIX
+            _mk(fetched, n)
+            gate = next((g for g in op.input_arg_names()
+                         if g and _grad_name(g)), None)
+            h_in = {"X": [n + _HOST_SUFFIX]}
+            if gate is not None:
+                h_in["Gate"] = [gate]
+            new_ops.append(fw.Operator(
+                block, "memcpy_h2d", h_in, {"Out": [fetched]},
+                {fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward}))
+            renames[n] = fetched
+        if renames and (P._is_bwd(op) or P._is_opt(op)):
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [renames.get(n, n) if n else n
+                                   for n in names]
+        new_ops.append(op)
+        for n in d2h_after.get(i, ()):
+            host = n + _HOST_SUFFIX
+            _mk(host, n)
+            new_ops.append(fw.Operator(
+                block, "memcpy_d2h", {"X": [n]}, {"Out": [host]},
+                {fw.OpRole.ROLE_ATTR_NAME: fw.OpRole.Backward}))
+    block.ops = new_ops
+    block._bump()
+
+    plan_after = (P.plan_program(program, feed_names, fetch_names,
+                                 batch_size=batch_size)
+                  if compute_plans else None)
+    return {
+        "offloaded": chosen,
+        "offloaded_bytes": offloaded_bytes,
+        "plan_before": plan_before,
+        "plan_after": plan_after,
+        "peak_before": plan_before.peak_bytes,
+        "peak_after": (plan_after.peak_bytes if plan_after else None),
+    }

@@ -1,0 +1,503 @@
+"""Activation-recompute (gradient-checkpointing) pass over the Program IR.
+
+The graph-level analogue of the reference's memory_optimization
+transpiler / var-reuse passes, shaped for XLA (Chen et al., *Training
+Deep Nets with Sublinear Memory Cost*): user- or auto-selected
+checkpoint vars split the forward into segments, and each segment's
+forward ops are CLONED in front of their grad ops — the backward reads
+the recomputed values, so the originals' live ranges end inside the
+forward and only the checkpoints (plus unavoidable cross-segment edges)
+are stashed across the fwd->bwd gap.
+
+Mechanics (all position-aware, like split_program's grad routing):
+
+  * Clones carry the Backward role + a `recompute_segment` attr and are
+    spliced immediately BEFORE the first backward op that reads any of
+    the segment's interior values — def-before-use holds by
+    construction and the full verifier passes on the rewritten IR
+    (graph_lint's "memory" builder gates on that).
+  * Every clone reads its segment-boundary inputs through a
+    `recompute_barrier` op (ops/memory_ops.py): the barrier breaks
+    XLA CSE (which would otherwise merge the clone chain back into the
+    stashed original, silently reinstating the stash) and, via its
+    `Gate` input (the earliest backward value at the splice point),
+    ties the recomputation to the backward front so it cannot be
+    hoisted into the forward — the jax.checkpoint scheduling idiom.
+  * RNG discipline: a cloned op that draws PRNG bits replays the SAME
+    step key because its static `rng_id` attr rides the clone
+    (fold_in(step_key, rng_id) — the PR-4 contract); dropout masks are
+    bit-identical between stash and recompute (asserted in
+    tests/test_memory.py).  An RNG op WITHOUT a static id cannot replay
+    deterministically, so the pass stashes its outputs instead of
+    cloning it — never a silently different mask.
+  * Originals whose outputs become fully unread (values computed ONLY
+    for the backward) are deleted — they now run once, in the clone.
+  * Flag-off (`FLAGS_recompute=""`) the pass never runs:
+    maybe_optimize_memory is one flag read and the graph stays
+    byte-identical (the zero-cost contract, asserted).
+
+Composition: the pass rewrites role-annotated global-block IR only, so
+it composes with amp (trace-time cast policy sees the same op types),
+with Executor.run_accumulated (clones are non-Optimize => prefix), and
+with pipeline stage programs (apply it per stage AFTER split_program —
+recompute within a stage).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..core import framework as fw
+from . import planner as P
+
+_RC_SUFFIX = "@RC"
+_RCIN_SUFFIX = "@RCIN"
+
+
+class RecomputeError(ValueError):
+    pass
+
+
+def _grad_name(n: str) -> bool:
+    return "@GRAD" in n
+
+
+def _check_single_block(program: fw.Program, what: str):
+    block = program.global_block()
+    for op in block.ops:
+        for a in op.attrs.values():
+            if isinstance(a, fw.Block):
+                raise RecomputeError(
+                    f"{what}: op {op.type!r} carries a control-flow "
+                    f"sub-block; the memory rewrites cover straight-line "
+                    f"trained programs (while/conditional bodies are "
+                    f"planned by memory.planner but not rewritten)")
+    return block
+
+
+# ---------------------------------------------------------------------------
+# auto checkpoint selection (sqrt(N) over the planner's watermark)
+# ---------------------------------------------------------------------------
+
+
+def auto_checkpoints(program: fw.Program, feed_names: Sequence[str] = (),
+                     n_segments: int = 0,
+                     batch_size: Optional[int] = None) -> List[str]:
+    """Segment boundaries minimizing estimated peak: sqrt(N) segments
+    (N = forward op count; FLAGS_recompute_segments overrides) cut at
+    equal cumulative-activation-byte quantiles, choosing the smallest
+    candidate activation near each quantile so the stash itself stays
+    cheap."""
+    block = _check_single_block(program, "auto_checkpoints")
+    ops = block.ops
+    feed_set = set(feed_names)
+    fwd_ids = [i for i, op in enumerate(ops)
+               if not P._is_bwd(op) and not P._is_opt(op)]
+    if not fwd_ids:
+        return []
+    # a candidate is a fwd op output a LATER fwd op reads (a real flowing
+    # activation, so cutting there yields a connected tail segment)
+    read_after: Dict[str, int] = {}
+    for i in fwd_ids:
+        for n in ops[i].input_arg_names():
+            if n:
+                read_after[n] = i
+    produced_at: Dict[str, int] = {}
+    for i in fwd_ids:
+        for n in ops[i].output_arg_names():
+            if n and n not in produced_at:
+                produced_at[n] = i
+
+    def _bytes(n: str) -> int:
+        # batch 1 substitution when unspecified: selection only needs
+        # RELATIVE sizes, and the -1 batch axis scales them uniformly
+        return P.var_bytes(block._find_var_recursive(n), None, n,
+                           batch_size or 1)
+
+    candidates: List[tuple] = []  # (fwd_pos, name, bytes)
+    for pos, i in enumerate(fwd_ids):
+        for n in ops[i].output_arg_names():
+            v = block._find_var_recursive(n) if n else None
+            if (not n or v is None or v.persistable or n in feed_set
+                    or read_after.get(n, -1) <= i):
+                continue
+            b = _bytes(n)
+            if b > 0:
+                candidates.append((pos, n, b))
+                break  # one candidate per op keeps quantile mapping clean
+    if not candidates:
+        return []
+    n_ops = len(fwd_ids)
+    if not n_segments:
+        from ..flags import FLAGS
+
+        n_segments = FLAGS.recompute_segments
+    n_seg = n_segments or max(2, min(64, int(round(math.sqrt(n_ops)))))
+    n_seg = min(n_seg, len(candidates))
+    # cumulative activation bytes produced per fwd position
+    cum: List[int] = []
+    acc = 0
+    for i in fwd_ids:
+        for n in ops[i].output_arg_names():
+            if n and produced_at.get(n) == i:
+                v = block._find_var_recursive(n)
+                if v is not None and not v.persistable:
+                    acc += _bytes(n)
+        cum.append(acc)
+    total = cum[-1] or 1
+    chosen: List[str] = []
+    used: Set[int] = set()
+    for j in range(1, n_seg):
+        target = total * j / n_seg
+        # candidates whose position has crossed the quantile
+        window = [c for c in candidates
+                  if cum[c[0]] >= target and c[0] not in used]
+        if not window:
+            continue
+        edge = window[0][0]
+        near = [c for c in window if c[0] - edge <= max(2, n_ops // 50)]
+        pos, name, _ = min(near, key=lambda c: c[2])
+        used.add(pos)
+        chosen.append(name)
+    return chosen
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+
+
+def _noop_report(n_seg: int, cps, plan) -> dict:
+    peak = plan.activation_peak_bytes if plan is not None else None
+    return {"n_segments": n_seg, "segments_rewritten": [],
+            "checkpoints": list(cps), "cloned_ops": 0, "removed_ops": 0,
+            "barrier_ops": 0, "plan_before": plan, "plan_after": plan,
+            "activation_peak_before": peak, "activation_peak_after": peak,
+            "flops_ratio": 1.0}
+
+
+def apply_recompute(
+    program: fw.Program,
+    feed_names: Sequence[str] = (),
+    checkpoints: Optional[Sequence[str]] = None,
+    fetch_names: Sequence[str] = (),
+    n_segments: int = 0,
+    batch_size: Optional[int] = None,
+    compute_plans: bool = True,
+) -> dict:
+    """Rewrite `program` IN PLACE; returns the report dict (segments,
+    clones, peak before/after, estimated FLOPs ratio)."""
+    from ..core import executor as ex
+
+    block = _check_single_block(program, "apply_recompute")
+    ops = block.ops
+    fetch_set = set(
+        v.name if isinstance(v, fw.Variable) else v for v in fetch_names)
+    plan_before = (P.plan_program(program, feed_names, fetch_names,
+                                  batch_size=batch_size)
+                   if compute_plans else None)
+
+    fwd_ids = [i for i, op in enumerate(ops)
+               if not P._is_bwd(op) and not P._is_opt(op)]
+    bwd_ids = [i for i, op in enumerate(ops)
+               if P._is_bwd(op) and not P._is_opt(op)]
+    if not bwd_ids:
+        raise RecomputeError(
+            "apply_recompute: program has no Backward-role ops (call "
+            "append_backward/minimize first — there is no stash to "
+            "recompute in a forward-only program)")
+
+    if checkpoints is None:
+        checkpoints = auto_checkpoints(program, feed_names, n_segments,
+                                       batch_size=batch_size)
+    checkpoints = [c for c in checkpoints if c]
+    producer: Dict[str, int] = {}
+    for i in fwd_ids:
+        for n in ops[i].output_arg_names():
+            if n:
+                producer[n] = i
+    for c in checkpoints:
+        if c not in producer:
+            raise RecomputeError(
+                f"apply_recompute: checkpoint var {c!r} is produced by no "
+                f"forward op — annotate real activation names "
+                f"(FLAGS_recompute)")
+    cps = sorted(set(checkpoints), key=lambda c: producer[c])
+    if not cps:
+        return _noop_report(0, [], plan_before)
+
+    # ---- segment assignment over fwd ops -------------------------------
+    cp_set = set(cps)
+    seg_of: Dict[int, int] = {}
+    cur = 0
+    cut_positions = {producer[c] for c in cps}
+    for i in fwd_ids:
+        seg_of[i] = cur
+        if i in cut_positions:
+            cur += 1
+    n_seg = cur + 1
+
+    # which segments' FORWARD ops read each name (cross-segment edges
+    # stay stashed — the standard checkpointing contract)
+    fwd_readers: Dict[str, Set[int]] = {}
+    for i in fwd_ids:
+        for n in ops[i].input_arg_names():
+            if n:
+                fwd_readers.setdefault(n, set()).add(seg_of[i])
+    bwd_readers: Dict[str, List[int]] = {}
+    for i in bwd_ids:
+        for n in ops[i].input_arg_names():
+            if n and not _grad_name(n):
+                bwd_readers.setdefault(n, []).append(i)
+
+    feed_set = set(feed_names)
+
+    def _stashed_only(n: str) -> bool:
+        v = block._find_var_recursive(n)
+        return (v is None or v.persistable or v.is_data or n in feed_set
+                or n in cp_set or n in fetch_set)
+
+    # ---- per-segment clone slices ---------------------------------------
+    rename_all: Dict[str, str] = {}
+    splice_at: Dict[int, List[fw.Operator]] = {}
+    n_clones = n_barriers = 0
+    segments_used: List[int] = []
+
+    for s in range(n_seg):
+        seg_ops = [i for i in fwd_ids if seg_of[i] == s]
+        produced_here: Set[str] = set()
+        for i in seg_ops:
+            produced_here.update(
+                n for n in ops[i].output_arg_names() if n)
+        interior = {
+            n for n in produced_here
+            if not _stashed_only(n)
+            and not (fwd_readers.get(n, set()) - {s})  # no cross-seg fwd read
+        }
+        seg_bwd_reads = {n for n in interior if n in bwd_readers}
+        if not seg_bwd_reads:
+            continue
+        # backward slice within the segment from the bwd-read set
+        needed = set(seg_bwd_reads)
+        clone_ids: List[int] = []
+        for i in reversed(seg_ops):
+            op = ops[i]
+            outs = set(n for n in op.output_arg_names() if n)
+            if not (needed & outs):
+                continue
+            if (ex.op_threads_rng(op) and not op.type.endswith("_grad")
+                    and not (op.attrs.get("rng_id")
+                             or op.attrs.get("seed"))):
+                # no static id => no deterministic replay: stash this
+                # op's outputs instead of recomputing a DIFFERENT mask
+                needed -= outs
+                continue
+            clone_ids.append(i)
+            needed.update(n for n in op.input_arg_names()
+                          if n and n in interior)
+        if not clone_ids:
+            continue
+        clone_ids.reverse()
+        # ALL outputs of a cloned op are renamed (a clone writing an
+        # original name would double-write it), so the splice must cover
+        # the backward readers of EVERY renamed output — including
+        # non-interior siblings of a multi-output op (a `split` with one
+        # interior and one cross-segment output) whose grad ops belong
+        # to a LATER segment's backward and therefore run earlier
+        cloned_outputs = {
+            n for i in clone_ids for n in ops[i].output_arg_names() if n}
+        renamed_bwd_read = {n for n in cloned_outputs if n in bwd_readers}
+        if not renamed_bwd_read:
+            continue  # every bwd-read value fell to the rng-stash rule
+        rename = {n: f"{n}{_RC_SUFFIX}{s}" for n in cloned_outputs}
+        rename_all.update(rename)
+        splice = min(min(bwd_readers[n]) for n in renamed_bwd_read)
+        # gate: the earliest backward value available at the splice —
+        # the splice op's first grad-named input
+        gate = next((n for n in ops[splice].input_arg_names()
+                     if n and _grad_name(n)), None)
+
+        # barriers: every clone must differ from its original in at
+        # least one operand (CSE protection); boundary inputs read
+        # through the barrier also inherit the gate tie
+        barrier_map: Dict[str, str] = {}
+        descs: List[tuple] = []  # (type, inputs, outputs, attrs)
+        for i in clone_ids:
+            op = ops[i]
+            if not any((n in rename or n in barrier_map)
+                       for n in op.input_arg_names() if n):
+                pivot = None
+                for n in op.input_arg_names():
+                    if not n:
+                        continue
+                    v = block._find_var_recursive(n)
+                    if v is not None and not v.persistable \
+                            and n not in feed_set:
+                        pivot = n
+                        break
+                    if pivot is None:
+                        pivot = n
+                # pivot None = input-free op (fill_constant): cloned
+                # as-is — a constant has no liveness to protect and CSE
+                # merging it back is harmless
+                if pivot is not None and pivot not in barrier_map:
+                    bname = f"{pivot}{_RCIN_SUFFIX}{s}"
+                    pv = block._find_var_recursive(pivot)
+                    block.create_var(
+                        name=bname,
+                        shape=(list(pv.shape) if pv is not None
+                               and pv.shape is not None else None),
+                        dtype=pv.dtype if pv is not None else "float32",
+                        stop_gradient=True)
+                    b_in = {"X": [pivot]}
+                    if gate is not None:
+                        b_in["Gate"] = [gate]
+                    descs.append(("recompute_barrier", b_in,
+                                  {"Out": [bname]},
+                                  {fw.OpRole.ROLE_ATTR_NAME:
+                                   fw.OpRole.Backward,
+                                   "recompute_segment": s}))
+                    barrier_map[pivot] = bname
+                    n_barriers += 1
+            new_in = {}
+            for slot, names in op.inputs.items():
+                new_in[slot] = [
+                    rename.get(n, barrier_map.get(n, n)) if n else n
+                    for n in names]
+            new_out = {}
+            for slot, names in op.outputs.items():
+                outs = []
+                for n in names:
+                    if not n:
+                        outs.append(n)
+                        continue
+                    rn = rename[n]
+                    ov = block._find_var_recursive(n)
+                    block.create_var(
+                        name=rn,
+                        shape=(list(ov.shape) if ov is not None
+                               and ov.shape is not None else None),
+                        dtype=ov.dtype if ov is not None else "float32",
+                        stop_gradient=True)
+                    outs.append(rn)
+                new_out[slot] = outs
+            attrs = dict(op.attrs)
+            attrs[fw.OpRole.ROLE_ATTR_NAME] = fw.OpRole.Backward
+            attrs["recompute_segment"] = s
+            descs.append((op.type, new_in, new_out, attrs))
+            n_clones += 1
+        splice_at.setdefault(splice, []).extend(
+            fw.Operator(block, t, i_, o_, a_) for t, i_, o_, a_ in descs)
+        segments_used.append(s)
+
+    if not rename_all:
+        return _noop_report(n_seg, cps, plan_before)
+
+    # ---- materialize: splice clones, rewrite backward reads -------------
+    bwd_set = set(bwd_ids)
+    new_ops: List[fw.Operator] = []
+    for i, op in enumerate(ops):
+        if i in splice_at:
+            new_ops.extend(splice_at[i])
+        if i in bwd_set:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [rename_all.get(n, n) if n else n
+                                   for n in names]
+        new_ops.append(op)
+    block.ops = new_ops
+
+    # ---- delete originals the rewrite orphaned --------------------------
+    # (values computed ONLY for the backward now run once, in the clone)
+    removed = 0
+    while True:
+        referenced: Set[str] = set(fetch_set)
+        for op in block.ops:
+            referenced.update(n for n in op.input_arg_names() if n)
+        drop = []
+        for j, op in enumerate(block.ops):
+            if P._is_bwd(op) or P._is_opt(op):
+                continue
+            outs = [n for n in op.output_arg_names() if n]
+            if not outs:
+                continue
+            live = False
+            for n in outs:
+                v = block._find_var_recursive(n)
+                if (n in referenced or n in feed_set
+                        or (v is not None
+                            and (v.persistable or v.is_data))):
+                    live = True
+                    break
+            if not live:
+                drop.append(j)
+        if not drop:
+            break
+        for j in reversed(drop):
+            del block.ops[j]
+        removed += len(drop)
+    block._bump()
+
+    plan_after = (P.plan_program(program, feed_names, fetch_names,
+                                 batch_size=batch_size)
+                  if compute_plans else None)
+    ratio = 1.0
+    if plan_before is not None and plan_before.total_flops:
+        ratio = plan_after.total_flops / plan_before.total_flops
+    return {
+        "n_segments": n_seg,
+        "segments_rewritten": segments_used,
+        "checkpoints": cps,
+        "cloned_ops": n_clones,
+        "barrier_ops": n_barriers,
+        "removed_ops": removed,
+        "plan_before": plan_before,
+        "plan_after": plan_after,
+        "activation_peak_before": (plan_before.activation_peak_bytes
+                                   if plan_before else None),
+        "activation_peak_after": (plan_after.activation_peak_bytes
+                                  if plan_after else None),
+        "flops_ratio": ratio,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the flag-gated entry point (zero-cost off)
+# ---------------------------------------------------------------------------
+
+
+def maybe_optimize_memory(program: fw.Program,
+                          feed_names: Sequence[str] = (),
+                          fetch_names: Sequence[str] = (),
+                          batch_size: Optional[int] = None
+                          ) -> Optional[dict]:
+    """Apply the flag-selected memory rewrites to a trained program:
+    FLAGS_recompute ('' off / 'auto' / checkpoint names) then
+    FLAGS_offload_activations.  Off = two flag reads, program untouched
+    (byte-identical fingerprint — the zero-cost contract)."""
+    from ..flags import FLAGS
+
+    spec = FLAGS.recompute
+    offload = FLAGS.offload_activations
+    if not spec and not offload:
+        return None
+    report: dict = {}
+    if spec:
+        cps = None if spec.strip().lower() == "auto" else [
+            s.strip() for s in spec.split(",") if s.strip()]
+        report["recompute"] = apply_recompute(
+            program, feed_names, checkpoints=cps, fetch_names=fetch_names,
+            batch_size=batch_size)
+    if offload:
+        from .offload import apply_offload
+
+        report["offload"] = apply_offload(
+            program, feed_names, fetch_names=fetch_names,
+            batch_size=batch_size)
+    # the last pass already planned the final program — publish that
+    # instead of sweeping the (byte-identical) IR a third time
+    plan = (report.get("offload") or report["recompute"])["plan_after"]
+    P.publish_plan(plan)
+    report["plan"] = plan
+    return report

@@ -19,4 +19,5 @@ from . import (  # noqa: F401
     image_ops,
     rcnn_ops,
     generation_ops,
+    memory_ops,
 )

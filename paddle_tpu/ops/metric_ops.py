@@ -12,7 +12,13 @@ def _jnp():
     return jnp
 
 
-@register("accuracy", no_grad=True)
+def _accuracy_infer(ctx):
+    ctx.set_output("Accuracy", (1,))
+    ctx.set_output("Correct", (1,))
+    ctx.set_output("Total", (1,))
+
+
+@register("accuracy", no_grad=True, infer_shape=_accuracy_infer)
 def lower_accuracy(ctx, ins):
     jnp = _jnp()
     # Inputs: Out (topk values path uses Indices), Indices, Label
@@ -30,7 +36,13 @@ def lower_accuracy(ctx, ins):
     }
 
 
-@register("auc", no_grad=True)
+def _auc_infer(ctx):
+    ctx.set_output("AUC", ())
+    ctx.set_output("StatPosOut", ctx.input_shape("StatPos"))
+    ctx.set_output("StatNegOut", ctx.input_shape("StatNeg"))
+
+
+@register("auc", no_grad=True, infer_shape=_auc_infer)
 def lower_auc(ctx, ins):
     """Streaming AUC with persistent histogram state (reference auc_op.cc:
     StatPos/StatNeg accumulators are persistable vars written back)."""
@@ -69,13 +81,43 @@ def lower_auc(ctx, ins):
     }
 
 
+def _broadcast_dims(xs, ys):
+    """numpy-style right-aligned broadcast over declared IR shapes; a -1
+    (dynamic batch) dim broadcasts like an unknown: against 1 it stays
+    -1, against anything else the other side wins."""
+    out = []
+    for i in range(max(len(xs), len(ys))):
+        a = xs[len(xs) - 1 - i] if i < len(xs) else 1
+        b = ys[len(ys) - 1 - i] if i < len(ys) else 1
+        a = int(a) if a is not None else -1
+        b = int(b) if b is not None else -1
+        if a == b or b == 1:
+            out.append(a)
+        elif a == 1:
+            out.append(b)
+        elif a == -1 or b == -1:
+            out.append(a if b == -1 else b)
+        else:
+            raise ValueError(f"shapes {tuple(xs)} and {tuple(ys)} are not "
+                             f"broadcast-compatible")
+    return tuple(reversed(out))
+
+
+def _cmp_infer(ctx):
+    """Comparison/logical outputs broadcast their operands (declared so
+    the mask-building prologues plan with real bytes, not None)."""
+    xs, ys = ctx.input_shape("X"), ctx.input_shape("Y")
+    if xs is not None and ys is not None:
+        ctx.set_output("Out", _broadcast_dims(xs, ys))
+
+
 def _cmp(name, fn):
     def lower(ctx, ins, _fn=fn):
         x, y = ins["X"][0], ins["Y"][0]
         return {"Out": [_fn(x, y)]}
 
     lower.__name__ = f"lower_{name}"
-    register(name, no_grad=True)(lower)
+    register(name, no_grad=True, infer_shape=_cmp_infer)(lower)
 
 
 def _install():

@@ -573,7 +573,23 @@ def _take_label(logp, label):
     return -picked
 
 
-@register("softmax_with_cross_entropy")
+def _ce_loss_infer(ctx):
+    """Loss-shaped output: X.shape[:-1] + (1,) — the trailing singleton
+    the reference's CE family keeps (declared so the memory planner and
+    the shape-contract re-inference see real bytes, not None)."""
+    xs = ctx.input_shape("X")
+    if xs is not None:
+        ctx.set_output("Y", tuple(xs[:-1]) + (1,), ctx.input_dtype("X"))
+
+
+def _swce_infer(ctx):
+    ls = ctx.input_shape("Logits")
+    if ls is not None:
+        ctx.set_output("Softmax", ls)
+        ctx.set_output("Loss", tuple(ls[:-1]) + (1,))
+
+
+@register("softmax_with_cross_entropy", infer_shape=_swce_infer)
 def lower_softmax_with_ce(ctx, ins):
     """Fused stable softmax+CE (reference: softmax_with_cross_entropy_op.cu).
 
@@ -612,7 +628,7 @@ def lower_softmax_with_ce(ctx, ins):
     return {"Softmax": [softmax], "Loss": [loss]}
 
 
-@register("cross_entropy")
+@register("cross_entropy", infer_shape=_ce_loss_infer)
 def lower_cross_entropy(ctx, ins):
     jnp = _jnp()
     x, label = ins["X"][0], ins["Label"][0]

@@ -343,7 +343,16 @@ def lower_argsort(ctx, ins):
     return {"Out": [jnp.take_along_axis(x, idx, axis=axis)], "Indices": [idx.astype(_canon_i64())]}
 
 
-@register("top_k", no_grad=True)
+def _top_k_infer(ctx):
+    xs = ctx.input_shape("X")
+    if xs is not None:
+        k = ctx.attr("k", 1)
+        out = tuple(xs[:-1]) + (int(k),)
+        ctx.set_output("Out", out, ctx.input_dtype("X"))
+        ctx.set_output("Indices", out)
+
+
+@register("top_k", no_grad=True, infer_shape=_top_k_infer)
 def lower_top_k(ctx, ins):
     import jax
 

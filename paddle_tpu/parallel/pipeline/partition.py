@@ -159,23 +159,37 @@ def _feed_only_ops(block: fw.Block, opt_start_set: Set[int]) -> Set[int]:
     return cheap_ops
 
 
-def _op_cost(block: fw.Block, op) -> float:
+def _op_cost(block: fw.Block, op, cost: str = "params") -> float:
     """Balance proxy: bytes of Parameter inputs (flop-dominant dots read
-    their weights) + 1 so param-free ops still carry weight."""
-    cost = 1.0
+    their weights) + 1 so param-free ops still carry weight.
+
+    cost="activations" additionally charges each op its non-persistable
+    OUTPUT elements (the per-micro-batch stash the memory planner's
+    plan_stages totals per stage) — activation-aware auto-balancing, so
+    a stage's share reflects what it must HOLD across the fwd->bwd gap,
+    not just the weights it reads.  A -1 batch dim counts as 1 (uniform
+    across ops, so the balance is unaffected)."""
+    total = 1.0
     for n in op.input_arg_names():
         v = block._find_var_recursive(n) if n else None
         if isinstance(v, fw.Parameter) and v.shape:
-            cost += float(np.prod([d for d in v.shape if d]))
-    return cost
+            total += float(np.prod([d for d in v.shape if d]))
+    if cost == "activations":
+        for n in op.output_arg_names():
+            v = block._find_var_recursive(n) if n else None
+            if v is not None and not v.persistable and v.shape:
+                total += float(np.prod([abs(d) if d else 1
+                                        for d in v.shape]))
+    return total
 
 
 def _auto_boundaries(block: fw.Block, fwd_ids: List[int],
-                     prologue: Set[int], n_stages: int) -> List[int]:
+                     prologue: Set[int], n_stages: int,
+                     cost: str = "params") -> List[int]:
     """Greedy prefix-sum balance of fwd op costs into n contiguous
     segments; returns the fwd-op indices (into block.ops) where each new
     stage begins (n_stages - 1 entries)."""
-    weighted = [(i, _op_cost(block, block.ops[i])) for i in fwd_ids
+    weighted = [(i, _op_cost(block, block.ops[i], cost)) for i in fwd_ids
                 if i not in prologue]
     total = sum(c for _, c in weighted)
     bounds, acc, next_share, s = [], 0.0, total / n_stages, 1
@@ -195,6 +209,7 @@ def split_program(
     n_stages: int = 2,
     cut_vars: Optional[Sequence[str]] = None,
     mark_boundaries: bool = True,
+    cost: str = "params",
 ) -> PipelineStages:
     """Partition `program` (a trained global-block program: forward +
     append_backward grads + optimizer.minimize suffix) into `n_stages`
@@ -202,7 +217,10 @@ def split_program(
 
     cut_vars: optional user annotation — n_stages-1 var names; stage s
     ends with the op producing cut_vars[s].  Omitted: auto-balanced on
-    parameter-byte cost.
+    `cost` — "params" (parameter-byte, the original proxy) or
+    "activations" (params + per-op activation output elements, so
+    stages balance what they STASH across the fwd->bwd gap too; cost
+    the result precisely with memory.plan_stages).
 
     mark_boundaries (default on): annotate the SOURCE program's
     boundary-crossing producers with `pipeline_boundary_vars` attrs — the
@@ -254,7 +272,7 @@ def split_program(
                 f"split_program: cut var(s) {missing} produced by no "
                 f"forward op — annotate real activation names")
     else:
-        bounds = _auto_boundaries(block, fwd_ids, prologue, n_stages)
+        bounds = _auto_boundaries(block, fwd_ids, prologue, n_stages, cost)
         stage_of_fwd = {}
         for i in fwd_ids:
             stage_of_fwd[i] = sum(1 for b in bounds if i >= b)

@@ -422,7 +422,9 @@ def _lower_hlo(exe, prog, startup, loss, feed):
     exe.run(startup, scope=scope)
     exe.run_steps(prog, feed={k: v[None] for k, v in feed.items()},
                   fetch_list=[loss], scope=scope)
-    (entry,) = [e for e in exe._cache.values() if e.jitted is not None]
+    from paddle_tpu.core.executor import latest_jitted_entry
+
+    entry = latest_jitted_entry(exe)
     rw = [scope.find_var(n) for n in entry.rw_state]
     ro = [scope.find_var(n) for n in entry.ro_state]
     feed_names = sorted(feed)

@@ -25,6 +25,10 @@ PUBLIC_API = [
     "passes.py", "inference.py", "layer_helper.py",
     # the generation tier's op wrappers (KVCache.write/attend/reorder)
     "generation",
+    # the memory tier's rewrites emit recompute_barrier/memcpy_d2h/h2d
+    # (memory/recompute.py, memory/offload.py — apply_recompute and
+    # apply_offload are the public way to reach them)
+    "memory",
 ]
 
 # Ops a user never spells: emitted by the executor/backward/compiler

@@ -167,11 +167,15 @@ def compile_deepfm(scan_steps=2, batch_size=256, hash_dim=10001,
     return exe, prog, feed, [avg_cost], scope
 
 
-def lower_entry(exe, prog, feed, fetch_list, scope):
+def lower_entry(exe, prog, feed, fetch_list, scope, return_compiled=False):
     """Compile via run_steps (populates the cache), then AOT-lower the
-    cached jitted fn on the same args to get optimized HLO text."""
+    cached jitted fn on the same args to get optimized HLO text (and the
+    compiled object, whose memory_analysis() the --memory report
+    reads)."""
     exe.run_steps(prog, feed=feed, fetch_list=fetch_list, scope=scope)
-    (entry,) = [e for e in exe._cache.values() if e.jitted is not None]
+    from paddle_tpu.core.executor import latest_jitted_entry
+
+    entry = latest_jitted_entry(exe)
     rw = [scope.find_var(n) for n in entry.rw_state]
     ro = [scope.find_var(n) for n in entry.ro_state]
     import jax
@@ -180,7 +184,10 @@ def lower_entry(exe, prog, feed, fetch_list, scope):
     feed_vals = [exe._to_device_array(prog, n, feed[n]) for n in feed_names]
     key = jax.random.PRNGKey(0)
     lowered = entry.jitted.lower(feed_vals, rw, ro, key)
-    return lowered.compile().as_text()
+    compiled = lowered.compile()
+    if return_compiled:
+        return compiled.as_text(), compiled
+    return compiled.as_text()
 
 
 INSTR_RE = re.compile(
@@ -514,11 +521,70 @@ def format_sparse(rep):
     return "\n".join(out)
 
 
+# --memory: planner table + memory_analysis() ground truth -----------------
+
+
+def analyze_memory(prog, feed, compiled, txt, fetch_names):
+    """The memory-tier report of one bench workload: the static
+    planner's table next to the XLA executable's CompiledMemoryStats
+    ground truth, with the long-open donated-param ENTRY-COPY bytes
+    folded in as a named row (the copy census already attributes them;
+    PERF.md's 'cause not yet found' aside becomes a tracked number).
+
+    The planner models ONE step program; the compiled entry is the
+    run_steps scan (leading [K] feed axis), so the delta also carries
+    the K-stacked feed bytes — both recorded, labeled, never conflated.
+    """
+    from paddle_tpu import memory as M
+
+    feed_names = sorted(feed)
+    import numpy as _np
+
+    first = _np.asarray(feed[feed_names[0]])
+    batch = int(first.shape[1]) if first.ndim >= 2 else None
+    plan = M.plan_program(prog, feed_names, fetch_names, batch_size=batch)
+    stats = M.xla_memory_stats(compiled)
+    census = analyze_copy_census(txt)
+    entry_mb = census["sites"]["entry"]["mb"]
+    rep = {
+        "batch_size": batch,
+        "planner": plan.to_dict(),
+        "memory_analysis": stats,
+        "planner_peak_bytes": plan.peak_bytes,
+        "memory_analysis_peak_bytes": stats["peak_bytes"],
+        "ratio": (round(plan.peak_bytes / stats["peak_bytes"], 4)
+                  if stats["peak_bytes"] else None),
+        # the donation question, now a named row instead of a PERF aside
+        "entry_copy_mb": entry_mb,
+        "entry_copy_count": census["sites"]["entry"]["count"],
+        "table": plan.table(),
+    }
+    return rep
+
+
+def format_memory(rep):
+    out = ["== memory report (planner vs memory_analysis) =="]
+    out.append(rep["table"])
+    ma = rep["memory_analysis"]
+    out.append(
+        f"  XLA executable: args {ma['argument_bytes'] / 1e6:.2f} MB, "
+        f"temp {ma['temp_bytes'] / 1e6:.2f} MB, out "
+        f"{ma['output_bytes'] / 1e6:.2f} MB, alias "
+        f"{ma['alias_bytes'] / 1e6:.2f} MB -> peak "
+        f"{ma['peak_bytes'] / 1e6:.2f} MB")
+    out.append(f"  planner/XLA ratio: {rep['ratio']}")
+    out.append(
+        f"  donated-param entry copies: {rep['entry_copy_count']} "
+        f"({rep['entry_copy_mb']:.3f} MB) — the PERF.md donation row")
+    return "\n".join(out)
+
+
 def main():
     argv = [a for a in sys.argv[1:] if not a.startswith("--")]
     bn_fusion = "--bn-fusion" in sys.argv[1:]
     sparse = "--sparse" in sys.argv[1:]
     copy_census = "--copy-census" in sys.argv[1:]
+    memory_report = "--memory" in sys.argv[1:]
     which = argv[0] if argv else "transformer"
     out_path = argv[1] if len(argv) > 1 else f"/tmp/hlo_{which}.txt"
     if which == "transformer":
@@ -533,7 +599,7 @@ def main():
         args = compile_deepfm()
     else:
         raise SystemExit(f"unknown workload {which}")
-    txt = lower_entry(*args)
+    txt, compiled = lower_entry(*args, return_compiled=True)
     with open(out_path, "w") as f:
         f.write(txt)
     print(f"[hlo_diag] optimized HLO -> {out_path} ({len(txt)} bytes)")
@@ -555,6 +621,18 @@ def main():
             json.dump(rep, f, indent=1)
         print(format_copy_census(rep))
         print(f"[hlo_diag] copy census -> {census_path}")
+    if memory_report:
+        import json
+
+        exe_, prog_, feed_, fetch_, scope_ = args
+        fetch_names = [getattr(v, "name", v) for v in fetch_]
+        mrep = analyze_memory(prog_, feed_, compiled, txt, fetch_names)
+        mrep["workload"] = which
+        mem_path = out_path + ".memory.json"
+        with open(mem_path, "w") as f:
+            json.dump(mrep, f, indent=1)
+        print(format_memory(mrep))
+        print(f"[hlo_diag] memory report -> {mem_path}")
 
 
 if __name__ == "__main__":

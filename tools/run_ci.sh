@@ -113,6 +113,47 @@ print("transformer A/B records OK:", [(r["config"]["fused_qkv_attention"],
                                        r["value"]) for r in recs])
 PY
   echo "-- transformer A/B record artifact: ci_artifacts/bench_transformer_smoke.json"
+  # Recompute A/B leg (PERF.md r12 / ISSUE 15): the activation-recompute
+  # rewrite paired against the plain record — the rewritten record must
+  # carry a LOWER planner activation peak and the est FLOPs factor, and
+  # every dense record now carries activation_peak_bytes (planner) +
+  # memory_analysis_peak_bytes (XLA ground truth), both under the
+  # warnings gate
+  python -W error::UserWarning bench.py --model transformer --smoke \
+    --recompute | tee ci_artifacts/bench_recompute_smoke.json
+  python -W error::UserWarning bench.py --model transformer --smoke \
+    | tee -a ci_artifacts/bench_recompute_smoke.json
+  python - <<'PY'
+import json
+recs = [json.loads(l) for l in open("ci_artifacts/bench_recompute_smoke.json")
+        if l.strip().startswith("{")]
+recs = [r for r in recs if r.get("metric", "").startswith("transformer")]
+flags = {r["config"]["recompute"] for r in recs}
+assert flags == {True, False}, f"need a recompute AND a plain record: {flags}"
+for r in recs:
+    assert "activation_peak_bytes" in r["config"], r["config"]
+    assert "memory_analysis_peak_bytes" in r["config"], r["config"]
+rc = next(r for r in recs if r["config"]["recompute"])
+plain = next(r for r in recs if not r["config"]["recompute"])
+assert rc["config"]["activation_peak_bytes"] \
+    < plain["config"]["activation_peak_bytes"], (rc, plain)
+# the <= 1.35 FLOPs bar is a transformer-BASE property (gated in
+# graph_lint's memory builder + tests/test_memory.py); the tiny smoke
+# model is less matmul-dominant, so this leg only sanity-bounds it
+assert rc["config"]["recompute_flops_ratio"] <= 1.5, rc["config"]
+print("recompute A/B records OK:",
+      [(r["config"]["recompute"], r["config"]["activation_peak_bytes"],
+        r["value"]) for r in recs])
+PY
+  echo "-- recompute A/B record artifact: ci_artifacts/bench_recompute_smoke.json"
+  # Memory report (ISSUE 15 satellite): planner table + memory_analysis
+  # ground-truth columns + the donated-param entry-copy row, archived
+  # like the copy census
+  python tools/hlo_diag.py transformer_smoke \
+    ci_artifacts/hlo_memory_probe.txt --memory | tail -25
+  rm -f ci_artifacts/hlo_memory_probe.txt  # keep the memory JSON
+  echo "-- memory report artifact:"
+  ls ci_artifacts/*.memory.json
   # Decode generation leg (PERF.md r10): tokens/sec at two batch sizes
   # through the KV-cache + flash-decode path, paired with the
   # FLAGS_kv_cache=0 full-prefix-recompute baseline record; every record

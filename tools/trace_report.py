@@ -145,6 +145,16 @@ def pipeline_stages(doc: dict):
             for k, d in stages.items()}, sched
 
 
+def memory_plans(doc: dict):
+    """Last memory.plan event per plan name (memory/planner.py
+    publish_plan: peak watermark + per-class split + offloaded bytes)."""
+    plans = {}
+    for ev in doc.get("flight", {}).get("events", []):
+        if ev.get("kind") == "memory.plan":
+            plans[ev.get("name", "main")] = ev
+    return plans
+
+
 def embedding_census(doc: dict):
     """Last sparse-tier trace census (gather launches / rows touched per
     step — the embedding.* gauges, mirrored into the flight ring at
@@ -204,6 +214,30 @@ def report(doc: dict, k: int = 20) -> str:
         lines.append(f"  gather launches      {census.get('gather_launches')}")
         lines.append(
             f"  sparse rows touched  {census.get('sparse_rows_touched')}")
+
+    plans = memory_plans(doc)
+    if plans:
+        lines.append("")
+        lines.append("Memory (planner table, memory.plan events)")
+        lines.append(
+            f"{'plan':<14} {'peak MB':>9} {'act MB':>9} {'offl MB':>9} "
+            f"{'peak op':<24} {'warn':>5}")
+        for name in sorted(plans):
+            ev = plans[name]
+            by = ev.get("peak_by_class") or {}
+            lines.append(
+                f"{name[:14]:<14} "
+                f"{float(ev.get('peak_bytes', 0)) / 1e6:>9.2f} "
+                f"{float(ev.get('activation_peak_bytes', 0)) / 1e6:>9.2f} "
+                f"{float(ev.get('offloaded_bytes', 0)) / 1e6:>9.2f} "
+                f"{str(ev.get('peak_op_type', '?'))[:20]:<20} "
+                f"@{ev.get('peak_op_index', '?'):<4} "
+                f"{ev.get('warnings', 0):>4}")
+            if by:
+                lines.append("    at peak: " + ", ".join(
+                    f"{c} {float(by.get(c, 0)) / 1e6:.2f} MB"
+                    for c in ("params", "opt_state", "activations",
+                              "workspace", "feeds") if by.get(c)))
 
     stages, sched = pipeline_stages(doc)
     if stages or sched:
