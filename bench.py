@@ -257,10 +257,87 @@ def memory_probe(exe, prog, feed, fetch_list, scope, batch_size):
     return fields
 
 
+def cost_probe(prog, batch_size, name):
+    """Static roofline attribution for a record's one-step program
+    (paddle_tpu/analysis/costmodel): predicted step time, launch count,
+    and launch-bound fraction land in the record's config so
+    tools/perf_report.py can compute predicted-vs-measured without
+    rebuilding the program.  Degrades to a stderr note like
+    memory_probe — attribution must never fail a measured bench."""
+    try:
+        from paddle_tpu.analysis.costmodel import cost_program, publish_cost
+
+        cost = cost_program(prog, name=name, batch_size=batch_size)
+        publish_cost(cost)
+        return {
+            "cost_device": cost.device.name,
+            "cost_launches": cost.n_launches,
+            "cost_predicted_step_us": round(
+                cost.predicted_seconds * 1e6, 2),
+            "cost_launch_bound_fraction": round(
+                cost.launch_bound_fraction, 4),
+        }
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] cost probe failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {}
+
+
+_PROVENANCE = None
+
+
+def _provenance():
+    """Computed once per process: git commit + dirty flag, jax/jaxlib
+    versions, and the non-default flags — rides every record so a
+    bench_diff comparison is attributable to a code/flag delta, not a
+    mystery."""
+    global _PROVENANCE
+    if _PROVENANCE is not None:
+        return _PROVENANCE
+    import os
+    import subprocess
+
+    prov = {"git_commit": "unknown", "git_dirty": None}
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo, capture_output=True,
+            text=True, timeout=10)
+        if out.returncode == 0 and out.stdout.strip():
+            prov["git_commit"] = out.stdout.strip()
+            st = subprocess.run(
+                ["git", "status", "--porcelain"], cwd=repo,
+                capture_output=True, text=True, timeout=10)
+            if st.returncode == 0:
+                prov["git_dirty"] = bool(st.stdout.strip())
+    except Exception:  # noqa: BLE001 — no git / not a checkout
+        pass
+    try:
+        import jax
+        import jaxlib
+
+        prov["jax"] = jax.__version__
+        prov["jaxlib"] = jaxlib.__version__
+    except Exception:  # noqa: BLE001
+        prov["jax"] = prov["jaxlib"] = "unknown"
+    try:
+        from paddle_tpu.flags import FLAGS
+
+        defs = object.__getattribute__(FLAGS, "_defs")
+        prov["flags"] = {
+            n: getattr(FLAGS, n) for n in sorted(defs)
+            if getattr(FLAGS, n) != defs[n].default}
+    except Exception:  # noqa: BLE001
+        prov["flags"] = {}
+    _PROVENANCE = prov
+    return prov
+
+
 def emit_metric(metric, value, unit, vs_baseline, mfu, loss, config,
                 loss_first=None):
     """One-json-line contract, extended with the self-validation fields:
-    loss_first (pre-training) vs loss (final) and learned = decreased."""
+    loss_first (pre-training) vs loss (final) and learned = decreased,
+    plus the provenance block every bench_diff comparison requires."""
     rec = {
         "metric": metric,
         "value": round(value, 2),
@@ -269,6 +346,7 @@ def emit_metric(metric, value, unit, vs_baseline, mfu, loss, config,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "loss": round(loss, 4),
         "config": config,
+        "provenance": _provenance(),
     }
     if loss_first is not None:
         rec["loss_first"] = round(loss_first, 4)
@@ -481,6 +559,7 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
                                             ckpt=ckpt, repeats=repeats)
     mem = memory_probe(exe, prog, feed, [avg_cost], scope, batch_size)
     mem.update(rc_fields)
+    mem.update(cost_probe(prog, batch_size, "bench.transformer"))
     # tokens counted on the decoded (trg) stream, the convention for MT
     toks = batch_size * seq_len * scan_steps * calls
     return [toks / d for d in dt], flops_tok, first_loss, last_loss, mem
@@ -526,6 +605,8 @@ def bench_decode(batch_size=1, max_tokens=64, tiny=False, repeats=1,
     src = rng.randint(2, cfg["vocab"],
                       (batch_size, cfg["src_len"], 1)).astype(np.int64)
 
+    from paddle_tpu.testing import chaos
+
     def one_pass(n_tokens):
         t0 = time.perf_counter()
         sess.prefill(src)
@@ -535,6 +616,11 @@ def bench_decode(batch_size=1, max_tokens=64, tiny=False, repeats=1,
                          np.int64)
         t1 = time.perf_counter()
         for t in range(n_tokens):
+            # per-decode-step chaos latency hook (one flag read when
+            # off): FLAGS_chaos + FLAGS_chaos_serve_latency_s inject a
+            # deterministic synthetic slowdown — the bench_diff red
+            # gate's regression source (tools/run_ci.sh)
+            chaos.maybe_serve_latency()
             if progs.kv_cache:
                 tokens = sess.decode_step(tokens)
             else:
@@ -550,7 +636,10 @@ def bench_decode(batch_size=1, max_tokens=64, tiny=False, repeats=1,
         prefill_s, dt = one_pass(max_tokens)
         runs.append(batch_size * max_tokens / dt)
     compile_flat = sess.compile_count == compiles
-    return runs, prefill_s, compile_flat, sess.compile_count
+    # static roofline attribution of the per-token decode program — the
+    # launch-bound-fraction input ROADMAP item 1 reads off this record
+    cost = cost_probe(progs.decode, batch_size, "bench.decode")
+    return runs, prefill_s, compile_flat, sess.compile_count, cost
 
 
 def run_decode(args, peak):
@@ -567,21 +656,73 @@ def run_decode(args, peak):
     if args.batch_size:
         batches = [args.batch_size]
     for bs in batches:
-        runs, prefill_s, flat, n_compiles = bench_decode(
+        runs, prefill_s, flat, n_compiles, cost = bench_decode(
             batch_size=bs, max_tokens=max_tokens, tiny=args.smoke,
             repeats=repeats)
         tps, spread, run_list = _mean_spread(runs)
+        config = {"batch": bs, "max_tokens": max_tokens, "tiny": args.smoke,
+                  "kv_cache": bool(FLAGS.kv_cache),
+                  "flash_decode": bool(FLAGS.flash_decode),
+                  "prefill_ms": round(prefill_s * 1e3, 2),
+                  "compile_flat": bool(flat),
+                  "compiled_signatures": n_compiles,
+                  "runs": [round(r, 1) for r in run_list],
+                  "spread": round(spread, 1)}
+        config.update(cost)
         emit_metric(
             f"decode_tokens_per_sec_b{bs}", tps, "tokens/sec",
-            None, None, 0.0,
-            {"batch": bs, "max_tokens": max_tokens, "tiny": args.smoke,
-             "kv_cache": bool(FLAGS.kv_cache),
-             "flash_decode": bool(FLAGS.flash_decode),
-             "prefill_ms": round(prefill_s * 1e3, 2),
-             "compile_flat": bool(flat),
-             "compiled_signatures": n_compiles,
-             "runs": [round(r, 1) for r in run_list],
-             "spread": round(spread, 1)})
+            None, None, 0.0, config)
+
+
+def bench_dispatch(calls=300, warmup=30, repeats=3):
+    """Per-launch dispatch overhead microbench: time N cache-hit
+    Executor.run calls of a trivially small program (one mean over 32
+    floats — nanoseconds of arithmetic), so the per-call wall time IS
+    the host-side launch cost the cost model charges each op: Python
+    bookkeeping, cache lookup, device enqueue, and the blocking fetch.
+    CPU-measurable today; re-run on chip to re-arm DEVICE_MODELS /
+    FLAGS_launch_overhead_us.  Returns per-repeat seconds/call."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        out = layers.mean(x)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.zeros((4, 8), np.float32)}
+    for _ in range(max(warmup, 1)):
+        exe.run(prog, feed=feed, fetch_list=[out], scope=scope)
+    per_call = []
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            exe.run(prog, feed=feed, fetch_list=[out], scope=scope)
+        per_call.append((time.perf_counter() - t0) / calls)
+    return per_call
+
+
+def run_dispatch(args, peak):
+    """Explicit-only (--model dispatch): emits dispatch_overhead_us, the
+    measured per-launch constant behind DEVICE_MODELS' launch term.  The
+    config carries the device kind and the table constant currently in
+    force so the report shows measured-vs-declared drift."""
+    from paddle_tpu.analysis.costmodel import resolve_device_model
+
+    repeats = _repeats(args)
+    calls = args.calls or (50 if args.smoke else 300)
+    per_call = bench_dispatch(calls=calls, repeats=repeats)
+    mean_us, spread, run_list = _mean_spread([p * 1e6 for p in per_call])
+    dm = resolve_device_model()
+    emit_metric(
+        "dispatch_overhead_us", mean_us, "us/launch", None, None, 0.0,
+        {"calls": calls, "device_model": dm.name,
+         "table_launch_overhead_us": round(dm.launch_overhead_s * 1e6, 1),
+         "table_source": dm.source,
+         "runs": [round(r, 2) for r in run_list],
+         "spread": round(spread, 2)})
 
 
 def bench_ringattn(seq_len=8192, n_head=8, d_head=64, iters=8, warmup=2):
@@ -885,6 +1026,7 @@ def bench_mnist(batch_size=512, scan_steps=16, calls=2, warmup=1, amp=True):
                                              scope, warmup, calls, mon=mon,
                                              ckpt=ckpt)
     mem = memory_probe(exe, prog, feed, [avg_cost], scope, batch_size)
+    mem.update(cost_probe(prog, batch_size, "bench.mnist"))
     ips = batch_size * scan_steps * calls / dts[0]
     return ips, first_loss, last_loss, mem
 
@@ -1159,7 +1301,7 @@ def main():
     p.add_argument("--model", default="all",
                    choices=["all", "resnet50", "transformer", "bert",
                             "deepfm", "mnist", "ringattn", "convbn",
-                            "decode"])
+                            "decode", "dispatch"])
     p.add_argument("--pp", type=int, default=0,
                    help="with --model transformer: run the pp-stage "
                         "pipeline-parallel leg (GPipe + 1F1B vs single-"
@@ -1234,6 +1376,11 @@ def main():
         # python bench.py --model decode (run_ci.sh pairs the
         # FLAGS_kv_cache=0 recompute baseline next to it)
         ran.append(run_guarded("decode", run_decode, args, peak))
+    if args.model == "dispatch":
+        # per-launch overhead microbench (the cost model's launch-term
+        # constant); explicit-only like convbn/decode —
+        # python bench.py --model dispatch
+        ran.append(run_guarded("dispatch", run_dispatch, args, peak))
     if args.model in ("all", "ringattn"):
         ran.append(run_guarded("ringattn", run_ringattn, args, peak))
     if args.model in ("all", "bert"):

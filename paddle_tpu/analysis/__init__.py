@@ -10,6 +10,12 @@ is the TPU-first equivalent for the Python IR:
     dead-var/dead-op detection, donation/fetch alias conflicts, and the
     RNG-determinism lint (key-deriving ops the executor would not thread
     the step key for — the PR-4 `dropout_add` bug class).
+  * costmodel.py — static roofline / launch-cost model: per-op analytic
+    FLOPs + HBM bytes from the declared IR shapes, compute/memory/launch
+    classification against a declared device model, and the predicted
+    step time `max(flops/peak, bytes/bw) + n_launches*overhead` that
+    tools/perf_report.py renders (ROADMAP item 1's launch-bound
+    fraction).
   * kernel_lint.py — statically audits every Pallas kernel plan in
     kernels/ (attention, fused-qkv, conv_bn, dropout_epilogue, embedding,
     ring attention): VMEM budget vs the plan gate's estimate, (8,128)
@@ -33,6 +39,15 @@ from .verifier import (  # noqa: F401
     verify_program_set,
 )
 from .kernel_lint import lint_kernel_plans  # noqa: F401
+from .costmodel import (  # noqa: F401
+    DEVICE_MODELS,
+    DeviceModel,
+    OpCost,
+    ProgramCost,
+    cost_program,
+    publish_cost,
+    resolve_device_model,
+)
 
 __all__ = [
     "Finding",
@@ -41,4 +56,11 @@ __all__ = [
     "verify_or_raise",
     "verify_program_set",
     "lint_kernel_plans",
+    "DEVICE_MODELS",
+    "DeviceModel",
+    "OpCost",
+    "ProgramCost",
+    "cost_program",
+    "publish_cost",
+    "resolve_device_model",
 ]

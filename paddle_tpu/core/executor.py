@@ -1391,14 +1391,31 @@ class Executor:
     def _finish_monitored(self, mode, mon, t0, compiled_now, feed_vals,
                           fetches, return_numpy):
         """Telemetry epilogue shared by the three run modes: convert the
-        fetches (the device sync) and record the call's metrics."""
-        if return_numpy:
-            outs = [np.asarray(v) for v in fetches]
-        else:
+        fetches (the device sync) and record the call's metrics.
+
+        When monitoring, the np.asarray conversion is timed separately:
+        under jax's async dispatch the Python call returns as soon as the
+        computation is ENQUEUED, and the first np.asarray blocks until
+        the device finishes — so the call decomposes into dispatch time
+        (trace/cache-hit bookkeeping + enqueue) and device-wait time (the
+        blocking fetch, which bounds actual device execution from above).
+        The split is the step-time attribution the cost model's launch
+        term is validated against."""
+        if not return_numpy:
             outs = list(fetches)
-        if mon:
-            self._record_run_metrics(mode, t0, compiled_now, feed_vals,
-                                     outs if return_numpy else None)
+            if mon:
+                self._record_run_metrics(mode, t0, compiled_now, feed_vals,
+                                         None)
+            return outs
+        if not mon:
+            return [np.asarray(v) for v in fetches]
+        import time as _time
+
+        tc0 = _time.perf_counter()
+        outs = [np.asarray(v) for v in fetches]
+        device_wait_s = _time.perf_counter() - tc0
+        self._record_run_metrics(mode, t0, compiled_now, feed_vals, outs,
+                                 device_wait_s=device_wait_s)
         return outs
 
     def _count_error(self, mon):
@@ -1418,11 +1435,13 @@ class Executor:
                        if exc is not None else "unknown"))
 
     def _record_run_metrics(self, mode, t0, compiled_now, feed_vals,
-                            np_outs):
+                            np_outs, device_wait_s=None):
         """Registry writes for one finished executor call: run wall-time
         (and compile wall-time when this call traced+compiled — jax.jit
         compiles lazily, so the miss call's duration IS the compile cost),
-        plus host->device feed bytes and device->host fetch bytes."""
+        plus host->device feed bytes, device->host fetch bytes, and — when
+        _finish_monitored timed the fetch conversion — the dispatch-vs-
+        device-wait decomposition of the call."""
         import time as _time
 
         from .. import monitor, profiler
@@ -1451,8 +1470,24 @@ class Executor:
         else:
             monitor.histogram("executor.run_seconds").observe(dt)
             profiler.add_event(f"executor.{mode}", dt)
+            span_fields = {}
+            if device_wait_s is not None:
+                # dispatch = everything before the blocking fetch (Python
+                # bookkeeping + XLA enqueue); device_wait = the blocking
+                # np.asarray conversion.  Async dispatch means compute
+                # overlaps the dispatch window, so device_wait is a LOWER
+                # bound on device time and dispatch an upper bound on
+                # launch overhead — exactly the pair the cost model's
+                # launch term is checked against (tools/perf_report.py).
+                dispatch_s = max(dt - device_wait_s, 0.0)
+                monitor.histogram(
+                    "executor.dispatch_seconds").observe(dispatch_s)
+                monitor.histogram(
+                    "executor.device_wait_seconds").observe(device_wait_s)
+                span_fields = {"dispatch_s": round(dispatch_s, 6),
+                               "device_wait_s": round(device_wait_s, 6)}
             _flight.record(f"executor.{mode}", t0=t0_epoch,
-                           dur=round(dt, 6))
+                           dur=round(dt, 6), **span_fields)
         fb = sum(int(getattr(v, "nbytes", 0) or 0) for v in feed_vals)
         if fb:
             monitor.counter("executor.feed_bytes").inc(fb)

@@ -264,6 +264,23 @@ FLAGS.define(
     "path for StepMonitor per-step JSONL records (bench.py/trainer "
     "loops); empty keeps records in memory only")
 FLAGS.define(
+    "device_model", str, "",
+    "device model the static cost model attributes against "
+    "(paddle_tpu/analysis/costmodel.py DEVICE_MODELS key, e.g. "
+    "'TPU v5e'); empty = auto-detect from the jax backend's device_kind, "
+    "falling back to the measured 'cpu-host' entry off-chip")
+FLAGS.define(
+    "peak_flops", float, 0.0,
+    "override the device peak FLOP/s used by the cost model and "
+    "StepMonitor MFU (per chip); 0 = resolve from the cost model's "
+    "device table — and OMIT MFU entirely when the device is unknown "
+    "rather than publish a wrong number")
+FLAGS.define(
+    "launch_overhead_us", float, 0.0,
+    "override the per-launch dispatch overhead (microseconds) the cost "
+    "model charges each op; 0 = the device-table constant (measure "
+    "yours with `python bench.py --model dispatch`)")
+FLAGS.define(
     "flight_dir", str, "",
     "directory for flight-recorder JSONL dumps (monitor/flight.py): on "
     "crash, SIGTERM/SIGUSR1, or watchdog trip the in-memory event ring "

@@ -52,6 +52,18 @@ class KVCache:
         return (self.num_layers, self.batch, self.max_t, self.n_head,
                 self.d_head)
 
+    @property
+    def hbm_bytes(self) -> int:
+        """Resident HBM footprint of the allocated cache: K + V buffers
+        plus the int32 length counters — the denominator of the
+        generation tier's tokens/sec-per-HBM-GB efficiency gauge."""
+        from ..memory.planner import _DTYPE_BYTES
+
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return 2 * n * _DTYPE_BYTES.get(self.dtype, 4) + 4 * self.batch
+
     # -- program side ----------------------------------------------------
     def vars_in(self, program=None, persistable=True):
         """(k_var, v_var, len_var) declared in `program`'s global block

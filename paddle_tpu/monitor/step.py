@@ -37,16 +37,16 @@ _EPOCH_OFFSET = EPOCH_OFFSET
 # file (bench workloads, run_guarded retries restarting step numbers)
 _RUN_SEQ = _itertools.count(1)
 
-# bf16 peak FLOP/s by PJRT device_kind — the committed per-chip table
-# (bench.py reuses this for its MFU lines)
+# bf16 peak FLOP/s by PJRT device_kind — derived from the cost model's
+# committed device table (analysis/costmodel.py DEVICE_MODELS, the single
+# source of truth; bench.py reuses this view for its MFU lines).  The
+# "cpu-host" fallback entry is excluded: an unknown/host device has no
+# honest MFU denominator, so MFU is OMITTED rather than fabricated.
+from ..analysis.costmodel import DEVICE_MODELS as _DEVICE_MODELS
+
 TPU_PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
+    kind: dm.peak_flops for kind, dm in _DEVICE_MODELS.items()
+    if kind != "cpu-host"
 }
 
 
@@ -111,6 +111,13 @@ class StepMonitor:
 
     def _resolve_peak(self) -> Optional[float]:
         if self.peak_flops is not None:
+            return self.peak_flops
+        from ..flags import FLAGS
+
+        if FLAGS.peak_flops > 0:
+            # operator-declared per-chip peak (an unlisted device kind,
+            # or a derated sustained number) — trusted verbatim
+            self.peak_flops = float(FLAGS.peak_flops)
             return self.peak_flops
         try:
             import jax

@@ -135,6 +135,10 @@ class GenerationServingModel:
         self.max_tokens = min(config.max_tokens, p.max_out_len)
         self.bos_id, self.eos_id = p.bos_id, p.eos_id
         self.vocab = p.src_vocab_size
+        # resident KV footprint (self + cross caches): the capacity
+        # denominator of generation.<name>.tokens_per_sec_per_hbm_gb
+        self.kv_cache_bytes = (p.self_cache.hbm_bytes
+                               + p.cross_cache.hbm_bytes)
         self.ready = False
 
     def init_params(self):
@@ -151,7 +155,29 @@ class GenerationServingModel:
             np.full((self.slots,), self.bos_id, np.int64),
             active=zeros_active)
         self.ready = True
+        self.publish_attribution()
         return 2
+
+    def publish_attribution(self) -> None:
+        """Static capacity/attribution gauges for this model: KV-cache
+        HBM bytes plus per-program roofline costs of the prefill and
+        decode programs (op/launch counts, predicted step time, and the
+        decode launch-bound fraction ROADMAP item 1 tracks).  One
+        enabled() read when FLAGS_monitor is off."""
+        from .. import monitor
+
+        if not monitor.enabled():
+            return
+        from ..analysis.costmodel import cost_program, publish_cost
+
+        monitor.gauge(
+            f"generation.{self.name}.kv_cache_hbm_bytes").set(
+            self.kv_cache_bytes)
+        monitor.gauge(f"generation.{self.name}.slots").set(self.slots)
+        p = self.session.p
+        for tag, prog in (("prefill", p.prefill), ("decode", p.decode)):
+            publish_cost(cost_program(prog, name=f"gen.{self.name}.{tag}",
+                                      batch_size=self.slots))
 
     @property
     def compile_count(self) -> int:
@@ -209,6 +235,11 @@ class ContinuousBatcher:
             [None] * model.slots
         self._slot_token = np.full((model.slots,), model.bos_id, np.int64)
         self._pending_join: collections.deque = collections.deque()
+        # capacity-efficiency EWMA (scheduler-thread-private): emitted
+        # tokens/sec smoothed across decode iterations, divided by the
+        # resident KV-cache GB — ROADMAP item 2's gate metric
+        self._tps_ewma: Optional[float] = None
+        self._t_last_decode: Optional[float] = None
         # iteration clock anchor (tracing only): each decode.step span
         # starts where the previous iteration's span ENDED, so the
         # scheduler's between-iteration overhead (queue poll, span
@@ -601,8 +632,27 @@ class ContinuousBatcher:
                 emitted)
             monitor.counter(
                 f"serving.gen.{model.name}.decode_steps").inc()
-            monitor.gauge(f"serving.gen.{model.name}.occupancy").set(
-                sum(1 for r in self._slot_req if r is not None))
+            occ = sum(1 for r in self._slot_req if r is not None)
+            monitor.gauge(f"serving.gen.{model.name}.occupancy").set(occ)
+            monitor.gauge(
+                f"serving.gen.{model.name}.occupancy_fraction").set(
+                occ / max(model.slots, 1))
+            if self._t_last_decode is not None:
+                # tokens/sec over the inter-iteration interval, EWMA-
+                # smoothed (alpha 0.2), per resident KV-cache GB: the
+                # capacity-efficiency number a fleet scheduler bins by
+                dt_it = max(now - self._t_last_decode, 1e-9)
+                inst = emitted / dt_it
+                self._tps_ewma = (
+                    inst if self._tps_ewma is None
+                    else 0.2 * inst + 0.8 * self._tps_ewma)
+                kv_gb = model.kv_cache_bytes / 1e9
+                if kv_gb > 0:
+                    monitor.gauge(
+                        f"generation.{model.name}"
+                        ".tokens_per_sec_per_hbm_gb").set(
+                        self._tps_ewma / kv_gb)
+            self._t_last_decode = now
         return True
 
     def _fail_slots(self, exc: Exception) -> None:

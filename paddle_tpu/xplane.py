@@ -87,13 +87,18 @@ def _fields(buf: bytes):
 
 
 class XEvent:
-    __slots__ = ("name", "metadata_id", "offset_ps", "duration_ps")
+    __slots__ = ("name", "metadata_id", "offset_ps", "duration_ps",
+                 "raw_stats", "stats")
 
     def __init__(self):
         self.name = ""
         self.metadata_id = 0
         self.offset_ps = 0
         self.duration_ps = 0
+        # (stat_metadata_id, value, is_ref) triples, resolved into
+        # `stats` once the owning plane's stat-metadata map is known
+        self.raw_stats: List[tuple] = []
+        self.stats: Dict[str, object] = {}
 
 
 class XLine:
@@ -106,18 +111,47 @@ class XLine:
 
 
 class XPlane:
-    __slots__ = ("name", "lines")
+    __slots__ = ("name", "lines", "warnings")
 
     def __init__(self):
         self.name = ""
         self.lines: List[XLine] = []
+        # named skip-with-warning notes from tolerant parsing (newer
+        # libtpu dumps: unknown plane content, missing stat metadata)
+        self.warnings: List[str] = []
 
 
 class XSpace:
-    __slots__ = ("planes",)
+    __slots__ = ("planes", "warnings")
 
     def __init__(self):
         self.planes: List[XPlane] = []
+        self.warnings: List[str] = []
+
+
+def _parse_stat(buf: bytes):
+    """XStat: returns (metadata_id, value, is_ref) — value oneof double/
+    uint64/int64/str/bytes/ref (a ref indexes the plane's stat-metadata
+    name table)."""
+    import struct
+
+    mid, val, is_ref = 0, None, False
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            mid = _signed(v)
+        elif f == 2 and wt == 1:  # double_value
+            val = struct.unpack("<d", v)[0]
+        elif f == 3 and wt == 0:  # uint64_value
+            val = v
+        elif f == 4 and wt == 0:  # int64_value
+            val = _signed(v)
+        elif f == 5 and wt == 2:  # str_value
+            val = v.decode("utf-8", "replace")
+        elif f == 6 and wt == 2:  # bytes_value
+            val = bytes(v)
+        elif f == 7 and wt == 0:  # ref_value
+            val, is_ref = v, True
+    return mid, val, is_ref
 
 
 def _parse_event(buf: bytes) -> XEvent:
@@ -129,6 +163,8 @@ def _parse_event(buf: bytes) -> XEvent:
             ev.offset_ps = _signed(v)
         elif f == 3 and wt == 0:
             ev.duration_ps = _signed(v)
+        elif f == 4 and wt == 2:  # stats
+            ev.raw_stats.append(_parse_stat(v))
     return ev
 
 
@@ -159,36 +195,105 @@ def _parse_event_metadata(buf: bytes):
     return mid, (display or name)
 
 
+def _parse_stat_metadata(buf: bytes):
+    """XStatMetadata: returns (id, name)."""
+    mid, name = 0, ""
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            mid = _signed(v)
+        elif f == 2 and wt == 2:
+            name = v.decode("utf-8", "replace")
+    return mid, name
+
+
+def _map_entry(buf: bytes):
+    """One map<int64, Msg> entry: returns (key, value_bytes)."""
+    key, val = 0, None
+    for mf, mwt, mv in _fields(buf):
+        if mf == 1 and mwt == 0:
+            key = _signed(mv)
+        elif mf == 2 and mwt == 2:
+            val = mv
+    return key, val
+
+
 def _parse_plane(buf: bytes) -> XPlane:
     plane = XPlane()
     meta: Dict[int, str] = {}
+    stat_meta: Dict[int, str] = {}
     for f, wt, v in _fields(buf):
         if f == 2 and wt == 2:
             plane.name = v.decode("utf-8", "replace")
         elif f == 3 and wt == 2:
-            plane.lines.append(_parse_line(v))
+            # newer dumps may carry line/event content this reader does
+            # not model: skip THE LINE with a named warning, keep the
+            # plane (postmortem traces must not die on one bad stream)
+            try:
+                plane.lines.append(_parse_line(v))
+            except ValueError as e:
+                plane.warnings.append(
+                    f"plane {plane.name or '?'}: skipping unparseable "
+                    f"line #{len(plane.lines)}: {e}")
         elif f == 4 and wt == 2:
             # map<int64, XEventMetadata>: entries are {1: key, 2: value}
-            key, val = 0, None
-            for mf, mwt, mv in _fields(v):
-                if mf == 1 and mwt == 0:
-                    key = _signed(mv)
-                elif mf == 2 and mwt == 2:
-                    val = mv
+            key, val = _map_entry(v)
             if val is not None:
                 mid, name = _parse_event_metadata(val)
                 meta[key or mid] = name
+        elif f == 5 and wt == 2:
+            # map<int64, XStatMetadata> — stat name table
+            key, val = _map_entry(v)
+            if val is not None:
+                mid, name = _parse_stat_metadata(val)
+                stat_meta[key or mid] = name
+    missing_stats = set()
     for line in plane.lines:
         for ev in line.events:
             ev.name = meta.get(ev.metadata_id, f"op#{ev.metadata_id}")
+            for mid, val, is_ref in ev.raw_stats:
+                # a stat (or ref target) whose metadata entry is absent
+                # from this dump is SKIPPED by name, never a KeyError —
+                # newer libtpu versions add stat types freely
+                sname = stat_meta.get(mid)
+                if sname is None:
+                    missing_stats.add(mid)
+                    continue
+                if is_ref:
+                    if val not in stat_meta:
+                        missing_stats.add(val)
+                        continue
+                    val = stat_meta[val]
+                ev.stats[sname] = val
+    for mid in sorted(missing_stats):
+        plane.warnings.append(
+            f"plane {plane.name or '?'}: skipping stat(s) with missing "
+            f"stat-metadata entry #{mid}")
     return plane
 
 
 def parse_xspace(buf: bytes) -> XSpace:
+    """Decode one XSpace.  Tolerant by construction: unknown fields skip
+    by wire type, and a plane whose contents this reader cannot decode
+    (an unknown plane type from a newer libtpu) is dropped with a NAMED
+    warning on `space.warnings` (+ one log line) instead of poisoning
+    the whole trace."""
     space = XSpace()
+    idx = 0
     for f, wt, v in _fields(buf):
         if f == 1 and wt == 2:
-            space.planes.append(_parse_plane(v))
+            try:
+                plane = _parse_plane(v)
+            except ValueError as e:
+                msg = f"skipping unparseable plane #{idx}: {e}"
+                space.warnings.append(msg)
+                from .log import warning
+
+                warning("xplane: %s", msg)
+                idx += 1
+                continue
+            space.planes.append(plane)
+            space.warnings.extend(plane.warnings)
+            idx += 1
     return space
 
 

@@ -34,6 +34,14 @@ jax.config.update("jax_platforms", _platform)
 # matmuls (the framework default is device-native fast precision).
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# NOTE: do NOT enable jax's persistent compilation cache here.  It cuts
+# suite wall time ~40% warm, but on this jaxlib the CPU backend's Pallas
+# kernels lower to custom_calls whose callback pointers are baked into
+# the serialized executable — a cache hit across processes returns a
+# stale/wrong kernel (observed: fused-qkv checkpoint-interop loss
+# mismatch, then a segfault on re-execution).  Re-evaluate on a jaxlib
+# whose CPU thunk serialization is stable.
+
 
 def pytest_configure(config):
     config.addinivalue_line(

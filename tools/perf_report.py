@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""perf_report: render the static cost model's attribution for the
+bundled programs, and falsify it against measured bench records.
+
+Three products on stdout:
+
+  1. Per-program roofline tables (paddle_tpu/analysis/costmodel): per-op
+     FLOPs + HBM bytes, compute/memory/launch classification against the
+     resolved device model, and the predicted step time
+     `max(flops/peak, bytes/bw) + n_launches * overhead`.
+  2. The decode program's LAUNCH-BOUND FRACTION — ROADMAP item 1's
+     go/no-go number for the decode megakernel, CPU-estimable today.
+  3. With --bench <record.json> (bench.py / run_ci smoke artifacts):
+     predicted-vs-measured step-time ratios for every record whose
+     config carries the cost probe's fields — the model is falsifiable,
+     not just quotable.
+
+Usage:
+  python tools/perf_report.py                          # all programs
+  python tools/perf_report.py --programs decode
+  python tools/perf_report.py --bench ci_artifacts/bench_smoke.json \
+      --bench ci_artifacts/bench_decode_smoke.json
+  python tools/perf_report.py --device "TPU v5e"       # what-if retarget
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PROGRAMS = ("mnist", "transformer_smoke", "decode")
+
+
+def _build_mnist(batch_size):
+    """The bench_mnist one-step train program (smoke shapes)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import mnist as M
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        _, _, avg_cost, _, _ = M.build_train_net()
+        pt.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    return [("mnist", prog, batch_size)]
+
+
+def _build_transformer_smoke(batch_size):
+    """The bench_transformer --smoke train program (tiny config,
+    seq 64)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as T
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        avg_cost, _, _ = T.transformer(
+            src_vocab_size=256, trg_vocab_size=256, max_length=64,
+            n_layer=2, n_head=4, d_key=16, d_value=16, d_model=64,
+            d_inner_hid=128, dropout_rate=0.1, src_seq_len=64,
+            trg_seq_len=64)
+        pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    return [("transformer_smoke", prog, batch_size)]
+
+
+def _build_decode(batch_size):
+    """The bench_decode --smoke program pair (tiny config): the
+    per-token decode program is the megakernel candidate; prefill rides
+    along for contrast."""
+    from paddle_tpu.models import transformer as T
+
+    progs = T.build_generation_programs(
+        src_vocab_size=1000, trg_vocab_size=1000, max_length=50,
+        n_layer=2, n_head=4, d_key=32, d_value=32, d_model=128,
+        d_inner_hid=256, batch_size=batch_size, src_seq_len=32,
+        max_out_len=16, bos_id=0, eos_id=-1, strategy="greedy")
+    return [("decode", progs.decode, batch_size),
+            ("decode.prefill", progs.prefill, batch_size)]
+
+
+_BUILDERS = {
+    "mnist": _build_mnist,
+    "transformer_smoke": _build_transformer_smoke,
+    "decode": _build_decode,
+}
+
+_DEFAULT_BATCH = {"mnist": 64, "transformer_smoke": 2, "decode": 1}
+
+
+def roofline_section(names, device_name, batch_size, top):
+    from paddle_tpu.analysis.costmodel import (
+        cost_program,
+        resolve_device_model,
+    )
+
+    device = resolve_device_model(device_name)
+    out, decode_cost = [], None
+    for prog_name in names:
+        if prog_name not in _BUILDERS:
+            raise SystemExit(f"unknown program {prog_name!r} "
+                             f"(choices: {', '.join(PROGRAMS)})")
+        bs = batch_size or _DEFAULT_BATCH[prog_name]
+        for tag, prog, b in _BUILDERS[prog_name](bs):
+            cost = cost_program(prog, name=tag, batch_size=b,
+                                device=device)
+            out.append(f"== Roofline: {tag} (batch {b}) ==")
+            out.append(cost.table(top=top))
+            out.append("")
+            if tag == "decode":
+                decode_cost = cost
+    if decode_cost is not None:
+        out.append("== Decode launch-bound fraction (ROADMAP item 1) ==")
+        out.append(
+            f"  {decode_cost.launch_bound_fraction:.1%} of the predicted "
+            f"per-token step is dispatch overhead "
+            f"({decode_cost.n_launches} launches x "
+            f"{decode_cost.device.launch_overhead_s * 1e6:.1f} us on "
+            f"{decode_cost.device.name}, {decode_cost.device.source}) — "
+            f"re-estimate on chip before committing to the megakernel")
+        out.append("")
+    return "\n".join(out)
+
+
+def _measured_step_seconds(rec):
+    """Seconds one execution of the record's one-step program took,
+    derived from the record's throughput number and its config —
+    None when the record shape is not derivable."""
+    cfg = rec.get("config") or {}
+    value = rec.get("value")
+    unit = rec.get("unit", "")
+    batch = cfg.get("batch")
+    if not value or not batch:
+        return None
+    if unit in ("images/sec", "examples/sec"):
+        return batch / value
+    if unit == "tokens/sec":
+        if str(rec.get("metric", "")).startswith("decode_tokens_per_sec"):
+            # one decode-program call emits `batch` tokens (one per lane)
+            return batch / value
+        seq = cfg.get("seq_len")
+        return (batch * seq / value) if seq else None
+    return None
+
+
+def load_records(paths):
+    recs = []
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue
+    return recs
+
+
+def predicted_vs_measured(recs):
+    """One line per record carrying cost-probe fields: predicted (static
+    model) vs measured (the bench number) step time and their ratio.
+    Ratio >> 1 = the model overcharges (fusion merged launches, shapes
+    overstated); << 1 = hidden costs the model misses."""
+    rows = []
+    for rec in recs:
+        cfg = rec.get("config") or {}
+        pred_us = cfg.get("cost_predicted_step_us")
+        meas_s = _measured_step_seconds(rec)
+        if pred_us is None or meas_s is None or meas_s <= 0:
+            continue
+        rows.append((rec["metric"], pred_us, meas_s * 1e6,
+                     pred_us / (meas_s * 1e6),
+                     cfg.get("cost_launch_bound_fraction"),
+                     cfg.get("cost_device", "?")))
+    if not rows:
+        return ("== Predicted vs measured ==\n  (no records with cost "
+                "fields — run bench.py from this tree; the cost probe "
+                "stamps config.cost_predicted_step_us)\n")
+    out = ["== Predicted vs measured (per one-step program call) =="]
+    out.append(f"  {'metric':44s} {'pred us':>10s} {'meas us':>10s} "
+               f"{'ratio':>7s} {'launch%':>8s}  device")
+    for m, p, s, r, lf, dev in rows:
+        lf_s = f"{lf:.1%}" if lf is not None else "?"
+        out.append(f"  {m:44s} {p:10.1f} {s:10.1f} {r:7.3f} {lf_s:>8s}"
+                   f"  {dev}")
+    out.append("")
+    return "\n".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--programs", default=",".join(PROGRAMS),
+                    help=f"comma list of {', '.join(PROGRAMS)}; "
+                         f"'none' skips the static tables")
+    ap.add_argument("--bench", action="append", default=[],
+                    metavar="RECORD_JSON",
+                    help="bench/smoke JSON-lines artifact(s) for the "
+                         "predicted-vs-measured section (repeatable)")
+    ap.add_argument("--device", default=None,
+                    help="device model name (default: FLAGS_device_model "
+                         "or auto-detect; 'cpu-host' off-chip)")
+    ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--top", type=int, default=8,
+                    help="heaviest-ops rows per table")
+    args = ap.parse_args()
+
+    names = [] if args.programs == "none" else [
+        n for n in args.programs.split(",") if n]
+    if names:
+        print(roofline_section(names, args.device, args.batch_size,
+                               args.top))
+    if args.bench:
+        print(predicted_vs_measured(load_records(args.bench)))
+    elif not names:
+        print("nothing to do: --programs none and no --bench",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

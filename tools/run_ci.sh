@@ -12,8 +12,14 @@
 #   3. full test suite on the virtual 8-device CPU mesh
 #   4. bench smoke (real chip if present, else CPU) with telemetry,
 #      flight recorder, and metrics-snapshot artifacts
-#   5. chaos kill-and-resume fault-tolerance gate
-#   6. serving smoke gate: export a model, boot the inference server,
+#   5. bench regression sentry: tools/bench_diff.py diffs every archived
+#      smoke artifact against the committed baselines under
+#      ci_artifacts/baselines/ (noise-aware: runs[] envelopes + rel-tol;
+#      regression only when envelopes separate), asserts every record
+#      carries a provenance block, and proves the gate can go RED by
+#      chaos-injecting per-token latency into a decode re-run
+#   6. chaos kill-and-resume fault-tolerance gate
+#   7. serving smoke gate: export a model, boot the inference server,
 #      drive tools/loadgen.py — p99/batch-fill histograms on /metrics,
 #      zero recompiles across a shape-varying stream, the dynamic-
 #      batching A/B (batched >= 2x batch-size-1 QPS), the OVERLOAD gate
@@ -24,12 +30,12 @@
 #      drain-trigger flight dump — overload_smoke.json), and the
 #      generation continuous-batching gate (late joins without
 #      retrace/stall, concurrent streams >= 2x batch-1 decode tokens/sec)
-#   7. compile-check + multichip dryrun (the driver's graft contract)
+#   8. compile-check + multichip dryrun (the driver's graft contract)
 # Usage: tools/run_ci.sh [fast]   — "fast" skips the bench smoke.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/7] lint gate"
+echo "== [1/8] lint gate"
 if command -v ruff >/dev/null 2>&1; then
   ruff check paddle_tpu tools tests bench.py __graft_entry__.py
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -40,17 +46,17 @@ else
 fi
 python tools/lint_rules.py
 
-echo "== [2/7] graph-lint gate (static analysis over the model matrix)"
+echo "== [2/8] graph-lint gate (static analysis over the model matrix)"
 mkdir -p ci_artifacts
 JAX_PLATFORMS=cpu python tools/graph_lint.py \
   --out ci_artifacts/graph_lint.json
 echo "-- graph-lint findings artifact: ci_artifacts/graph_lint.json"
 
-echo "== [3/7] test suite (virtual 8-device CPU mesh)"
+echo "== [3/8] test suite (virtual 8-device CPU mesh)"
 python -m pytest tests/ -q
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [4/7] bench smoke (telemetry on; snapshot + flight artifacts)"
+  echo "== [4/8] bench smoke (telemetry on; snapshot + flight artifacts)"
   mkdir -p ci_artifacts
   rm -f ci_artifacts/bench_steps.jsonl  # StepMonitor appends; keep one run
   rm -rf ci_artifacts/flight && mkdir -p ci_artifacts/flight
@@ -213,6 +219,12 @@ print("pipeline records OK:",
         r["value"]) for r in recs])
 PY
   echo "-- pipeline A/B record artifact: ci_artifacts/bench_pipeline_smoke.json"
+  # Dispatch microbench (ISSUE 16): per-launch overhead of a cache-hit
+  # exe.run — the measured launch constant the static cost model's
+  # roofline attribution charges per op (analysis/costmodel.py)
+  python -W error::UserWarning bench.py --model dispatch --smoke \
+    | tee ci_artifacts/bench_dispatch_smoke.json
+  echo "-- dispatch overhead artifact: ci_artifacts/bench_dispatch_smoke.json"
   # Copy census (PERF.md r09 attribution artifact): the automated
   # while-body copy-byte attribution on the smoke transformer, fused vs
   # unfused — tests assert the projection-site collapse; CI archives the
@@ -240,7 +252,61 @@ PY
 fi
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [5/7] chaos smoke: kill-and-resume fault-tolerance gate"
+  echo "== [5/8] bench regression sentry (diff vs committed baselines)"
+  # Provenance contract (ISSUE 16 satellite): every archived record must
+  # say which commit/flags/jax produced it, or the baseline ledger is
+  # unreviewable.
+  python - <<'PY'
+import glob, json
+for path in sorted(glob.glob("ci_artifacts/bench_*_smoke.json")) \
+        + ["ci_artifacts/bench_smoke.json"]:
+    for line in open(path):
+        if not line.strip().startswith("{"):
+            continue
+        rec = json.loads(line)
+        p = rec.get("provenance")
+        assert p and "git_commit" in p and "flags" in p and "jax" in p, \
+            f"{path}: record {rec.get('metric')} lacks a provenance block"
+print("provenance blocks OK across all archived smoke artifacts")
+PY
+  # Noise-aware diff of every archived smoke artifact against the
+  # committed baseline ledger.  rel-tol 0.50: CI boxes differ from the
+  # baseline box; the runs[]-envelope + 50% padding only separates on
+  # real cliffs (the chaos demo below injects -95% and is caught), so a
+  # red here is a finding, not weather.  Refresh protocol: rerun the
+  # smoke legs on a quiet box and copy the artifacts over
+  # ci_artifacts/baselines/ in the SAME commit as an intended perf
+  # change.
+  for a in bench_smoke bench_convbn_smoke bench_deepfm_smoke \
+           bench_transformer_smoke bench_recompute_smoke \
+           bench_decode_smoke bench_pipeline_smoke bench_dispatch_smoke
+  do
+    python tools/bench_diff.py ci_artifacts/baselines/$a.json \
+      ci_artifacts/$a.json --rel-tol 0.50
+  done
+  # RED-gate demo: chaos-inject 20ms per decoded token and require the
+  # sentry to fail NAMING the regressed (workload, metric) pair — proof
+  # the gate can actually fire, not just pass.
+  FLAGS_chaos=1 FLAGS_chaos_serve_latency_s=0.02 \
+    python bench.py --model decode --smoke \
+    > ci_artifacts/bench_decode_chaos.json
+  set +e
+  python tools/bench_diff.py ci_artifacts/baselines/bench_decode_smoke.json \
+    ci_artifacts/bench_decode_chaos.json --rel-tol 0.50 \
+    | tee ci_artifacts/bench_diff_red.txt
+  rc=${PIPESTATUS[0]}
+  set -e
+  if [[ $rc -ne 1 ]]; then
+    echo "bench_diff red-gate demo: expected exit 1, got rc=$rc"
+    exit 1
+  fi
+  grep -q "REGRESSION (decode, decode_tokens_per_sec_b1)" \
+    ci_artifacts/bench_diff_red.txt
+  echo "-- sentry red-gate demo OK (chaos-injected decode regression caught by name)"
+fi
+
+if [[ "${1:-}" != "fast" ]]; then
+  echo "== [6/8] chaos smoke: kill-and-resume fault-tolerance gate"
   # A training subprocess is SIGKILLed mid-run by the chaos harness, then
   # resumed from the latest verifiable checkpoint; the gate passes when the
   # resumed run reports a non-zero start step and finishes.  Artifacts: the
@@ -275,7 +341,7 @@ PY
 fi
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [6/7] serving smoke: dynamic-batching inference gate"
+  echo "== [7/8] serving smoke: dynamic-batching inference gate"
   # Exports a demo model, boots two inference servers (batched + forced
   # --max-batch 1), and drives tools/loadgen.py through both:
   #   * a shape-varying stream must finish with the executor compile
@@ -332,7 +398,7 @@ PY
   ls ci_artifacts/serving/
 fi
 
-echo "== [7/7] entry compile-check + multichip dryrun"
+echo "== [8/8] entry compile-check + multichip dryrun"
 python __graft_entry__.py
 
 echo "CI OK"

@@ -155,6 +155,31 @@ def memory_plans(doc: dict):
     return plans
 
 
+def cost_attribution(doc: dict):
+    """Last `cost.program` event per program name (analysis/costmodel
+    publish_cost: the static roofline's predicted step time, launch-bound
+    fraction, and bound-class census)."""
+    costs = {}
+    for ev in doc.get("flight", {}).get("events", []):
+        if ev.get("kind") == "cost.program":
+            costs[ev.get("name", "?")] = ev
+    return costs
+
+
+def dispatch_split(doc: dict):
+    """(dispatch_s, device_wait_s, n) summed over executor run spans that
+    carry the enqueue-vs-transfer decomposition (core/executor.py)."""
+    dispatch = wait = 0.0
+    n = 0
+    for ev in doc.get("flight", {}).get("events", []):
+        if str(ev.get("kind", "")).startswith("executor.") \
+                and "dispatch_s" in ev:
+            dispatch += float(ev["dispatch_s"])
+            wait += float(ev.get("device_wait_s", 0.0))
+            n += 1
+    return dispatch, wait, n
+
+
 def embedding_census(doc: dict):
     """Last sparse-tier trace census (gather launches / rows touched per
     step — the embedding.* gauges, mirrored into the flight ring at
@@ -206,6 +231,32 @@ def report(doc: dict, k: int = 20) -> str:
             lines.append(f"  {comp:<32} x{n}")
     else:
         lines.append("Recompiles: none recorded")
+
+    costs = cost_attribution(doc)
+    disp, wait, nrun = dispatch_split(doc)
+    if costs or nrun:
+        lines.append("")
+        lines.append("Attribution (static cost model + dispatch split)")
+    if costs:
+        lines.append(
+            f"{'program':<28} {'launches':>8} {'pred(us)':>10} "
+            f"{'launch%':>8} {'bound c/m/l':>12}  device")
+        for name in sorted(costs):
+            ev = costs[name]
+            bc = ev.get("bound_counts") or {}
+            lines.append(
+                f"{name[:28]:<28} {ev.get('n_launches', 0):>8} "
+                f"{float(ev.get('predicted_seconds', 0)) * 1e6:>10.1f} "
+                f"{float(ev.get('launch_bound_fraction', 0)):>8.1%} "
+                f"{bc.get('compute', 0):>4}/{bc.get('memory', 0)}"
+                f"/{bc.get('launch', 0):<5} "
+                f"{ev.get('device', '?')} ({ev.get('device_source', '?')})")
+    if nrun:
+        tot = disp + wait
+        frac = disp / tot if tot > 0 else 0.0
+        lines.append(
+            f"  executor split over {nrun} runs: dispatch {disp:.4f}s vs "
+            f"device-wait {wait:.4f}s ({frac:.1%} host-side dispatch)")
 
     census = embedding_census(doc)
     if census:
