@@ -517,6 +517,12 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
         pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
     if amp:
         pt.amp.enable(prog)
+    # numerics observability A/B knob: FLAGS_check_numerics=summary adds
+    # the fused per-param-group stats reductions + one [N,4] fetch per
+    # step (the PERF.md overhead leg); off is a no-op by contract
+    from paddle_tpu.analysis import numerics as AN
+
+    AN.maybe_instrument(prog)
     rc_fields = {}
     if recompute:
         # the r12 A/B leg: activation-recompute pass applied to the

@@ -198,3 +198,72 @@ def apply_cast_policy(op_type: str, ins: dict) -> dict:
         slot: [_cast_value(v, target) for v in vals]
         for slot, vals in ins.items()
     }
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference: fluid.contrib.mixed_precision
+    DynamicLossScale).  bf16 autocast does not need it — bf16 keeps
+    fp32's exponent range — but fp16-style recipes and user-driven
+    scaling do, and the numerics tier needs a place to route overflow
+    verdicts: monitor/numerics.publish_step_stats calls `update(found)`
+    once per step with whether any low-precision grad held Inf/NaN.
+
+    Host-side state only: the user multiplies the loss by `scale` (and
+    un-scales grads) in their own graph or feed; this object just runs
+    the grow/backoff policy and exports the `amp.loss_scale` gauge.
+    Skipped steps (overflow -> caller should drop the update) are
+    counted in `amp.overflow_steps`.
+    """
+
+    def __init__(self, init_scale: float = 2.0 ** 15,
+                 growth_factor: float = 2.0, backoff_factor: float = 0.5,
+                 growth_interval: int = 2000,
+                 min_scale: float = 1.0, max_scale: float = 2.0 ** 24):
+        self.scale = float(init_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.min_scale = float(min_scale)
+        self.max_scale = float(max_scale)
+        self.good_steps = 0
+        self.overflow_steps = 0
+
+    def update(self, found_overflow: bool) -> float:
+        """Advance the policy one step; returns the new scale."""
+        if found_overflow:
+            self.overflow_steps += 1
+            self.good_steps = 0
+            self.scale = max(self.scale * self.backoff_factor,
+                             self.min_scale)
+        else:
+            self.good_steps += 1
+            if self.good_steps >= self.growth_interval:
+                self.good_steps = 0
+                self.scale = min(self.scale * self.growth_factor,
+                                 self.max_scale)
+        self._export()
+        return self.scale
+
+    def _export(self):
+        from .monitor import registry as _registry
+
+        if _registry.enabled():
+            reg = _registry.default_registry()
+            reg.gauge("amp.loss_scale").set(self.scale)
+            reg.gauge("amp.overflow_steps").set(self.overflow_steps)
+
+
+_loss_scaler = None
+
+
+def set_loss_scaler(scaler) -> None:
+    """Install (or clear, with None) the process-wide dynamic loss
+    scaler consulted by the numerics tier's overflow publication."""
+    global _loss_scaler
+    _loss_scaler = scaler
+    if scaler is not None:
+        scaler._export()
+
+
+def active_loss_scaler():
+    return _loss_scaler

@@ -16,6 +16,12 @@ is the TPU-first equivalent for the Python IR:
     step time `max(flops/peak, bytes/bw) + n_launches*overhead` that
     tools/perf_report.py renders (ROADMAP item 1's launch-bound
     fraction).
+  * numerics.py — the FLAGS_check_numerics instrumentation pass: rewrite
+    a Program to append fused per-tensor health reductions
+    (ops/numerics_ops.py) packed into one [N, 4] stats fetch per step —
+    per-op-output rows in `locate` mode (NaN/Inf origin localization),
+    grad/weight/update rows in `summary` mode (training-dynamics
+    gauges); `off` is zero-cost with a byte-identical fingerprint.
   * kernel_lint.py — statically audits every Pallas kernel plan in
     kernels/ (attention, fused-qkv, conv_bn, dropout_epilogue, embedding,
     ring attention): VMEM budget vs the plan gate's estimate, (8,128)
@@ -39,6 +45,11 @@ from .verifier import (  # noqa: F401
     verify_program_set,
 )
 from .kernel_lint import lint_kernel_plans  # noqa: F401
+from .numerics import (  # noqa: F401
+    instrument_program,
+    is_instrumented,
+    maybe_instrument,
+)
 from .costmodel import (  # noqa: F401
     DEVICE_MODELS,
     DeviceModel,
@@ -56,6 +67,9 @@ __all__ = [
     "verify_or_raise",
     "verify_program_set",
     "lint_kernel_plans",
+    "instrument_program",
+    "is_instrumented",
+    "maybe_instrument",
     "DEVICE_MODELS",
     "DeviceModel",
     "OpCost",

@@ -253,6 +253,15 @@ def trace_block(block: fw.Block, env: Dict[str, Any], tctx: TraceContext,
             for name, val in zip(names, vals):
                 if name and val is not None:
                     env[name] = val
+        if FLAGS.chaos:
+            # graph-level NaN injection (FLAGS_chaos_nan_var): poison the
+            # named op output IN the compiled graph, so the numerics
+            # tier's locate replay has a real in-graph origin to find —
+            # unlike chaos_nan_at_step's host-side fake loss.  One flag
+            # read per op at trace time only when chaos is armed.
+            from ..testing import chaos as _chaos
+
+            _chaos.poison_outputs(op, env)
         bvars = op.attrs.get("pipeline_boundary_vars")
         if bvars and getattr(tctx, "boundary_barriers", True):
             # Pipeline-annotated programs (parallel/pipeline/partition.py
@@ -538,6 +547,12 @@ class Executor:
         self._cache: Dict[Any, _CompiledEntry] = {}
         self._ref_names_cache: Dict[Any, tuple] = {}
         self._run_counter = 0
+        # numerics failing-step replay (monitor/numerics.py): when set,
+        # the next _next_run_id() returns THIS value once, without
+        # advancing the counter — the replayed step folds the SAME id
+        # into its PRNG key, so dropout masks come out bit-identical to
+        # the step being diagnosed
+        self._forced_run_id: Optional[int] = None
         # pre-compile static-verification memo: (program fingerprint,
         # scope signature, feeds, fetches) already verified by this
         # executor — verification runs at most once per signature, so a
@@ -637,6 +652,11 @@ class Executor:
             v.name if isinstance(v, fw.Variable) else v for v in (fetch_list or [])
         ]
         scope = scope or global_scope()
+        # numerics-instrumented programs (analysis/numerics.py) carry
+        # packed [N, 4] stats tensors that ride the user's fetch — ONE
+        # device->host transfer per step, stripped before returning
+        user_fetch_n, fetch_names = self._numerics_fetch(program,
+                                                         fetch_names)
 
         feed_names = sorted(feed)
         # fingerprint (content hash, memoized on the mutation stamp) rather
@@ -718,14 +738,18 @@ class Executor:
                 else contextlib.nullcontext():
             rw_vals = [scope.find_var(n) for n in entry.rw_state]
             ro_vals = [scope.find_var(n) for n in entry.ro_state]
+            rid = self._next_run_id()
+            # locate-mode capture must happen HERE: the rw buffers are
+            # donated to the executable below, so a post-hoc snapshot
+            # would read deleted arrays
+            self._maybe_capture_step(program, feed, fetch_names, entry,
+                                     rw_vals, ro_vals, rid)
             try:
                 if entry.needs_key:
                     seed = program.random_seed or 0
-                    key_arr = jax.random.fold_in(prng_key(seed),
-                                                 self._next_run_id())
+                    key_arr = jax.random.fold_in(prng_key(seed), rid)
                     result = entry.fn(feed_vals, rw_vals, ro_vals, key_arr)
                 else:
-                    self._next_run_id()
                     result = entry.fn(feed_vals, rw_vals, ro_vals)
             except Exception:
                 self._count_error(mon)
@@ -756,8 +780,10 @@ class Executor:
                     + "\n  ".join(bad)
                 )
 
-        return self._finish_monitored("run", mon, t0, compiled_now,
+        outs = self._finish_monitored("run", mon, t0, compiled_now,
                                       feed_vals, fetches, return_numpy)
+        return self._publish_numerics(program, fetch_names, user_fetch_n,
+                                      outs)
 
     def run_steps(
         self,
@@ -787,6 +813,8 @@ class Executor:
             v.name if isinstance(v, fw.Variable) else v
             for v in (fetch_list or [])
         ]
+        user_fetch_n, fetch_names = self._numerics_fetch(program,
+                                                         fetch_names)
         feed_names = sorted(feed)
         feed_stack = {
             n: self._to_device_array(program, n, feed[n])
@@ -869,8 +897,10 @@ class Executor:
                     "check_nan_inf: non-finite output from op(s):\n  "
                     + "\n  ".join(bad)
                 )
-        return self._finish_monitored("run_steps", mon, t0, compiled_now,
+        outs = self._finish_monitored("run_steps", mon, t0, compiled_now,
                                       feed_vals, fetches, return_numpy)
+        return self._publish_numerics(program, fetch_names, user_fetch_n,
+                                      outs)
 
     def run_startup_missing(self, startup_program=None, scope=None):
         """Run only the startup ops whose outputs are NOT yet in the scope
@@ -956,6 +986,8 @@ class Executor:
             v.name if isinstance(v, fw.Variable) else v
             for v in (fetch_list or [])
         ]
+        user_fetch_n, fetch_names = self._numerics_fetch(program,
+                                                         fetch_names)
         feed_names = sorted(feed)
         feed_stack = {
             n: self._to_device_array(program, n, feed[n])
@@ -1023,9 +1055,11 @@ class Executor:
                 raise FloatingPointError(
                     "check_nan_inf: non-finite output from op(s):\n  "
                     + "\n  ".join(bad))
-        return self._finish_monitored("run_accumulated", mon, t0,
+        outs = self._finish_monitored("run_accumulated", mon, t0,
                                       compiled_now, feed_vals, fetches,
                                       return_numpy)
+        return self._publish_numerics(program, fetch_names, user_fetch_n,
+                                      outs)
 
     def _compile_accumulated(self, program, feed_names, fetch_names, scope,
                              k, unroll=False):
@@ -1540,10 +1574,83 @@ class Executor:
     def _next_run_id(self) -> int:
         """Draw the next run-counter value under a lock: key-deriving
         programs fold this into their PRNG key, and concurrent serving
-        threads must never fold in the same value twice."""
+        threads must never fold in the same value twice.  A forced id
+        (numerics failing-step replay) is consumed exactly once and
+        does not advance the counter."""
         with self._counter_lock:
+            if self._forced_run_id is not None:
+                rid = self._forced_run_id
+                self._forced_run_id = None
+                return rid
             self._run_counter += 1
             return self._run_counter
+
+    def _numerics_fetch(self, program, fetch_names):
+        """Append the instrumented program's packed stats tensors to the
+        fetch list (analysis/numerics.py) so the per-step health rows
+        ride the existing device->host transfer.  Returns (user fetch
+        count, possibly-extended fetch list).  Uninstrumented programs
+        pay one getattr."""
+        stats_vars = getattr(program, "_numerics_stats_vars", None)
+        if not stats_vars:
+            return len(fetch_names), fetch_names
+        extra = [n for n in stats_vars if n not in fetch_names]
+        return len(fetch_names), fetch_names + extra
+
+    def _publish_numerics(self, program, fetch_names, user_n, outs):
+        """Strip auto-appended stats tensors off the fetch results and
+        hand them to the monitor tier.  Publication is exception-proof:
+        telemetry must never fail the run."""
+        if len(fetch_names) == user_n and not getattr(
+                program, "_numerics_stats_vars", None):
+            return outs
+        try:
+            from ..monitor import numerics as _mnum
+
+            stats_vars = set(program._numerics_stats_vars)
+            stats = {n: v for n, v in zip(fetch_names, outs)
+                     if n in stats_vars}
+            _mnum.publish_step_stats(program, stats)
+        except Exception:  # pragma: no cover
+            pass
+        return outs[:user_n]
+
+    def _maybe_capture_step(self, program, feed, fetch_names, entry,
+                            rw_vals, ro_vals, rid):
+        """FLAGS_check_numerics=locate: snapshot this step's replay
+        context (feed, pre-donation rw-state copies, the PRNG run id)
+        so a watchdog nan_loss trip can re-run the failing step
+        bit-identically under full per-op instrumentation
+        (monitor/numerics.locate_replay).  One flag read when off."""
+        from ..flags import FLAGS
+
+        if FLAGS.check_numerics != "locate":
+            return
+        try:
+            import jax.numpy as jnp
+
+            from ..monitor import numerics as _mnum
+
+            if not _mnum.capture_armed():  # a replay run is in flight
+                return
+            state = {}
+            for n, v in zip(entry.rw_state, rw_vals):
+                if v is not None:
+                    # rw buffers are donated: copy now or never
+                    state[n] = jnp.array(v, copy=True)
+            for n, v in zip(entry.ro_state, ro_vals):
+                if v is not None:
+                    state[n] = v
+            _mnum.note_step_context({
+                "program": program,
+                "feed": dict(feed),
+                "fetch": list(fetch_names),
+                "state": state,
+                "run_id": rid,
+                "executor": self,
+            })
+        except Exception:  # pragma: no cover - capture must not fail a step
+            pass
 
     def _scope_signature(self, program, feed_names, scope) -> frozenset:
         """Which program-referenced names resolve to a live scope var.

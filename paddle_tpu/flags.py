@@ -101,6 +101,18 @@ FLAGS.define(
     "validate every op output for NaN/Inf and name the offending op "
     "(reference FLAGS_check_nan_inf, operator.cc:943)")
 FLAGS.define(
+    "check_numerics", str, "off",
+    "numerics observability tier (analysis/numerics.py + "
+    "monitor/numerics.py): 'off' = zero-cost (no graph change, "
+    "byte-identical fingerprint), 'summary' = instrument grads / param "
+    "updates / loss with one fused stats reduction per tensor, packed "
+    "into a single [N,4] device->host fetch per step and published as "
+    "per-param-group gauges (grad-norm, weight-norm, update-to-weight "
+    "ratio), 'locate' = per-op-output instrumentation naming the first "
+    "op in topological order with a non-finite output — the reference "
+    "FLAGS_check_nan_inf rebuilt for whole-block XLA; also enables the "
+    "watchdog's deterministic failing-step replay on a nan_loss trip")
+FLAGS.define(
     "benchmark", bool, False,
     "synchronize after every executor call for stable timing "
     "(reference FLAGS_benchmark, operator.cc:938)")
@@ -447,6 +459,13 @@ FLAGS.define(
     "chaos_nan_at_step", int, -1,
     "training loops report a NaN loss at this step (watchdog fodder); "
     "-1 disables")
+FLAGS.define(
+    "chaos_nan_var", str, "",
+    "graph-level NaN injection: at trace time the named op-output var "
+    "is poisoned with NaN (testing/chaos.poison_var, applied in "
+    "core/executor.trace_block) — unlike chaos_nan_at_step's host-side "
+    "fake loss, the NaN is real in the compiled graph, so the numerics "
+    "locate replay must find the op that wrote it; '' disables")
 FLAGS.define(
     "chaos_serve_latency_s", float, 0.0,
     "sleep injected into every serving batch execution / generation "

@@ -597,6 +597,21 @@ class CheckpointManager:
                 },
                 "extra_state": extra,
             }
+            if str(trigger).startswith(self.EMERGENCY_PREFIX):
+                # a watchdog/SIGTERM save is a postmortem artifact: carry
+                # the numerics tier's NaN-origin verdict (first op in
+                # topological order with a non-finite output) so the
+                # checkpoint alone answers "what blew up" without the
+                # flight dump.  Best-effort — the save must not fail on
+                # telemetry.
+                try:
+                    from .monitor import numerics as _numerics
+
+                    verdict = _numerics.last_locate_result()
+                    if verdict is not None:
+                        manifest["numerics"] = verdict
+                except Exception:
+                    pass
             mpath = os.path.join(tmp, MANIFEST_NAME)
 
             def _write_manifest():

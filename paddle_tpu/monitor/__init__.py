@@ -21,6 +21,11 @@ Five pieces:
     daemon thread; actions log / dump / raise.
   * `serve.py` — stdlib-http exposition: /metrics (Prometheus), /health,
     /flight (last-N events), behind FLAGS.monitor_port.
+  * `numerics.py` — the monitor half of the FLAGS_check_numerics tier:
+    per-param-group training-dynamics gauges from the in-graph stats
+    fetch (analysis/numerics.py), amp overflow accounting, and the
+    failing-step capture/replay that names the first op with a
+    non-finite output on a watchdog nan_loss trip.
   * instrumentation call-sites live in the runtime itself
     (`core/executor.py` compile/run/recompile, `data_feed.py` queue
     gauges, `inference.py` request histograms, `parallel/distributed.py`
@@ -54,5 +59,6 @@ from . import flight  # noqa: F401
 from .flight import FlightRecorder  # noqa: F401
 from .watchdog import Watchdog, WatchdogError  # noqa: F401
 from . import serve  # noqa: F401
+from . import numerics  # noqa: F401
 from . import tracing  # noqa: F401
 from .tracing import RequestTrace, TraceStore  # noqa: F401

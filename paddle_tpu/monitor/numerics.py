@@ -1,0 +1,385 @@
+"""Numerics telemetry + NaN/Inf origin localization (monitor side of the
+check_numerics tier; the graph rewrite lives in analysis/numerics.py).
+
+Three jobs:
+
+  * `publish_step_stats` — the executor hands over each step's packed
+    [N, 4] stats tensor(s); summary-level rows become per-param-group
+    gauges (`numerics.grad_norm.<group>`, `numerics.weight_norm.<group>`,
+    `numerics.update_ratio.<group>` + process-wide aggregates) and amp
+    overflow accounting (`amp.overflow.<group>` counters + flight
+    events, loss-scale update when dynamic scaling is armed).  The last
+    step's rows are kept for postmortems whatever the level.
+  * failing-step capture + replay — with FLAGS_check_numerics=locate the
+    executor snapshots each run's inputs (feed, pre-donation rw-state
+    copies, the folded-in run id) via `note_step_context`; on a watchdog
+    nan_loss trip `locate_replay` re-runs THAT step bit-identically
+    (same run id -> same step key -> same dropout masks) on a clone
+    instrumented with full per-op stats, and names the first op in
+    topological order with a non-finite output — the reference
+    FLAGS_check_nan_inf verdict, reconstructed after the fact for XLA.
+  * postmortem wiring — the locate result rides a flight header provider
+    (every dump and unified-trace export carries a "numerics" block),
+    `last_locate_result()` feeds the emergency-checkpoint manifest
+    (io.py), and tools/trace_report.py renders the "Numerics" section.
+
+Cost: nothing here runs unless the executor saw an instrumented program
+or FLAGS_check_numerics=locate armed the capture; every publish is
+exception-proof (telemetry must not fail the run).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import flight as _flight
+from . import registry as _registry
+
+# column indices of a stat row (ops/numerics_ops.py STAT_COLUMNS)
+_NONFINITE, _ABS_MAX, _ABS_MEAN, _L2 = 0, 1, 2, 3
+
+_lock = threading.Lock()
+_last_stats: Optional[dict] = None    # {"level", "rows": [merged row dicts]}
+_capture: Optional[dict] = None       # last locate-armed step context
+_last_locate: Optional[dict] = None   # last localization verdict
+_replaying = False                    # re-entrancy guard for the replay run
+
+
+def reset() -> None:
+    """Test isolation: forget captures, stats, and verdicts."""
+    global _last_stats, _capture, _last_locate, _replaying
+    with _lock:
+        _last_stats = None
+        _capture = None
+        _last_locate = None
+        _replaying = False
+
+
+# ---------------------------------------------------------------------------
+# Row plumbing
+# ---------------------------------------------------------------------------
+
+
+def _combine_axis0(arr: np.ndarray) -> np.ndarray:
+    """Collapse a stacked [K, N, 4] stats tensor (run_steps scan slices,
+    run_accumulated micro-batches) to [N, 4]: counts add, magnitudes take
+    the per-row max over the stacked axis."""
+    out = np.empty(arr.shape[1:], dtype=np.float64)
+    out[..., _NONFINITE] = arr[..., _NONFINITE].sum(axis=0)
+    for c in (_ABS_MAX, _ABS_MEAN, _L2):
+        out[..., c] = arr[..., c].max(axis=0)
+    return out
+
+
+def merged_rows(program, stats: Dict[str, Any]) -> List[dict]:
+    """Join fetched stats tensors with the program's row metadata into one
+    topologically-ordered list of row dicts (meta fields + 'stat')."""
+    meta = getattr(program, "_numerics_meta", None)
+    if meta is None:
+        return []
+    rows: List[dict] = []
+    for tensor_name, tensor_meta in meta["tensors"].items():
+        arr = stats.get(tensor_name)
+        if arr is None or not tensor_meta:
+            continue
+        arr = np.asarray(arr, dtype=np.float64)
+        while arr.ndim > 2:
+            arr = _combine_axis0(arr)
+        if arr.ndim != 2 or arr.shape[0] != len(tensor_meta):
+            continue  # shape drifted from meta: refuse to mislabel rows
+        for m, row in zip(tensor_meta, arr):
+            r = dict(m)
+            r["stat"] = {
+                "nonfinite": float(row[_NONFINITE]),
+                "abs_max": float(row[_ABS_MAX]),
+                "abs_mean": float(row[_ABS_MEAN]),
+                "l2": float(row[_L2]),
+            }
+            rows.append(r)
+    rows.sort(key=lambda r: r.get("pos", 0))
+    return rows
+
+
+def first_bad_row(rows: List[dict]) -> Optional[dict]:
+    """First row (topological order) whose tensor had non-finite elements,
+    or a NaN/Inf statistic (an Inf abs_max with a zero non-finite count
+    means the value overflowed inside the stat reduction itself)."""
+    for r in rows:
+        st = r["stat"]
+        if st["nonfinite"] > 0 or not all(
+                math.isfinite(v) for v in st.values()):
+            return r
+    return None
+
+
+def _verdict_from_row(row: dict, step=None, replayed=False) -> dict:
+    return {
+        "step": step,
+        "first_bad_op": f"{row.get('op_type', '?')}"
+                        f"@block{row.get('block', 0)}"
+                        f":op{row.get('op_index', '?')}",
+        "op_type": row.get("op_type"),
+        "op_index": row.get("op_index"),
+        "block": row.get("block", 0),
+        "in_loop": bool(row.get("in_loop")),
+        "var": row.get("var"),
+        "stat": dict(row["stat"]),
+        "replayed": bool(replayed),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Summary publication (gauges / overflow accounting)
+# ---------------------------------------------------------------------------
+
+
+def summarize(rows: List[dict]) -> dict:
+    """Aggregate summary-level rows into per-param-group training-dynamics
+    numbers (pure; hand-checked against numpy in tests)."""
+    groups: Dict[str, dict] = {}
+    glob = {"grad_norm_sq": 0.0, "nonfinite_rows": 0, "grad_nonfinite": 0.0}
+    for r in rows:
+        st = r["stat"]
+        if st["nonfinite"] > 0:
+            glob["nonfinite_rows"] += 1
+        kind = r.get("kind", "op")
+        if kind not in ("grad", "weight", "update"):
+            continue
+        g = groups.setdefault(r.get("group", "?"), {
+            "grad_norm_sq": 0.0, "weight_norm_sq": 0.0,
+            "update_norm_sq": 0.0, "grad_nonfinite": 0.0, "params": 0})
+        if kind == "grad":
+            g["grad_norm_sq"] += st["l2"] ** 2
+            g["grad_nonfinite"] += st["nonfinite"]
+            glob["grad_norm_sq"] += st["l2"] ** 2
+            glob["grad_nonfinite"] += st["nonfinite"]
+            g["params"] += 1
+        elif kind == "weight":
+            g["weight_norm_sq"] += st["l2"] ** 2
+        elif kind == "update":
+            g["update_norm_sq"] += st["l2"] ** 2
+    out = {"groups": {}, "grad_norm": math.sqrt(glob["grad_norm_sq"]),
+           "grad_nonfinite": glob["grad_nonfinite"],
+           "nonfinite_rows": glob["nonfinite_rows"]}
+    for name, g in groups.items():
+        wn = math.sqrt(g["weight_norm_sq"])
+        un = math.sqrt(g["update_norm_sq"])
+        out["groups"][name] = {
+            "grad_norm": math.sqrt(g["grad_norm_sq"]),
+            "weight_norm": wn,
+            "update_norm": un,
+            "update_ratio": (un / wn) if wn > 0 else 0.0,
+            "grad_nonfinite": g["grad_nonfinite"],
+            "params": g["params"],
+        }
+    return out
+
+
+def publish_step_stats(program, stats: Dict[str, Any]) -> None:
+    """Executor hand-off: one call per run with the fetched stats tensors
+    ({tensor_name: array}).  Never raises."""
+    global _last_stats
+    try:
+        rows = merged_rows(program, stats)
+        if not rows:
+            return
+        meta = getattr(program, "_numerics_meta", None) or {}
+        level = meta.get("level", "summary")
+        with _lock:
+            _last_stats = {"level": level, "rows": rows}
+        if level != "summary" or not _registry.enabled():
+            return
+        summ = summarize(rows)
+        gauge = _registry.default_registry().gauge
+        gauge("numerics.grad_norm").set(summ["grad_norm"])
+        gauge("numerics.nonfinite_rows").set(summ["nonfinite_rows"])
+        for gname, g in summ["groups"].items():
+            gauge(f"numerics.grad_norm.{gname}").set(g["grad_norm"])
+            gauge(f"numerics.weight_norm.{gname}").set(g["weight_norm"])
+            gauge(f"numerics.update_ratio.{gname}").set(g["update_ratio"])
+        _flight.record("numerics.summary",
+                       grad_norm=round(summ["grad_norm"], 6),
+                       grad_nonfinite=summ["grad_nonfinite"],
+                       nonfinite_rows=summ["nonfinite_rows"],
+                       groups=len(summ["groups"]))
+        _publish_overflow(program, summ, rows)
+    except Exception:  # pragma: no cover - telemetry must not fail the run
+        pass
+
+
+def _publish_overflow(program, summ: dict, rows: List[dict]) -> None:
+    """amp satellite: named overflow counters + flight events per param
+    group (inf/nan in low-precision grads was previously silently
+    absorbed), and the dynamic loss-scale update/gauge when armed."""
+    from .. import amp as _amp
+
+    scaler = _amp.active_loss_scaler()
+    if not (_amp.is_enabled(program) or scaler is not None):
+        return
+    found = False
+    for gname, g in summ["groups"].items():
+        if g["grad_nonfinite"] > 0:
+            found = True
+            _registry.default_registry().counter(
+                f"amp.overflow.{gname}").inc()
+            worst = max(
+                (r for r in rows
+                 if r.get("kind") == "grad" and r.get("group") == gname),
+                key=lambda r: r["stat"]["nonfinite"])
+            _flight.record("amp.overflow", group=gname,
+                           param=worst.get("param"),
+                           nonfinite=worst["stat"]["nonfinite"])
+    if scaler is not None:
+        scaler.update(found)
+
+
+# ---------------------------------------------------------------------------
+# Locate: failing-step capture + deterministic replay
+# ---------------------------------------------------------------------------
+
+
+def capture_armed() -> bool:
+    """Whether executors should snapshot step contexts (one flag read)."""
+    if _replaying:
+        return False
+    from ..flags import FLAGS
+
+    return FLAGS.check_numerics == "locate"
+
+
+def note_step_context(ctx: dict) -> None:
+    """Executor hand-off (locate mode): the just-dispatched step's replay
+    context — program/feed/fetch refs, PRE-donation copies of the rw
+    state, and the run id folded into the step key.  Only the latest
+    step is kept (the failing step is by definition the last one)."""
+    global _capture
+    if _replaying:
+        return
+    with _lock:
+        _capture = ctx
+
+
+def last_capture() -> Optional[dict]:
+    return _capture
+
+
+def locate_replay(step: Optional[int] = None) -> Optional[dict]:
+    """Replay the captured step on a fully-instrumented clone and name
+    the first op (topological order) with a non-finite output.  Returns
+    the verdict dict (also stored for header/manifest consumers), or
+    None without a capture."""
+    global _replaying, _last_locate
+    ctx = _capture
+    if ctx is None:
+        return None
+    from ..analysis import numerics as _anum
+    from ..core import executor as _ex
+
+    prog = ctx["program"].clone()
+    report = _anum.instrument_program(prog, "locate")
+    scope = _ex.Scope()
+    for n, v in ctx["state"].items():
+        scope.set_var(n, v)
+    exe = ctx["executor"]
+    _replaying = True
+    try:
+        exe._forced_run_id = ctx["run_id"]
+        try:
+            outs = exe.run(prog, feed=dict(ctx["feed"]),
+                           fetch_list=list(prog._numerics_stats_vars),
+                           scope=scope)
+        finally:
+            exe._forced_run_id = None
+    finally:
+        _replaying = False
+    stats = dict(zip(prog._numerics_stats_vars, outs))
+    rows = merged_rows(prog, stats)
+    bad = first_bad_row(rows)
+    if bad is None:
+        verdict = {"step": step, "first_bad_op": None, "replayed": True,
+                   "rows_checked": len(rows),
+                   "note": "replay found no non-finite op output"}
+    else:
+        verdict = _verdict_from_row(bad, step=step, replayed=True)
+        verdict["rows_checked"] = len(rows)
+    verdict["run_id"] = ctx.get("run_id")
+    verdict["instrumented_rows"] = report.get("rows")
+    with _lock:
+        _last_locate = verdict
+    if _registry.enabled():
+        _registry.default_registry().counter("numerics.locate_replays").inc()
+        _flight.record("numerics.locate", **verdict)
+    return verdict
+
+
+def handle_nan_trip(step: Optional[int] = None) -> Optional[dict]:
+    """Watchdog hook (monitor/watchdog.py _fire, kind nan_loss): produce
+    the best localization available — a bit-identical replay in locate
+    mode, or the failing step's already-fetched summary rows otherwise.
+    Exception-proof: a broken replay must not mask the trip handling."""
+    global _last_locate
+    try:
+        from ..flags import FLAGS
+
+        level = FLAGS.check_numerics
+        if level == "locate" and _capture is not None:
+            return locate_replay(step=step)
+        if _last_stats is not None:
+            bad = first_bad_row(_last_stats["rows"])
+            if bad is not None:
+                verdict = _verdict_from_row(bad, step=step, replayed=False)
+                verdict["rows_checked"] = len(_last_stats["rows"])
+                with _lock:
+                    _last_locate = verdict
+                if _registry.enabled():
+                    _flight.record("numerics.locate", **verdict)
+                return verdict
+    except Exception:  # pragma: no cover - trip handling must not raise
+        pass
+    return None
+
+
+def last_locate_result() -> Optional[dict]:
+    """The most recent localization verdict (emergency-checkpoint
+    manifests and the flight header provider read this)."""
+    return _last_locate
+
+
+def last_summary() -> Optional[dict]:
+    """Aggregates of the most recent published stats (None when nothing
+    was published)."""
+    snap = _last_stats
+    if snap is None:
+        return None
+    return summarize(snap["rows"])
+
+
+def _header_provider() -> dict:
+    """Flight header provider: every dump / unified-trace export carries
+    the localization verdict once one exists."""
+    if _last_locate is not None:
+        return {"numerics": dict(_last_locate)}
+    return {}
+
+
+_flight.add_header_provider(_header_provider)
+
+
+__all__ = [
+    "publish_step_stats",
+    "merged_rows",
+    "first_bad_row",
+    "summarize",
+    "last_summary",
+    "capture_armed",
+    "note_step_context",
+    "last_capture",
+    "locate_replay",
+    "handle_nan_trip",
+    "last_locate_result",
+    "reset",
+]

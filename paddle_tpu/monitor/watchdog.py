@@ -213,6 +213,21 @@ class Watchdog:
                        detail=trip.detail)
         if _registry.enabled():
             _registry.counter(f"watchdog.trips.{trip.kind}").inc()
+        verdict = None
+        if trip.kind == "nan_loss":
+            # numerics tier: localize the NaN's origin before dumping —
+            # in locate mode this replays the failing step bit-identically
+            # under full per-op instrumentation and names the first op in
+            # topological order with a non-finite output; in summary mode
+            # it falls back to the step's already-fetched stat rows.
+            # Exception-proof and lazily imported: a broken replay must
+            # not swallow the trip, and the off path stays import-free.
+            try:
+                from . import numerics as _numerics
+
+                verdict = _numerics.handle_nan_trip(step=trip.step)
+            except Exception:
+                verdict = None
         if self.on_trip is not None:
             self.on_trip(trip)
             return
@@ -222,9 +237,11 @@ class Watchdog:
             self._warned_kinds.add(trip.kind)
             warning("%s", trip)
         if self.action in ("dump", "raise"):
-            _flight.dump(trigger="watchdog",
-                         extra={"trip": trip.kind, "trip_step": trip.step,
-                                "trip_detail": trip.detail})
+            extra = {"trip": trip.kind, "trip_step": trip.step,
+                     "trip_detail": trip.detail}
+            if verdict is not None:
+                extra["numerics"] = verdict
+            _flight.dump(trigger="watchdog", extra=extra)
         if self.action == "raise":
             if from_hang_thread:
                 # can't raise into the training thread from here; the

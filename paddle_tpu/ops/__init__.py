@@ -20,4 +20,5 @@ from . import (  # noqa: F401
     rcnn_ops,
     generation_ops,
     memory_ops,
+    numerics_ops,
 )

@@ -1,0 +1,122 @@
+"""Numerics-observability ops: the fused per-tensor health reduction and
+its packing op (paddle_tpu/analysis/numerics.py instrumentation pass —
+the reference's FLAGS_check_nan_inf per-op output walk, operator.cc:943,
+rebuilt for whole-block XLA where ops never individually return to the
+host).
+
+  * `numerics_stat` — ONE fused reduction over a tensor producing the
+    [4] f32 health row `[nonfinite_count, abs_max, abs_mean, l2]`.
+    Non-finite elements are masked out of the magnitude stats so a
+    single Inf doesn't saturate abs_max into uselessness; everything
+    accumulates in f32 regardless of the input dtype (bf16/f16 grads
+    included).  Optional `Ref` input switches to delta stats over
+    `X - Ref` (update magnitude: `ParamOut - Param` gives the
+    update-to-weight numerator without a separate subtract op in the
+    user graph).  Optional `Acc` input combines with a previous row
+    (`[add, max, max, max]`) — the while-sub-block accumulator idiom:
+    the loop carries one [4] row per instrumented inner op, so inner
+    tensors are observed without any per-iteration host traffic.
+  * `numerics_pack` — stacks N such rows into the single [N, 4] stats
+    tensor the executor fetches alongside the user's fetches: one
+    device->host transfer per step, not N.
+  * `numerics_zeros` — the [4] zero row that seeds a while accumulator
+    in the outer block (so the verifier's def-before-use pass sees the
+    carry defined before the loop).
+
+All three are no_grad, derive no RNG, and infer static shapes even when
+input shapes are unknown — the instrumented program must stay green
+through the full verifier (analysis/verifier.py) and graph_lint.
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register
+
+# the stat-row layout; monitor/numerics.py indexes columns by this
+STAT_WIDTH = 4
+STAT_COLUMNS = ("nonfinite", "abs_max", "abs_mean", "l2")
+
+
+def _stat_infer(ctx):
+    ctx.set_output("Out", (STAT_WIDTH,), "float32")
+
+
+def _pack_infer(ctx):
+    ctx.set_output("Out", (int(ctx.attr("n")), STAT_WIDTH), "float32")
+
+
+def _zeros_infer(ctx):
+    ctx.set_output("Out", (STAT_WIDTH,), "float32")
+
+
+def _stat_row(x, ref=None):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x).astype(jnp.float32)
+    if ref is not None:
+        x = x - jnp.asarray(ref).astype(jnp.float32)
+    finite = jnp.isfinite(x)
+    nonfinite = jnp.sum(~finite).astype(jnp.float32)
+    ax = jnp.abs(jnp.where(finite, x, jnp.float32(0)))
+    n = max(int(x.size), 1)
+    if x.size:
+        abs_max = jnp.max(ax)
+    else:
+        abs_max = jnp.float32(0)
+    abs_sum = jnp.sum(ax)
+    abs_mean = abs_sum / jnp.float32(n)
+    l2 = jnp.sqrt(jnp.sum(ax * ax))
+    return jnp.stack([nonfinite, abs_max, abs_mean, l2])
+
+
+@register("numerics_stat", infer_shape=_stat_infer, no_grad=True,
+          doc="fused [nonfinite_count, abs_max, abs_mean, l2] health row "
+              "over one tensor (finite-masked, f32 accumulation); Ref "
+              "switches to delta stats over X-Ref, Acc combines with a "
+              "loop-carried previous row via [add, max, max, max] "
+              "(analysis/numerics.py)")
+def lower_numerics_stat(ctx, ins):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ref = (ins.get("Ref") or [None])[0]
+    acc = (ins.get("Acc") or [None])[0]
+    if x is None:
+        # declared-but-unwritten producer output (optional slot): an
+        # all-zero row rather than a trace crash — telemetry must not
+        # be able to fail the run
+        row = jnp.zeros((4,), jnp.float32)
+    else:
+        row = _stat_row(x, ref)
+    if acc is not None:
+        acc = jnp.asarray(acc).astype(jnp.float32)
+        row = jnp.stack([
+            acc[0] + row[0],
+            jnp.maximum(acc[1], row[1]),
+            jnp.maximum(acc[2], row[2]),
+            jnp.maximum(acc[3], row[3]),
+        ])
+    return {"Out": [row]}
+
+
+@register("numerics_pack", infer_shape=_pack_infer, no_grad=True,
+          doc="stack N [4] health rows into the single [N, 4] stats "
+              "tensor fetched once per step (attr n = row count)")
+def lower_numerics_pack(ctx, ins):
+    import jax.numpy as jnp
+
+    rows = [jnp.asarray(v).astype(jnp.float32) for v in ins["X"]]
+    return {"Out": [jnp.stack(rows, axis=0)]}
+
+
+@register("numerics_zeros", infer_shape=_zeros_infer, no_grad=True,
+          doc="the [4] f32 zero row seeding a while-loop stats "
+              "accumulator in the outer block")
+def lower_numerics_zeros(ctx, ins):
+    import jax.numpy as jnp
+
+    return {"Out": [jnp.zeros((STAT_WIDTH,), jnp.float32)]}
+
+
+__all__ = ["STAT_WIDTH", "STAT_COLUMNS", "lower_numerics_stat",
+           "lower_numerics_pack", "lower_numerics_zeros"]

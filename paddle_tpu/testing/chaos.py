@@ -242,3 +242,28 @@ def nan_loss(step: int, loss):
         _count("nan_loss")
         return float("nan")
     return loss
+
+
+def poison_outputs(op, env) -> None:
+    """Graph-level NaN injection: trace_block calls this after writing
+    each op's outputs; when FLAGS.chaos_nan_var names one of them, its
+    traced value is replaced with all-NaN IN the compiled graph (inexact
+    dtypes only — integer outputs have no NaN).  Unlike nan_loss's
+    host-side substitute, the poison propagates through downstream ops
+    exactly like a real numerical blow-up, so the numerics tier's locate
+    replay (monitor/numerics.py) must find THIS op as the origin.
+    Trace-time only; one flag read per op while chaos is armed."""
+    target = FLAGS.chaos_nan_var
+    if not target:
+        return
+    for name in op.output_arg_names():
+        if name != target:
+            continue
+        v = env.get(name)
+        if v is None:
+            continue
+        import jax.numpy as jnp
+
+        if jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact):
+            env[name] = jnp.full_like(v, jnp.nan)
+            _count("nan_var")

@@ -1,0 +1,588 @@
+"""Numerics observability tier (FLAGS_check_numerics): the in-graph
+tensor-health instrumentation pass (analysis/numerics.py +
+ops/numerics_ops.py), the monitor-side gauges/locate machinery
+(monitor/numerics.py), and the wiring into executor, watchdog, flight,
+amp, and chaos.
+
+Red gates: a chaos-injected NaN at a KNOWN op (mid-network, inside a
+while sub-block, in a grad op) must be named — exactly that op — by the
+locate replay.  Zero-cost-off is asserted byte-for-byte (fingerprint
+identity, one flag read, no registry entries).  Summary gauges are
+hand-checked against numpy grads fetched from an uninstrumented twin.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, monitor
+from paddle_tpu.analysis import numerics as anum
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.monitor import flight
+from paddle_tpu.monitor import numerics as mnum
+from paddle_tpu.monitor.watchdog import Watchdog
+from paddle_tpu.testing import chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_numerics():
+    """Flags / registry / flight / chaos / numerics state isolation."""
+    from paddle_tpu import amp
+
+    FLAGS.reset()
+    monitor.default_registry().reset()
+    flight.default_recorder().clear()
+    chaos.reset()
+    mnum.reset()
+    amp.set_loss_scaler(None)
+    yield
+    FLAGS.reset()
+    monitor.default_registry().reset()
+    flight.default_recorder().clear()
+    chaos.reset()
+    mnum.reset()
+    amp.set_loss_scaler(None)
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+
+def _mlp(act="relu", lr=0.01, dropout=0.0):
+    """Tiny train net on the default programs; returns the loss var."""
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=16, act=act,
+                  param_attr=pt.ParamAttr(name="w1"),
+                  bias_attr=pt.ParamAttr(name="b1"))
+    if dropout:
+        h = layers.dropout(h, dropout_prob=dropout,
+                           dropout_implementation="upscale_in_train")
+    pred = layers.fc(h, size=1, param_attr=pt.ParamAttr(name="w2"),
+                     bias_attr=pt.ParamAttr(name="b2"))
+    loss = layers.mean(layers.square(pred - y))
+    pt.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return loss
+
+
+def _feed(bs=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.randn(bs, 8).astype("float32"),
+            "y": rng.randn(bs, 1).astype("float32")}
+
+
+def _op_output(prog, op_type, which=0):
+    """Name of the `which`-th output var of the first `op_type` op."""
+    hits = [op for op in prog.global_block().ops if op.type == op_type]
+    assert hits, f"no {op_type!r} op in program"
+    return hits[0].output_arg_names()[which]
+
+
+def _run_locate_replay(loss, target_var, feed=None):
+    """Arm chaos poison on `target_var` + locate capture, run one step,
+    and return the replay verdict."""
+    FLAGS.monitor = True
+    FLAGS.chaos = True
+    FLAGS.chaos_nan_var = target_var
+    FLAGS.check_numerics = "locate"
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    exe.run(feed=feed or _feed(), fetch_list=[loss])
+    assert mnum.last_capture() is not None
+    verdict = mnum.locate_replay(step=1)
+    assert verdict is not None
+    return verdict
+
+
+# ---------------------------------------------------------------------------
+# zero-cost off mode
+# ---------------------------------------------------------------------------
+
+
+class TestOffMode:
+    def test_off_is_zero_cost_and_one_flag_read(self, monkeypatch):
+        loss = _mlp()
+        prog = pt.default_main_program()
+        fp0 = prog.fingerprint()
+
+        reads = []
+        orig = type(FLAGS).__getattr__
+
+        def spy(self, name):
+            if name == "check_numerics":
+                reads.append(name)
+            return orig(self, name)
+
+        monkeypatch.setattr(type(FLAGS), "__getattr__", spy)
+        assert anum.maybe_instrument(prog) is None
+        monkeypatch.setattr(type(FLAGS), "__getattr__", orig)
+
+        assert reads == ["check_numerics"]  # exactly ONE flag read
+        assert prog.fingerprint() == fp0    # byte-identical graph
+        assert not anum.is_instrumented(prog)
+
+        # a run publishes nothing and fetches only what was asked
+        FLAGS.monitor = True
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        outs = exe.run(feed=_feed(), fetch_list=[loss])
+        assert len(outs) == 1
+        assert mnum.last_summary() is None
+        assert mnum.last_capture() is None
+        numerics_metrics = [n for n in monitor.default_registry().names()
+                            if n.startswith("numerics")]
+        assert numerics_metrics == []
+
+    def test_locate_mode_defers_graph_rewrite(self):
+        _mlp()
+        prog = pt.default_main_program()
+        fp0 = prog.fingerprint()
+        rep = anum.maybe_instrument(prog, level="locate")
+        assert rep == {"level": "locate", "rows": 0, "deferred": True}
+        assert prog.fingerprint() == fp0  # steady-state graph unchanged
+
+    def test_bad_level_and_double_instrument_raise(self):
+        _mlp()
+        prog = pt.default_main_program()
+        with pytest.raises(ValueError, match="check_numerics level"):
+            anum.instrument_program(prog, "verbose")
+        anum.instrument_program(prog, "summary")
+        with pytest.raises(ValueError, match="already"):
+            anum.instrument_program(prog, "summary")
+
+
+# ---------------------------------------------------------------------------
+# the fused stat op (vs numpy)
+# ---------------------------------------------------------------------------
+
+
+class TestStatRows:
+    def test_stat_row_matches_numpy(self):
+        """Instrument a one-op program in locate mode and hand-check the
+        fetched row (nonfinite count, finite-masked abs stats) vs numpy."""
+        x = layers.data(name="x", shape=[6], dtype="float32")
+        out = layers.scale(x, scale=2.0)
+        prog = pt.default_main_program()
+        anum.instrument_program(prog, "locate")
+
+        xv = np.array([[1.0, -3.0, np.nan, np.inf, 0.5, -np.inf]],
+                      dtype="float32")
+        exe = pt.Executor(pt.CPUPlace())
+        FLAGS.monitor = True
+        outs = exe.run(feed={"x": xv}, fetch_list=[out])
+        assert len(outs) == 1  # stats stripped from user results
+
+        snap = mnum._last_stats
+        assert snap is not None and snap["level"] == "locate"
+        by_var = {r["var"]: r["stat"] for r in snap["rows"]}
+        st = by_var[out.name]
+        ref = 2.0 * xv.astype(np.float64)
+        finite = np.isfinite(ref)
+        ax = np.abs(np.where(finite, ref, 0.0))
+        assert st["nonfinite"] == float((~finite).sum())
+        np.testing.assert_allclose(st["abs_max"], ax.max(), rtol=1e-6)
+        np.testing.assert_allclose(st["abs_mean"], ax.mean(), rtol=1e-6)
+        np.testing.assert_allclose(st["l2"],
+                                   math.sqrt((ax * ax).sum()), rtol=1e-6)
+
+    def test_single_extra_fetch_per_step(self):
+        """The packing contract: locate mode adds exactly the packed
+        stats tensor(s) to the fetch, not one fetch per op."""
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        h = layers.scale(x, scale=2.0)
+        out = layers.scale(h, scale=0.5)
+        prog = pt.default_main_program()
+        rep = anum.instrument_program(prog, "locate")
+        assert rep["rows"] >= 2
+        assert prog._numerics_stats_vars == [anum.STATS_VAR]
+        exe = pt.Executor(pt.CPUPlace())
+        user_fetch = [out.name]
+        n, full = exe._numerics_fetch(prog, user_fetch)
+        assert n == 1 and full == [out.name, anum.STATS_VAR]
+
+
+# ---------------------------------------------------------------------------
+# summary mode: gauges hand-checked vs numpy
+# ---------------------------------------------------------------------------
+
+
+class TestSummaryGauges:
+    def test_gauges_match_numpy_grads(self):
+        lr = 0.05
+        loss = _mlp(act="tanh", lr=lr)
+        prog = pt.default_main_program()
+        twin = prog.clone()  # uninstrumented twin for the numpy reference
+        anum.instrument_program(prog, "summary")
+
+        exe = pt.Executor(pt.CPUPlace())
+        scope_a, scope_b = pt.Scope(), pt.Scope()
+        exe.run(pt.default_startup_program(), scope=scope_a)
+        exe.run(pt.default_startup_program(), scope=scope_b)
+        init = {n: np.asarray(scope_a.find_var(n)).copy()
+                for n in ("w1", "b1", "w2", "b2")}
+        for n, v in init.items():
+            scope_b.set_var(n, v)
+
+        feed = _feed(seed=3)
+        grads = exe.run(twin, feed=feed, scope=scope_a,
+                        fetch_list=[f"{n}@GRAD"
+                                    for n in ("w1", "b1", "w2", "b2")])
+        g = {n: np.asarray(v, dtype=np.float64)
+             for n, v in zip(("w1", "b1", "w2", "b2"), grads)}
+        post = {n: init[n].astype(np.float64) - lr * g[n] for n in g}
+
+        FLAGS.monitor = True
+        exe.run(prog, feed=feed, scope=scope_b, fetch_list=[loss])
+        summ = mnum.last_summary()
+        assert summ is not None and summ["grad_nonfinite"] == 0
+
+        expect_gn = math.sqrt(sum((gv ** 2).sum() for gv in g.values()))
+        np.testing.assert_allclose(summ["grad_norm"], expect_gn, rtol=1e-4)
+        reg = monitor.default_registry()
+        np.testing.assert_allclose(reg.get("numerics.grad_norm").value,
+                                   expect_gn, rtol=1e-4)
+        for grp in ("w1", "b1", "w2", "b2"):
+            gg = summ["groups"][grp]
+            wn = math.sqrt((post[grp] ** 2).sum())
+            un = lr * math.sqrt((g[grp] ** 2).sum())
+            np.testing.assert_allclose(gg["weight_norm"], wn, rtol=1e-4)
+            np.testing.assert_allclose(gg["update_norm"], un, rtol=1e-4)
+            np.testing.assert_allclose(gg["update_ratio"], un / wn,
+                                       rtol=1e-4)
+            np.testing.assert_allclose(
+                reg.get(f"numerics.update_ratio.{grp}").value, un / wn,
+                rtol=1e-4)
+        # flight carries the per-step summary event
+        evs = flight.default_recorder().events(kind="numerics.summary")
+        assert evs and evs[-1]["grad_nonfinite"] == 0
+
+    def test_instrumented_program_verifies_clean(self):
+        from paddle_tpu.analysis import verify_program
+
+        loss = _mlp()
+        prog = pt.default_main_program()
+        anum.instrument_program(prog, "summary")
+        findings = verify_program(
+            prog, feed_names=["x", "y"],
+            fetch_names=[loss.name] + list(prog._numerics_stats_vars),
+            check_dead=True)
+        assert findings == [], [str(f) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# locate red gates: the injected op must be named, exactly
+# ---------------------------------------------------------------------------
+
+
+class TestLocateRedGates:
+    def test_names_mid_network_op(self):
+        loss = _mlp(act="relu")
+        target = _op_output(pt.default_main_program(), "relu")
+        v = _run_locate_replay(loss, target)
+        assert v["var"] == target
+        assert v["op_type"] == "relu"
+        assert v["replayed"] is True
+        assert v["stat"]["nonfinite"] > 0
+        assert v["first_bad_op"].startswith("relu@block0:")
+        assert mnum.last_locate_result() == v
+
+    def test_names_grad_op(self):
+        loss = _mlp(act="tanh")
+        prog = pt.default_main_program()
+        target = _op_output(prog, "square_grad")
+        v = _run_locate_replay(loss, target)
+        assert v["var"] == target
+        assert v["op_type"] == "square_grad"
+        assert v["replayed"] is True
+
+    def test_names_op_inside_while_block(self):
+        i = layers.fill_constant([1], "float32", 0.0)
+        total = layers.fill_constant([1], "float32", 0.0)
+        limit = layers.fill_constant([1], "float32", 10.0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            new_total = layers.elementwise_add(total, i)
+            layers.assign(new_total, output=total)
+            new_i = layers.scale(i, scale=1.0, bias=1.0)
+            layers.assign(new_i, output=i)
+            layers.less_than(i, limit, cond=cond)
+
+        FLAGS.monitor = True
+        FLAGS.chaos = True
+        FLAGS.chaos_nan_var = new_total.name
+        FLAGS.check_numerics = "locate"
+        exe = pt.Executor(pt.CPUPlace())
+        (t,) = exe.run(fetch_list=[total])
+        assert not np.isfinite(t).all()
+        v = mnum.locate_replay(step=1)
+        assert v is not None
+        assert v["var"] == new_total.name
+        assert v["op_type"] == "elementwise_add"
+        assert v["in_loop"] is True
+        assert v["block"] > 0  # named inside the sub-block, not the while
+
+    def test_clean_replay_names_nothing(self):
+        loss = _mlp()
+        FLAGS.check_numerics = "locate"
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        exe.run(feed=_feed(), fetch_list=[loss])
+        v = mnum.locate_replay(step=1)
+        assert v is not None and v["first_bad_op"] is None
+        assert v["rows_checked"] > 10
+
+    def test_forced_run_id_replays_dropout_bitwise(self):
+        """The determinism contract under the replay: forcing the failing
+        step's run id reproduces the SAME dropout masks, so the replayed
+        loss is bit-identical; an unforced re-run draws fresh masks."""
+        # forward-only net (no optimizer): scope state is identical across
+        # runs, so any loss difference is purely the dropout mask
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        h = layers.fc(x, size=16, act="relu",
+                      param_attr=pt.ParamAttr(name="w1"),
+                      bias_attr=pt.ParamAttr(name="b1"))
+        h = layers.dropout(h, dropout_prob=0.5,
+                           dropout_implementation="upscale_in_train")
+        loss = layers.mean(h)
+        FLAGS.check_numerics = "locate"
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        feed = _feed(bs=16)
+        (l1,) = exe.run(feed=feed, fetch_list=[loss])
+        ctx = mnum.last_capture()
+        assert ctx is not None
+        exe._forced_run_id = ctx["run_id"]
+        (l2,) = exe.run(feed=feed, fetch_list=[loss])
+        assert np.asarray(l1).tobytes() == np.asarray(l2).tobytes()
+        (l3,) = exe.run(feed=feed, fetch_list=[loss])  # fresh masks
+        assert np.asarray(l3).tobytes() != np.asarray(l1).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# watchdog end-to-end: trip -> replay -> flight dump names the op
+# ---------------------------------------------------------------------------
+
+
+class TestWatchdogEndToEnd:
+    def test_nan_trip_dump_names_injected_op(self, tmp_path):
+        FLAGS.monitor = True
+        FLAGS.flight_dir = str(tmp_path)
+        loss = _mlp(act="relu")
+        target = _op_output(pt.default_main_program(), "relu")
+        FLAGS.chaos = True
+        FLAGS.chaos_nan_var = target
+        FLAGS.check_numerics = "locate"
+
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        wd = Watchdog(action="dump")
+        mon = monitor.StepMonitor(name="numerics_e2e", watchdog=wd)
+        mon.step()  # arm the timer
+        (lv,) = exe.run(feed=_feed(), fetch_list=[loss])
+        mon.step(loss=float(np.asarray(lv).ravel()[0]))
+        mon.close()
+
+        assert [t.kind for t in wd.trips] == ["nan_loss"]
+        dumps = sorted(tmp_path.glob("flight-*-watchdog.jsonl"))
+        assert len(dumps) == 1
+        hdr = json.loads(open(dumps[0]).readline())
+        assert hdr["trip"] == "nan_loss"
+        num = hdr["numerics"]
+        assert num["var"] == target
+        assert num["op_type"] == "relu"
+        assert num["replayed"] is True
+        assert num["stat"]["nonfinite"] > 0
+        # the injected fault is accounted by the chaos harness
+        assert chaos.injected_counts().get("nan_var", 0) > 0
+
+    def test_summary_fallback_names_first_bad_row(self):
+        """Without locate armed, the trip handler falls back to the
+        already-fetched summary rows of the failing step."""
+        FLAGS.monitor = True
+        loss = _mlp(act="relu", lr=1.0)
+        prog = pt.default_main_program()
+        target = _op_output(prog, "relu")
+        FLAGS.chaos = True
+        FLAGS.chaos_nan_var = target
+        FLAGS.check_numerics = "summary"
+        anum.instrument_program(prog, "summary")
+
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        exe.run(feed=_feed(), fetch_list=[loss])
+        v = mnum.handle_nan_trip(step=1)
+        assert v is not None and v["replayed"] is False
+        assert v["stat"]["nonfinite"] > 0
+        # grad rows downstream of the poisoned relu are non-finite
+        assert mnum.last_summary()["grad_nonfinite"] > 0
+
+
+# ---------------------------------------------------------------------------
+# composition: recompute, run_accumulated, run_steps, pipeline stages
+# ---------------------------------------------------------------------------
+
+
+class TestComposition:
+    def test_compose_with_recompute(self):
+        from paddle_tpu import memory
+
+        loss = _mlp(act="tanh")
+        prog = pt.default_main_program()
+        twin = prog.clone()
+        memory.apply_recompute(prog, ["x", "y"], fetch_names=[loss.name],
+                               batch_size=8)
+        anum.instrument_program(prog, "summary")
+
+        exe = pt.Executor(pt.CPUPlace())
+        scope_a, scope_b = pt.Scope(), pt.Scope()
+        exe.run(pt.default_startup_program(), scope=scope_a)
+        exe.run(pt.default_startup_program(), scope=scope_b)
+        for n in ("w1", "b1", "w2", "b2"):
+            scope_b.set_var(n, np.asarray(scope_a.find_var(n)).copy())
+        feed = _feed(bs=8)
+        FLAGS.monitor = True
+        (la,) = exe.run(twin, feed=feed, scope=scope_a, fetch_list=[loss])
+        (lb,) = exe.run(prog, feed=feed, scope=scope_b, fetch_list=[loss])
+        np.testing.assert_allclose(la, lb, rtol=1e-6)  # math untouched
+        assert mnum.last_summary() is not None
+
+    def test_run_accumulated_splits_stats_by_role(self):
+        loss = _mlp(act="tanh")
+        prog = pt.default_main_program()
+        anum.instrument_program(prog, "summary")
+        # grad rows ride the non-Optimize prefix; update/weight rows ride
+        # the Optimize suffix — both packs must exist for the role split
+        assert prog._numerics_stats_vars == [anum.STATS_VAR,
+                                             anum.STATS_OPT_VAR]
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        FLAGS.monitor = True
+        k, bs = 3, 4
+        rng = np.random.RandomState(7)
+        feed = {"x": rng.randn(k, bs, 8).astype("float32"),
+                "y": rng.randn(k, bs, 1).astype("float32")}
+        outs = exe.run_accumulated(prog, feed=feed, fetch_list=[loss])
+        assert len(outs) == 1  # stats stripped
+        assert outs[0].shape[0] == k  # prefix fetch: one slice per micro
+        summ = mnum.last_summary()
+        assert summ is not None
+        assert set(summ["groups"]) == {"w1", "b1", "w2", "b2"}
+
+    def test_run_steps_combines_stacked_stats(self):
+        loss = _mlp(act="tanh")
+        prog = pt.default_main_program()
+        anum.instrument_program(prog, "summary")
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        FLAGS.monitor = True
+        steps, bs = 3, 4
+        rng = np.random.RandomState(11)
+        feed = {"x": rng.randn(steps, bs, 8).astype("float32"),
+                "y": rng.randn(steps, bs, 1).astype("float32")}
+        outs = exe.run_steps(prog, feed=feed, fetch_list=[loss])
+        assert len(outs) == 1 and outs[0].shape[0] == steps
+        summ = mnum.last_summary()
+        assert summ is not None and summ["grad_nonfinite"] == 0
+        assert summ["grad_norm"] > 0
+
+    def test_pipeline_stage_programs_instrument_clean(self):
+        from paddle_tpu.analysis import verify_program
+        from paddle_tpu.parallel.pipeline import split_program
+
+        _mlp(act="tanh", lr=0.1)
+        prog = pt.default_main_program()
+        stages = split_program(prog, ["x", "y"], n_stages=2)
+        for st in stages:
+            rep = anum.instrument_program(st.program, "locate")
+            assert rep["rows"] > 0
+            feeds = (st.feeds + [n for n, _, _ in st.fwd_inputs]
+                     + [n for n, _, _ in st.bwd_inputs] + st.bwd_feeds)
+            fetch = ([n for n, _, _ in st.fwd_outputs]
+                     + [n for n, _, _ in st.bwd_outputs]
+                     + list(st.program._numerics_stats_vars))
+            findings = verify_program(st.program, feed_names=feeds,
+                                      fetch_names=fetch, check_dead=True)
+            assert findings == [], (st.index, [str(f) for f in findings])
+
+
+# ---------------------------------------------------------------------------
+# amp: loss scaler + overflow accounting
+# ---------------------------------------------------------------------------
+
+
+class TestAmpOverflow:
+    def test_loss_scaler_policy(self):
+        from paddle_tpu import amp
+
+        s = amp.LossScaler(init_scale=1024.0, growth_factor=2.0,
+                           backoff_factor=0.5, growth_interval=3)
+        assert s.update(False) == 1024.0
+        assert s.update(False) == 1024.0
+        assert s.update(False) == 2048.0  # grew after 3 good steps
+        assert s.update(True) == 1024.0   # halved on overflow
+        assert s.good_steps == 0 and s.overflow_steps == 1
+        s2 = amp.LossScaler(init_scale=2.0, backoff_factor=0.5,
+                            min_scale=1.0)
+        s2.update(True)
+        assert s2.update(True) == 1.0  # clamped at min_scale
+
+    def test_overflow_counter_and_scale_backoff(self):
+        from paddle_tpu import amp
+
+        FLAGS.monitor = True
+        loss = _mlp(act="relu")
+        prog = pt.default_main_program()
+        target = _op_output(prog, "relu")
+        FLAGS.chaos = True
+        FLAGS.chaos_nan_var = target
+        anum.instrument_program(prog, "summary")
+        scaler = amp.LossScaler(init_scale=1024.0, backoff_factor=0.5)
+        amp.set_loss_scaler(scaler)
+
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        exe.run(feed=_feed(), fetch_list=[loss])
+
+        reg = monitor.default_registry()
+        over = [n for n in reg.names() if n.startswith("amp.overflow.")]
+        assert over, "no per-group overflow counter"
+        assert scaler.scale == 512.0  # backoff applied this step
+        assert reg.get("amp.loss_scale").value == 512.0
+        evs = flight.default_recorder().events(kind="amp.overflow")
+        assert evs and evs[-1]["nonfinite"] > 0
+
+
+# ---------------------------------------------------------------------------
+# trace_report surfaces the verdict
+# ---------------------------------------------------------------------------
+
+
+def test_trace_report_numerics_section():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import trace_report
+
+    doc = {"traceEvents": [], "flight": {
+        "header": {"numerics": {
+            "step": 6, "first_bad_op": "relu@block0:op2",
+            "op_type": "relu", "var": "fc_0.tmp_2", "replayed": True,
+            "stat": {"nonfinite": 64.0, "abs_max": 0.0,
+                     "abs_mean": 0.0, "l2": 0.0}}},
+        "events": [{"kind": "numerics.summary", "grad_norm": 3.5,
+                    "grad_nonfinite": 0, "nonfinite_rows": 0,
+                    "groups": 4}],
+    }}
+    text = trace_report.report(doc, 5)
+    assert "Numerics" in text
+    assert "relu@block0:op2" in text
+    assert "fc_0.tmp_2" in text
+    assert "grad_norm=3.5" in text

@@ -29,6 +29,10 @@ PUBLIC_API = [
     # (memory/recompute.py, memory/offload.py — apply_recompute and
     # apply_offload are the public way to reach them)
     "memory",
+    # the numerics tier's instrumentation pass emits numerics_stat/
+    # numerics_pack/numerics_zeros (analysis/numerics.py —
+    # instrument_program / maybe_instrument are the public way)
+    "analysis/numerics.py",
 ]
 
 # Ops a user never spells: emitted by the executor/backward/compiler

@@ -331,6 +331,59 @@ def build_memory():
     return out
 
 
+def build_numerics():
+    """The numerics observability tier's gate (analysis/numerics.py +
+    monitor/numerics.py): instrumented transformer-base (base widths,
+    short seq — the pipeline-builder convention for CI wall time) goes
+    through the FULL verifier in BOTH levels — `summary` (grad/weight/
+    update rows + the Optimize-role stats split) and `locate` (a stat
+    row per op output, While sub-block included) — and must emit
+    verifier-clean IR with the packed stats tensors in the fetch set.
+
+    Also asserts the structural contract cheap enough to check here:
+    flag-off zero-cost (maybe_instrument with FLAGS_check_numerics unset
+    returns None and leaves the fingerprint byte-identical)."""
+    import paddle_tpu as pt
+    from paddle_tpu.analysis import numerics as anum
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.models import transformer as T
+
+    def _build():
+        prog, startup, guard = _fresh()
+        with guard, pt.program_guard(prog, startup):
+            avg_cost, _, feeds = T.transformer(
+                src_vocab_size=2048, trg_vocab_size=2048, max_length=64,
+                n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+                d_inner_hid=2048, dropout_rate=0.1, src_seq_len=64,
+                trg_seq_len=64, use_flash=False)
+            pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+        return prog, startup, avg_cost, feeds
+
+    out = []
+    prog, startup, avg_cost, feeds = _build()
+    findings = []
+    fp0 = prog.fingerprint()
+    level0 = FLAGS.check_numerics
+    if anum.maybe_instrument(prog) is not None \
+            or prog.fingerprint() != fp0 or FLAGS.check_numerics != level0:
+        findings.append({
+            "check": "numerics-zero-cost", "severity": "error",
+            "message": "maybe_instrument touched the program with "
+                       "FLAGS_check_numerics unset — the flag-off "
+                       "byte-identity contract is broken"})
+    rep = anum.instrument_program(prog, "summary")
+    out.append({"name": "numerics/zero-cost-contract",
+                "summary_rows": rep["rows"], "findings": findings})
+    out.append(("numerics/transformer-base-summary", prog, list(feeds),
+                [avg_cost.name] + list(prog._numerics_stats_vars), startup))
+
+    prog, startup, avg_cost, feeds = _build()
+    anum.instrument_program(prog, "locate")
+    out.append(("numerics/transformer-base-locate", prog, list(feeds),
+                [avg_cost.name] + list(prog._numerics_stats_vars), None))
+    return out
+
+
 # one build per process for the entries two gates share (verify + the
 # memory planner); pipeline/generation/serving stay un-memoized — they
 # are built exactly once per run anyway
@@ -352,6 +405,7 @@ BUILDERS = {
     "generation": build_generation,
     "pipeline": build_pipeline,
     "memory": build_memory,
+    "numerics": build_numerics,
 }
 
 

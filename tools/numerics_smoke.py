@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""numerics_smoke: the CI red-gate for the numerics observability tier.
+
+End-to-end proof that FLAGS_check_numerics=locate can NAME a NaN's
+origin op: the chaos harness poisons one known op output in the
+compiled graph (FLAGS_chaos_nan_var — the fault is real, downstream
+math consumes the NaNs), the watchdog trips on the NaN loss, the
+monitor replays the captured failing step under full per-op
+instrumentation with the SAME run id (bit-identical RNG), and the
+flight dump's header must name exactly the poisoned op.
+
+Artifacts (under --out-dir, default ci_artifacts/numerics):
+  flight/flight-*-watchdog.jsonl — the dump a dead run would leave
+  numerics_smoke.json            — the verdict + assertions summary
+
+Exit 0 only when the verdict names the injected op, with replayed=True.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out-dir", default="ci_artifacts/numerics")
+    args = ap.parse_args(argv)
+
+    flight_dir = os.path.join(args.out_dir, "flight")
+    os.makedirs(flight_dir, exist_ok=True)
+    for stale in glob.glob(os.path.join(flight_dir, "flight-*.jsonl")):
+        os.remove(stale)
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, monitor
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.monitor import numerics as mnum
+    from paddle_tpu.monitor.watchdog import Watchdog
+
+    FLAGS.monitor = True
+    FLAGS.flight_dir = flight_dir
+    FLAGS.check_numerics = "locate"
+
+    x = layers.data(name="x", shape=[16], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=32, act="relu")
+    h = layers.fc(h, size=32, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square(pred - y))
+    pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    prog = pt.default_main_program()
+
+    # poison the SECOND relu: mid-network, with healthy ops both before
+    # (must stay un-named) and after (their NaNs are downstream symptoms)
+    relus = [op for op in prog.global_block().ops if op.type == "relu"]
+    target = relus[1].output_arg_names()[0]
+    FLAGS.chaos = True
+    FLAGS.chaos_nan_var = target
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    wd = Watchdog(action="dump")
+    mon = monitor.StepMonitor(name="numerics_smoke", watchdog=wd)
+    mon.step()  # arm
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 16).astype("float32"),
+            "y": rng.randn(8, 1).astype("float32")}
+    (lv,) = exe.run(feed=feed, fetch_list=[loss])
+    mon.step(loss=float(np.asarray(lv).ravel()[0]))
+    mon.close()
+
+    checks = []
+
+    def check(name, ok, detail=""):
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+        print(f"numerics_smoke: {name:<38} {'OK' if ok else 'FAIL'}"
+              f"{'  ' + detail if detail else ''}")
+        return ok
+
+    ok = check("watchdog-tripped-nan_loss",
+               [t.kind for t in wd.trips] == ["nan_loss"],
+               f"trips={[t.kind for t in wd.trips]}")
+    dumps = sorted(glob.glob(os.path.join(flight_dir,
+                                          "flight-*-watchdog.jsonl")))
+    ok &= check("flight-dump-written", len(dumps) == 1,
+                f"{len(dumps)} dump(s)")
+    verdict = None
+    if dumps:
+        with open(dumps[0]) as f:
+            hdr = json.loads(f.readline())
+        verdict = hdr.get("numerics")
+        ok &= check("dump-header-carries-verdict", verdict is not None)
+    if verdict:
+        ok &= check("verdict-names-injected-var",
+                    verdict.get("var") == target,
+                    f"named {verdict.get('var')!r}, injected {target!r}")
+        ok &= check("verdict-names-injected-op-type",
+                    verdict.get("op_type") == "relu",
+                    f"op {verdict.get('first_bad_op')!r}")
+        ok &= check("verdict-from-deterministic-replay",
+                    verdict.get("replayed") is True)
+        ok &= check("verdict-counts-nonfinite",
+                    (verdict.get("stat") or {}).get("nonfinite", 0) > 0,
+                    f"stat={verdict.get('stat')}")
+    ok &= check("locate-replay-counter",
+                monitor.default_registry()
+                .counter("numerics.locate_replays").value >= 1)
+
+    out = {"target_var": target, "verdict": verdict, "checks": checks,
+           "dump": dumps[0] if dumps else None,
+           "last_locate": mnum.last_locate_result()}
+    path = os.path.join(args.out_dir, "numerics_smoke.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"numerics_smoke: artifact -> {path}")
+    if not ok:
+        print("numerics_smoke: FAILED — the locate pipeline did not name "
+              "the injected op")
+        return 1
+    print(f"numerics_smoke: OK — {verdict['first_bad_op']} named for "
+          f"injected var {target!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,12 @@
 #      carries a provenance block, and proves the gate can go RED by
 #      chaos-injecting per-token latency into a decode re-run
 #   6. chaos kill-and-resume fault-tolerance gate
-#   7. serving smoke gate: export a model, boot the inference server,
+#   7. numerics observability gate: a chaos-poisoned op output (a REAL
+#      NaN in the compiled graph) must trip the watchdog and the
+#      FLAGS_check_numerics=locate capture/replay must NAME the injected
+#      op in the flight dump — tools/numerics_smoke.py, artifacts under
+#      ci_artifacts/numerics/
+#   8. serving smoke gate: export a model, boot the inference server,
 #      drive tools/loadgen.py — p99/batch-fill histograms on /metrics,
 #      zero recompiles across a shape-varying stream, the dynamic-
 #      batching A/B (batched >= 2x batch-size-1 QPS), the OVERLOAD gate
@@ -30,12 +35,12 @@
 #      drain-trigger flight dump — overload_smoke.json), and the
 #      generation continuous-batching gate (late joins without
 #      retrace/stall, concurrent streams >= 2x batch-1 decode tokens/sec)
-#   8. compile-check + multichip dryrun (the driver's graft contract)
+#   9. compile-check + multichip dryrun (the driver's graft contract)
 # Usage: tools/run_ci.sh [fast]   — "fast" skips the bench smoke.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/8] lint gate"
+echo "== [1/9] lint gate"
 if command -v ruff >/dev/null 2>&1; then
   ruff check paddle_tpu tools tests bench.py __graft_entry__.py
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -46,17 +51,17 @@ else
 fi
 python tools/lint_rules.py
 
-echo "== [2/8] graph-lint gate (static analysis over the model matrix)"
+echo "== [2/9] graph-lint gate (static analysis over the model matrix)"
 mkdir -p ci_artifacts
 JAX_PLATFORMS=cpu python tools/graph_lint.py \
   --out ci_artifacts/graph_lint.json
 echo "-- graph-lint findings artifact: ci_artifacts/graph_lint.json"
 
-echo "== [3/8] test suite (virtual 8-device CPU mesh)"
+echo "== [3/9] test suite (virtual 8-device CPU mesh)"
 python -m pytest tests/ -q
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [4/8] bench smoke (telemetry on; snapshot + flight artifacts)"
+  echo "== [4/9] bench smoke (telemetry on; snapshot + flight artifacts)"
   mkdir -p ci_artifacts
   rm -f ci_artifacts/bench_steps.jsonl  # StepMonitor appends; keep one run
   rm -rf ci_artifacts/flight && mkdir -p ci_artifacts/flight
@@ -219,6 +224,34 @@ print("pipeline records OK:",
         r["value"]) for r in recs])
 PY
   echo "-- pipeline A/B record artifact: ci_artifacts/bench_pipeline_smoke.json"
+  # Numerics-observability overhead leg (PERF.md r13): transformer smoke
+  # with FLAGS_check_numerics=summary (fused per-param-group stats
+  # reductions + one packed [N,4] fetch per step) paired against the
+  # plain record, both under the warnings gate.  The <3% bar is gated in
+  # PERF.md from a quiet-box measurement; CI only requires the summary
+  # record within 15% of the plain one (CPU boxes are noisy) and prints
+  # the measured delta for the archived pair.
+  python -W error::UserWarning bench.py --model transformer --smoke \
+    | tee ci_artifacts/bench_numerics_smoke.json
+  FLAGS_check_numerics=summary FLAGS_monitor=1 \
+    python -W error::UserWarning bench.py --model transformer --smoke \
+    | tee -a ci_artifacts/bench_numerics_smoke.json
+  python - <<'PY'
+import json
+recs = [json.loads(l) for l in open("ci_artifacts/bench_numerics_smoke.json")
+        if l.strip().startswith("{")]
+recs = [r for r in recs if r.get("metric", "").startswith("transformer")]
+by = {r["provenance"]["flags"].get("check_numerics", "off"): r
+      for r in recs}
+assert set(by) == {"off", "summary"}, \
+    f"need an off AND a summary record: {sorted(by)}"
+overhead = 1.0 - by["summary"]["value"] / by["off"]["value"]
+assert overhead < 0.15, \
+    f"check_numerics=summary cost {overhead:.1%} tokens/sec (>15%)"
+print(f"numerics A/B records OK: off={by['off']['value']} "
+      f"summary={by['summary']['value']} (overhead {overhead:+.2%})")
+PY
+  echo "-- numerics A/B record artifact: ci_artifacts/bench_numerics_smoke.json"
   # Dispatch microbench (ISSUE 16): per-launch overhead of a cache-hit
   # exe.run — the measured launch constant the static cost model's
   # roofline attribution charges per op (analysis/costmodel.py)
@@ -252,7 +285,7 @@ PY
 fi
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [5/8] bench regression sentry (diff vs committed baselines)"
+  echo "== [5/9] bench regression sentry (diff vs committed baselines)"
   # Provenance contract (ISSUE 16 satellite): every archived record must
   # say which commit/flags/jax produced it, or the baseline ledger is
   # unreviewable.
@@ -279,7 +312,8 @@ PY
   # change.
   for a in bench_smoke bench_convbn_smoke bench_deepfm_smoke \
            bench_transformer_smoke bench_recompute_smoke \
-           bench_decode_smoke bench_pipeline_smoke bench_dispatch_smoke
+           bench_decode_smoke bench_pipeline_smoke bench_dispatch_smoke \
+           bench_numerics_smoke
   do
     python tools/bench_diff.py ci_artifacts/baselines/$a.json \
       ci_artifacts/$a.json --rel-tol 0.50
@@ -306,7 +340,7 @@ PY
 fi
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [6/8] chaos smoke: kill-and-resume fault-tolerance gate"
+  echo "== [6/9] chaos smoke: kill-and-resume fault-tolerance gate"
   # A training subprocess is SIGKILLed mid-run by the chaos harness, then
   # resumed from the latest verifiable checkpoint; the gate passes when the
   # resumed run reports a non-zero start step and finishes.  Artifacts: the
@@ -340,8 +374,20 @@ PY
   ls ci_artifacts/chaos/ckpt
 fi
 
+echo "== [7/9] numerics observability gate (NaN-origin locate red-gate)"
+# A REAL NaN is chaos-injected at one known op output in the compiled
+# graph; the gate passes only when the watchdog-tripped locate replay
+# NAMES that op in the flight dump — under the same warnings gate as the
+# bench legs.  Runs in fast mode too: it is seconds of CPU work and it
+# is THE proof the tier's flagship path works end to end.
+rm -rf ci_artifacts/numerics
+JAX_PLATFORMS=cpu python -W error::UserWarning tools/numerics_smoke.py \
+  --out-dir ci_artifacts/numerics
+echo "-- numerics gate artifacts:"
+ls ci_artifacts/numerics/ ci_artifacts/numerics/flight/
+
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [7/8] serving smoke: dynamic-batching inference gate"
+  echo "== [8/9] serving smoke: dynamic-batching inference gate"
   # Exports a demo model, boots two inference servers (batched + forced
   # --max-batch 1), and drives tools/loadgen.py through both:
   #   * a shape-varying stream must finish with the executor compile
@@ -398,7 +444,7 @@ PY
   ls ci_artifacts/serving/
 fi
 
-echo "== [8/8] entry compile-check + multichip dryrun"
+echo "== [9/9] entry compile-check + multichip dryrun"
 python __graft_entry__.py
 
 echo "CI OK"

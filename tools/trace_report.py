@@ -115,6 +115,25 @@ def watchdog_trips(doc: dict):
             if ev.get("kind") == "watchdog.trip"]
 
 
+def numerics_info(doc: dict):
+    """(locate verdict, last summary event, locate events) from the
+    numerics tier (monitor/numerics.py): the header provider embeds the
+    NaN-origin verdict; `numerics.summary` events carry the per-step
+    training-dynamics aggregates."""
+    hdr = doc.get("flight", {}).get("header", {})
+    verdict = hdr.get("numerics")
+    last_summary = None
+    locates = []
+    for ev in doc.get("flight", {}).get("events", []):
+        if ev.get("kind") == "numerics.summary":
+            last_summary = ev
+        elif ev.get("kind") == "numerics.locate":
+            locates.append(ev)
+    if verdict is None and locates:
+        verdict = locates[-1]
+    return verdict, last_summary, locates
+
+
 def request_traces(doc: dict, k: int = 10):
     """(all trace.request events, slowest-K, padding-waste top-K) from
     the request-scoped tracing tier (monitor/tracing.py)."""
@@ -351,6 +370,35 @@ def report(doc: dict, k: int = 20) -> str:
                     f"padded={ev.get('padded_rows')} "
                     f"bucket={pad.get('bucket')} "
                     f"fill={pad.get('fill')}")
+
+    verdict, num_summary, _locates = numerics_info(doc)
+    if verdict is not None or num_summary is not None:
+        lines.append("")
+        lines.append("Numerics (check_numerics tier)")
+        if verdict is not None:
+            stat = verdict.get("stat") or {}
+            first = verdict.get("first_bad_op")
+            if first:
+                lines.append(
+                    f"  first non-finite output: {first} "
+                    f"(var {verdict.get('var')!r}, step "
+                    f"{verdict.get('step')}, "
+                    f"{'replayed' if verdict.get('replayed') else 'in-step'})")
+                lines.append(
+                    f"    nonfinite={stat.get('nonfinite')} "
+                    f"abs_max={stat.get('abs_max')} "
+                    f"abs_mean={stat.get('abs_mean')} l2={stat.get('l2')}")
+            else:
+                lines.append(
+                    f"  locate replay found no non-finite op output "
+                    f"(step {verdict.get('step')}, "
+                    f"{verdict.get('rows_checked')} rows checked)")
+        if num_summary is not None:
+            lines.append(
+                f"  last summary: grad_norm={num_summary.get('grad_norm')} "
+                f"grad_nonfinite={num_summary.get('grad_nonfinite')} "
+                f"nonfinite_rows={num_summary.get('nonfinite_rows')} "
+                f"groups={num_summary.get('groups')}")
 
     trips = watchdog_trips(doc)
     if trips:
