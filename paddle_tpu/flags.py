@@ -398,6 +398,45 @@ FLAGS.define(
     "budget is 1 - this fraction of requests allowed to miss "
     "FLAGS_serving_slo_ms")
 FLAGS.define(
+    "router_port", int, 0,
+    "TCP port for the serving router front-end (serving/router.py): "
+    "proxies /v1/models/*:predict and :generate across the replica "
+    "fleet; 0 = pick a free port")
+FLAGS.define(
+    "router_probe_interval_s", float, 0.5,
+    "router health-probe period: every replica's /health is polled this "
+    "often to drive the in-rotation / draining-out / evicted state "
+    "machine (serving/router.py)")
+FLAGS.define(
+    "router_probe_timeout_s", float, 2.0,
+    "per-probe HTTP timeout; a probe that times out counts as a failure "
+    "toward FLAGS_router_evict_failures")
+FLAGS.define(
+    "router_evict_failures", int, 3,
+    "consecutive failed health probes (connect error, timeout, or "
+    "scheduler_dead status) before a replica is EVICTED from rotation; "
+    "a single passing 'ready' probe re-admits it")
+FLAGS.define(
+    "router_retries", int, 2,
+    "max failover attempts per proxied request AFTER the first (each on "
+    "a different replica where possible), budgeted against the "
+    "request's own timeout_s deadline — the router never sleeps or "
+    "retries past it.  Predict retries on connect error/5xx/429; "
+    "generation fails over only before the first upstream byte")
+FLAGS.define(
+    "router_hedge_ms", float, 0.0,
+    "tail-latency hedging: if a proxied predict gets no response within "
+    "this many ms, a second attempt is fired at a DIFFERENT replica and "
+    "the first response wins (loser's connection is dropped; "
+    "router.hedges_total / hedges_won_total).  0 disables; generation "
+    "is never hedged")
+FLAGS.define(
+    "router_slo_weight", float, 0.0,
+    "SLO-aware load balancing: a replica's effective load is "
+    "inflight + this weight x its serving slo_burn_rate_5m gauge "
+    "(scraped with each health probe), steering new requests away from "
+    "replicas burning error budget; 0 = pure least-inflight")
+FLAGS.define(
     "record_lowered_ops", bool, False,
     "test/debug flag: the executor trace records every lowered op type "
     "into the flight recorder (monitor/flight.py lowered_op_types) — the "
@@ -483,3 +522,22 @@ FLAGS.define(
     "arming additionally fires this many synthetic duplicate requests "
     "at its own model (chaos.serve_flood — deterministic queue-pressure "
     "spike); 0 disables")
+FLAGS.define(
+    "chaos_kill_replica_after", int, -1,
+    "replica-death injection: SIGKILL this serving process right after "
+    "it finishes its Nth predict/generate request (1-based, "
+    "chaos.on_request_done) — armed per replica via env override, the "
+    "router/supervisor failover-and-restart fodder; -1 disables")
+FLAGS.define(
+    "chaos_probe_flap", int, 0,
+    "health-probe flapping: every Nth /health readiness evaluation "
+    "(1-based count of calls, process-global) reports not-ready "
+    "(chaos.probe_flap) — exercises router eviction/re-admission "
+    "hysteresis; 0 disables")
+FLAGS.define(
+    "chaos_replica_latency_s", float, 0.0,
+    "slow-replica simulation: sleep injected once per proxied serving "
+    "HTTP request at the handler level (chaos.maybe_replica_latency) — "
+    "unlike chaos_serve_latency_s this delays the whole request path "
+    "including admission, making one replica a hedging/eviction "
+    "straggler; 0 disables")

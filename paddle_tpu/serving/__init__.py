@@ -34,10 +34,21 @@ failures, and /health reports `draining` / `scheduler_dead`.  Chaos
 kinds in testing/chaos.py (serve latency / transient executor errors /
 request flood) drive the CI overload gate.
 
+Scale-out (ISSUE 18): `router.py` + `fleet.py` turn N replicas into one
+durable endpoint — a health-probe-driven Router (least-inflight +
+SLO-weighted balancing, deadline-budgeted retry-with-failover, optional
+tail-latency hedging, traceparent passthrough) fronting a
+ReplicaSupervisor that crash-restarts replicas with capped backoff and
+rolling-restarts them with zero downtime against the shared persistent
+compilation cache.  Both are lazy exports: the single-replica serving
+path never imports them.
+
 CLI: `python -m paddle_tpu.serving --model name=/path/to/export ...`
-     (add `--demo-generation NAME` for the seeded tiny generation model)
+     (add `--demo-generation NAME` for the seeded tiny generation model;
+      add `--replicas N` for a supervised fleet behind the router)
 Load test: `python tools/loadgen.py --url http://host:port --model name`
-           (`--generate` for prompt-in/tokens-out TTFT + tokens/sec).
+           (`--generate` for prompt-in/tokens-out TTFT + tokens/sec;
+            `--router` to scrape router fleet metrics into the artifact).
 """
 
 from .batcher import (  # noqa: F401
@@ -60,3 +71,18 @@ from .server import (  # noqa: F401
     ServingHandler,
     enable_compilation_cache,
 )
+
+
+def __getattr__(name):
+    # the scale-out tier stays un-imported until someone asks for it:
+    # single-replica serving pays nothing for the router/fleet code
+    if name in ("Router", "RouterHandler", "Replica"):
+        from . import router as _router
+
+        return getattr(_router, name)
+    if name == "ReplicaSupervisor":
+        from .fleet import ReplicaSupervisor
+
+        return ReplicaSupervisor
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -67,6 +67,15 @@ def main(argv=None) -> int:
     p.add_argument("--cache-dir", default=None,
                    help="persistent XLA compilation cache dir "
                         "(default FLAGS_serving_cache_dir)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="fleet mode: supervise N replica subprocesses "
+                        "(each serving the same models on an ephemeral "
+                        "port) behind a health-driven router; this "
+                        "process becomes supervisor + router and prints "
+                        'a {"event": "router_ready", ...} line instead')
+    p.add_argument("--router-port", type=int, default=None,
+                   help="fleet mode: router listen port "
+                        "(default FLAGS_router_port; 0 = ephemeral)")
     args = p.parse_args(argv)
 
     from paddle_tpu.flags import FLAGS
@@ -91,6 +100,9 @@ def main(argv=None) -> int:
         p.error(f"--int8 names not among --model entries: {sorted(unknown)}")
     if not configs and not args.demo_generation:
         p.error("nothing to serve: pass --model and/or --demo-generation")
+
+    if args.replicas > 0:
+        return _run_fleet(args)
 
     server = InferenceServer(configs, host=args.host, port=args.port)
     if args.demo_generation:
@@ -128,12 +140,84 @@ def main(argv=None) -> int:
             # admitted work completes (bounded), flight dump, exit 0
             from paddle_tpu.monitor import flight
 
-            drained = server.drain()
+            drained = server.drain(reason="sigterm")
             flight.record("serving.drain_complete", drained=drained)
             flight.dump(trigger="drain",
                         extra={"drained": drained, "signal": "SIGTERM"})
         else:
             server.stop()
+    return 0
+
+
+def _replica_args(args) -> list:
+    """Rebuild the per-replica CLI from the parsed fleet CLI (everything
+    except the fleet-only and port arguments — the supervisor owns
+    ports)."""
+    out = []
+    for spec in args.model:
+        out += ["--model", spec]
+    for name in args.demo_generation:
+        out += ["--demo-generation", name]
+    if args.gen_slots is not None:
+        out += ["--gen-slots", str(args.gen_slots)]
+    if args.buckets is not None:
+        out += ["--buckets", args.buckets]
+    if args.max_batch is not None:
+        out += ["--max-batch", str(args.max_batch)]
+    if args.max_wait_ms is not None:
+        out += ["--max-wait-ms", str(args.max_wait_ms)]
+    if args.use_aot:
+        out += ["--use-aot"]
+    for name in args.int8:
+        out += ["--int8", name]
+    if args.no_optimize:
+        out += ["--no-optimize"]
+    if args.no_warmup:
+        out += ["--no-warmup"]
+    if args.cache_dir is not None:
+        out += ["--cache-dir", args.cache_dir]
+    return out
+
+
+def _run_fleet(args) -> int:
+    """Fleet mode: this process is supervisor + router; the replicas are
+    subprocesses of the SAME CLI without --replicas."""
+    from paddle_tpu.monitor import flight
+    from paddle_tpu.serving.fleet import ReplicaSupervisor
+    from paddle_tpu.serving.router import Router
+
+    from paddle_tpu.flags import FLAGS
+
+    FLAGS.monitor = True  # a blind router is undebuggable (same stance
+    #                       as the replica server)
+    router = Router(host=args.host, port=args.router_port)
+    sup = ReplicaSupervisor(_replica_args(args), n=args.replicas,
+                            router=router, host=args.host)
+    sup.start()
+    print(json.dumps({
+        "event": "router_ready",
+        "port": router.port,
+        "host": args.host,
+        "replicas": args.replicas,
+        "replica_ports": [sup.replica_port(f"r{i}")
+                          for i in range(args.replicas)],
+    }), flush=True)
+
+    done = threading.Event()
+
+    def _shutdown(signum, frame):
+        done.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _shutdown)
+        except (ValueError, OSError):
+            pass
+    try:
+        done.wait()
+    finally:
+        flight.record("router.fleet_stop", replicas=args.replicas)
+        sup.stop()
     return 0
 
 

@@ -183,6 +183,17 @@ class GenerationServingModel:
     def compile_count(self) -> int:
         return self.session.compile_count
 
+    def readiness_detail(self) -> dict:
+        """Structured readiness for /health (router probe): generation's
+        'ladder' is the prefill+decode program pair compiled at warmup."""
+        return {
+            "ready": self.ready,
+            "state": "ready" if self.ready else "warming",
+            "type": "generation",
+            "warm_buckets": 2 if self.ready else 0,
+            "ladder_size": 2,
+        }
+
     def info(self) -> dict:
         from .. import monitor
 

@@ -234,6 +234,22 @@ class ServingModel:
         self.ready = True
         return warmed
 
+    def readiness_detail(self) -> dict:
+        """Structured per-model readiness for the /health body: how much
+        of the (precision x bucket) warmup ladder is actually compiled,
+        so a fleet router can tell a replica that is WARMING (poll again
+        soon) from one that is dead or will never be ready — without
+        string-matching status prose."""
+        warm = {(p, b) for (p, _sig, b) in self._warm_sigs}
+        ladder = len(self.buckets) * max(1, len(self.precisions))
+        return {
+            "ready": self.ready,
+            "state": "ready" if self.ready else "warming",
+            "precisions": self.precisions,
+            "warm_buckets": len(warm),
+            "ladder_size": ladder,
+        }
+
     # -- execution -------------------------------------------------------
     def run_batch(self, precision: str, feed: Dict[str, np.ndarray],
                   rows: int, bucket: int, requested_sig: tuple):

@@ -1,0 +1,696 @@
+"""Fleet front-end: health-driven request routing across N InferenceServer
+replicas (the robustness half of ROADMAP item 3 — the Fluid distributed
+runtime's client/server split, rebuilt as a modern scale-out serving tier).
+
+One process on one chip is a total outage waiting to happen; the per-replica
+contracts already exist (ISSUE 13: admission control, graceful SIGTERM
+drain, breakers, scheduler-death health) and the cross-process signals too
+(ISSUE 14: traceparent propagation, SLO burn-rate gauges).  This module is
+the part that turns N independently-mortal replicas into one durable
+endpoint:
+
+  * Health-driven rotation — a probe thread polls each replica's /health
+    every FLAGS_router_probe_interval_s and drives a per-replica state
+    machine: in_rotation / warming (alive, ladder still compiling — poll
+    again, do NOT evict) / draining (planned exit: stop sending, keep the
+    slot) / evicted (scheduler_dead, stalled, or
+    FLAGS_router_evict_failures consecutive probe failures).  A single
+    passing probe re-admits.  Evictions and re-admissions are flight
+    events (`router.evict` / `router.readmit`).
+  * Least-inflight balancing with SLO awareness — effective load is
+    inflight + FLAGS_router_slo_weight x the replica's worst
+    slo_burn_rate_5m gauge (scraped alongside the probe), steering
+    traffic away from replicas burning error budget before they fail.
+  * Deadline-budgeted retry-with-failover — connect errors, 5xx, and 429
+    fail over to a different replica with jittered backoff
+    (utils/retry.backoff_delays with deadline_s = the request's own
+    timeout_s), so the router NEVER sleeps a request past its deadline.
+    Predict is idempotent and retries freely; generation fails over only
+    when no response was received (connect error) or the replica rejected
+    it before admission (429/503) — never after tokens may have flowed.
+  * Tail-latency hedging (FLAGS_router_hedge_ms) — a predict that has no
+    response after the hedge delay fires a second attempt at a different
+    replica; first response wins, the loser's connection is torn down.
+  * Traceparent propagation — the client's W3C traceparent header rides
+    through to the replica and the replica's response header rides back,
+    so ISSUE-14 traces span client -> router -> replica.
+
+The router holds no model state and imports no jax: it is pure stdlib
+HTTP (same MonitorHandler base as the monitor endpoint, so /metrics,
+/flight, and /v1/replicas come for free).  Zero-cost contract: nothing
+here is imported by the single-replica serving path.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from ..flags import FLAGS
+from ..monitor import serve as mserve
+from ..utils.retry import backoff_delays
+
+# replica states (gauge encoding: router.replica.<rid>.state)
+IN_ROTATION = "in_rotation"
+WARMING = "warming"
+DRAINING = "draining"
+EVICTED = "evicted"
+_STATE_CODE = {IN_ROTATION: 0, WARMING: 1, DRAINING: 2, EVICTED: 3}
+
+# response statuses that justify sending a predict elsewhere; generation
+# retries only the pre-admission rejections (429/503) — a 5xx may have
+# consumed tokens
+_RETRY_PREDICT = frozenset({429}) | frozenset(range(500, 600))
+_RETRY_GENERATE = frozenset({429, 503})
+
+# request headers forwarded replica-ward; response headers forwarded back
+_FWD_REQ_HEADERS = ("Content-Type", "Accept", "traceparent")
+_FWD_RESP_HEADERS = ("Content-Type", "Retry-After", "traceparent")
+
+
+class _ConnectError(Exception):
+    """The attempt never produced an HTTP response (dead socket, refused
+    connection, timeout before status line) — always safe to fail over."""
+
+
+class Replica:
+    """One backend InferenceServer as the router sees it."""
+
+    def __init__(self, rid: str, host: str, port: int):
+        self.rid = rid
+        self.host = host
+        self.port = int(port)
+        self.state = WARMING  # nothing enters rotation unprobed
+        self.inflight = 0
+        self.consec_fail = 0
+        self.probe_latency_ms = 0.0
+        self.slo_burn = 0.0
+        self.last_status: Optional[str] = None
+        self.detail: dict = {}
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def snapshot(self) -> dict:
+        return {
+            "rid": self.rid,
+            "url": self.url,
+            "state": self.state,
+            "inflight": self.inflight,
+            "consec_fail": self.consec_fail,
+            "probe_latency_ms": round(self.probe_latency_ms, 3),
+            "slo_burn": self.slo_burn,
+            "health_status": self.last_status,
+            "detail": self.detail,
+        }
+
+
+class _RouterHTTPServer(mserve.ThreadingHTTPServer):
+    daemon_threads = True
+    router: "Router" = None
+
+
+class RouterHandler(mserve.MonitorHandler):
+    """/v1/models/<name>:predict|:generate proxy + /v1/replicas fleet
+    introspection; /metrics //health //flight inherited (they report the
+    ROUTER process — replica health lives under /v1/replicas)."""
+
+    server_version = "paddle-tpu-router/1.0"
+
+    def _route_get(self, url) -> bool:
+        router = self.server.router
+        if url.path == "/v1/replicas":
+            self._send_json(200, {"replicas": router.replicas_info()})
+            return True
+        if url.path.startswith("/v1/models"):
+            # introspection GETs proxy to any in-rotation replica
+            status, headers, body = router.proxy_get(self.path)
+            self._respond(status, headers, body)
+            return True
+        return super()._route_get(url)
+
+    def _send_json(self, code: int, obj, headers=None) -> None:
+        self._send(code, json.dumps(obj) + "\n", "application/json",
+                   extra_headers=headers)
+
+    def _respond(self, status: int, headers: dict, body: bytes) -> None:
+        self.send_response(status)
+        ctype = headers.get("Content-Type") or "application/json"
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            if k != "Content-Type" and v:
+                self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        router = self.server.router
+        try:
+            path = urlparse(self.path).path
+            kind = ("generate" if path.endswith((":generate", "/generate"))
+                    else "predict" if path.endswith((":predict", "/predict"))
+                    else None)
+            if kind is None:
+                self._send_json(404, {
+                    "error": "POST /v1/models/<name>:predict "
+                             "(or :generate)"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length > 0 else b""
+            headers = {h: self.headers.get(h) for h in _FWD_REQ_HEADERS
+                       if self.headers.get(h)}
+            status, resp_headers, resp_body = router.proxy(
+                kind, self.path, body, headers)
+            self._respond(status, resp_headers, resp_body)
+        except Exception as e:  # noqa: BLE001 — a request must not kill routing
+            try:
+                self._send_json(500, {
+                    "error": f"router: {type(e).__name__}: {e}"})
+            except OSError:
+                pass
+
+
+class Router:
+    """The fleet front-end.  Replicas are registered by the supervisor
+    (serving/fleet.py) or by hand (`add_replica`); `start()` boots the
+    proxy endpoint and the probe thread."""
+
+    def __init__(self, host: str = "127.0.0.1",
+                 port: Optional[int] = None):
+        self.host = host
+        self._requested_port = (FLAGS.router_port if port is None
+                                else port)
+        self._replicas: Dict[str, Replica] = {}
+        self._lock = threading.Lock()
+        self._httpd: Optional[_RouterHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._probe_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._local = threading.local()  # per-thread keep-alive conns
+
+    # -- fleet membership (supervisor API) -------------------------------
+    def add_replica(self, host: str, port: int,
+                    rid: Optional[str] = None) -> Replica:
+        with self._lock:
+            if rid is None:
+                rid = f"r{len(self._replicas)}"
+            rep = Replica(rid, host, port)
+            self._replicas[rid] = rep
+        # probe immediately so a ready replica does not wait out a full
+        # probe interval before taking traffic
+        self.probe_now(rid)
+        return rep
+
+    def update_replica(self, rid: str, host: str, port: int) -> None:
+        """A restarted replica came back on a new ephemeral port: repoint
+        the slot and let the next probe re-admit it."""
+        with self._lock:
+            rep = self._replicas[rid]
+            rep.host, rep.port = host, int(port)
+            rep.state = WARMING
+            rep.consec_fail = 0
+        self.probe_now(rid)
+
+    def remove_replica(self, rid: str) -> None:
+        with self._lock:
+            self._replicas.pop(rid, None)
+
+    def set_draining(self, rid: str) -> None:
+        """Planned drain (rolling restart): stop sending BEFORE the
+        replica's own /health flips, so zero requests race the SIGTERM."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None and rep.state != DRAINING:
+                self._transition(rep, DRAINING, reason="planned_drain")
+
+    def replicas_info(self) -> List[dict]:
+        with self._lock:
+            return [self._replicas[rid].snapshot()
+                    for rid in sorted(self._replicas)]
+
+    def replica_state(self, rid: str) -> Optional[str]:
+        with self._lock:
+            rep = self._replicas.get(rid)
+            return rep.state if rep is not None else None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> int:
+        if self._httpd is not None:
+            return self.port
+        self._stop.clear()
+        self._httpd = _RouterHTTPServer(
+            (self.host, int(self._requested_port)), RouterHandler)
+        self._httpd.router = self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="paddle-tpu-router-http", daemon=True)
+        self._thread.start()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="paddle-tpu-router-probe",
+            daemon=True)
+        self._probe_thread.start()
+        from ..log import vlog
+
+        vlog(1, "router: listening on %s:%d (%d replicas)", self.host,
+             self.port, len(self._replicas))
+        return self.port
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5.0)
+            self._probe_thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- health probes ---------------------------------------------------
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(FLAGS.router_probe_interval_s):
+            with self._lock:
+                rids = list(self._replicas)
+            for rid in rids:
+                if self._stop.is_set():
+                    return
+                self.probe_now(rid)
+
+    def probe_now(self, rid: str) -> None:
+        """Probe one replica's /health and apply the state machine."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+        if rep is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            status, body = self._http_get(rep, "/health",
+                                          FLAGS.router_probe_timeout_s)
+            health = json.loads(body)
+        except Exception:  # noqa: BLE001 — dead socket, bad JSON: a failure
+            health = None
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return
+            rep.probe_latency_ms = latency_ms
+            self._apply_probe(rep, health)
+        self._publish(rep)
+
+    def _apply_probe(self, rep: Replica, health: Optional[dict]) -> None:
+        """State machine (caller holds the lock).  `health` is the parsed
+        /health body, or None for an unanswered probe."""
+        if health is None:
+            rep.last_status = None
+            rep.consec_fail += 1
+            if (rep.state not in (EVICTED, DRAINING)
+                    and rep.consec_fail >= FLAGS.router_evict_failures):
+                self._transition(rep, EVICTED, reason="probe_failures")
+            return
+        hstatus = health.get("status")
+        serving = health.get("serving") or {}
+        rep.last_status = hstatus
+        rep.detail = serving.get("models") or {}
+        if FLAGS.router_slo_weight > 0:
+            rep.slo_burn = self._scrape_burn(rep)
+        if hstatus == "ok":
+            rep.consec_fail = 0
+            if rep.state != IN_ROTATION:
+                self._transition(rep, IN_ROTATION, reason=rep.state)
+            return
+        if hstatus in ("scheduler_dead", "stalled"):
+            # a dead scheduler never finishes a drain and never recovers
+            # on its own: evict NOW, no hysteresis
+            rep.consec_fail += 1
+            if rep.state != EVICTED:
+                self._transition(rep, EVICTED, reason=hstatus)
+            return
+        if hstatus == "draining":
+            # planned exit: out of rotation but NOT a failure
+            rep.consec_fail = 0
+            if rep.state != DRAINING:
+                self._transition(
+                    rep, DRAINING,
+                    reason=serving.get("draining_reason") or "draining")
+            return
+        # not_ready: the structured per-model detail distinguishes a
+        # replica still compiling its ladder (warming — poll again) from
+        # one that will never be ready (count toward eviction)
+        warming = any(
+            (m or {}).get("state") == "warming"
+            for m in rep.detail.values()) if rep.detail else False
+        if warming:
+            rep.consec_fail = 0
+            if rep.state not in (WARMING, DRAINING):
+                self._transition(rep, WARMING, reason="warming")
+        else:
+            rep.consec_fail += 1
+            if (rep.state not in (EVICTED, DRAINING)
+                    and rep.consec_fail >= FLAGS.router_evict_failures):
+                self._transition(rep, EVICTED, reason="not_ready")
+
+    def _transition(self, rep: Replica, state: str, reason: str) -> None:
+        """Caller holds the lock.  Eviction and re-admission are the two
+        transitions an operator pages on — both flight-record."""
+        prev, rep.state = rep.state, state
+        from ..monitor import counter, enabled, flight
+
+        if state == EVICTED:
+            if enabled():
+                counter("router.evictions_total").inc()
+            flight.record("router.evict", replica=rep.rid, url=rep.url,
+                          reason=reason, prev=prev)
+        elif state == IN_ROTATION and prev != IN_ROTATION:
+            if enabled():
+                counter("router.readmissions_total").inc()
+            flight.record("router.readmit", replica=rep.rid, url=rep.url,
+                          prev=prev)
+
+    def _scrape_burn(self, rep: Replica) -> float:
+        """Worst slo_burn_rate_5m across the replica's models (the
+        /metrics scrape also refreshes the replica's burn windows)."""
+        try:
+            _status, body = self._http_get(rep, "/metrics",
+                                           FLAGS.router_probe_timeout_s)
+            worst = 0.0
+            for line in body.decode().splitlines():
+                if "_slo_burn_rate_5m " in line and line[0] != "#":
+                    try:
+                        worst = max(worst, float(line.rsplit(" ", 1)[1]))
+                    except ValueError:
+                        pass
+            return worst
+        except Exception:  # noqa: BLE001 — burn is advisory, never fatal
+            return 0.0
+
+    def _publish(self, rep: Replica) -> None:
+        from ..monitor import enabled, gauge
+
+        if not enabled():
+            return
+        pfx = f"router.replica.{rep.rid}"
+        gauge(f"{pfx}.state").set(_STATE_CODE[rep.state])
+        gauge(f"{pfx}.inflight").set(rep.inflight)
+        gauge(f"{pfx}.probe_latency_ms").set(rep.probe_latency_ms)
+        if FLAGS.router_slo_weight > 0:
+            gauge(f"{pfx}.slo_burn").set(rep.slo_burn)
+
+    # -- balancing -------------------------------------------------------
+    def pick(self, exclude=()) -> Optional[Replica]:
+        """Least loaded in-rotation replica; effective load is
+        inflight + FLAGS_router_slo_weight x burn.  Falls back to an
+        already-tried replica rather than failing when the exclusion
+        empties the candidate set (retrying somewhere beats 503)."""
+        w = FLAGS.router_slo_weight
+        with self._lock:
+            pool = [r for r in self._replicas.values()
+                    if r.state == IN_ROTATION]
+            if not pool:
+                return None
+            fresh = [r for r in pool if r.rid not in exclude]
+            return min(fresh or pool,
+                       key=lambda r: (r.inflight + w * r.slo_burn, r.rid))
+
+    # -- proxying --------------------------------------------------------
+    def proxy(self, kind: str, path: str, body: bytes,
+              headers: dict) -> Tuple[int, dict, bytes]:
+        """Forward one request, failing over inside its own deadline.
+        Returns (status, response headers, response body)."""
+        from ..monitor import counter, enabled
+
+        timeout_s = _body_timeout_s(body, headers.get("Content-Type"))
+        deadline = time.monotonic() + timeout_s
+        if enabled():
+            counter("router.requests_total").inc()
+        retryable = (_RETRY_PREDICT if kind == "predict"
+                     else _RETRY_GENERATE)
+        delays = backoff_delays(FLAGS.router_retries, base_delay=0.02,
+                                max_delay=0.5, deadline_s=timeout_s)
+        tried: set = set()
+        last: Optional[Tuple[int, dict, bytes]] = None
+        while True:
+            rep = self.pick(exclude=tried)
+            if rep is None:
+                if last is not None:
+                    return last
+                return _json_error(
+                    503, "no replicas in rotation",
+                    reason="no_replicas")
+            tried.add(rep.rid)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return last if last is not None else _json_error(
+                    504, f"deadline exhausted after {timeout_s}s",
+                    reason="deadline")
+            try:
+                if kind == "predict" and FLAGS.router_hedge_ms > 0:
+                    result = self._attempt_hedged(
+                        rep, path, body, headers, remaining, tried)
+                else:
+                    result = self._attempt(
+                        rep, path, body, headers, remaining)
+            except _ConnectError as e:
+                last = _json_error(
+                    502, f"replica {rep.rid} unreachable: {e}",
+                    reason="connect_error")
+                result = None
+            if result is not None:
+                status = result[0]
+                if status not in retryable:
+                    return result
+                last = result
+            # failover: a different replica may well serve this
+            try:
+                delay = next(delays)
+            except StopIteration:
+                return last
+            delay = min(delay, max(0.0, deadline - time.monotonic()))
+            if enabled():
+                counter("router.failover_total").inc()
+                counter(f"router.replica.{rep.rid}.failovers").inc()
+            from ..monitor import flight
+
+            flight.record("router.failover", replica=rep.rid,
+                          request=kind,
+                          status=(last[0] if last else None))
+            if delay > 0:
+                time.sleep(delay)
+
+    def proxy_get(self, path: str) -> Tuple[int, dict, bytes]:
+        """Introspection GET (one failover, no body)."""
+        tried: set = set()
+        for _ in range(2):
+            rep = self.pick(exclude=tried)
+            if rep is None:
+                break
+            tried.add(rep.rid)
+            try:
+                status, body = self._http_get(
+                    rep, path, FLAGS.router_probe_timeout_s)
+                return status, {"Content-Type": "application/json"}, body
+            except Exception:  # noqa: BLE001 — try the next replica
+                continue
+        return _json_error(503, "no replicas in rotation",
+                           reason="no_replicas")
+
+    # -- attempts --------------------------------------------------------
+    def _attempt(self, rep: Replica, path: str, body: bytes,
+                 headers: dict,
+                 timeout_s: float) -> Tuple[int, dict, bytes]:
+        """One forwarded request on this handler thread's keep-alive
+        connection to `rep`; raises _ConnectError when no HTTP response
+        came back (always retryable)."""
+        conn = self._conn(rep, timeout_s)
+        with self._lock:
+            rep.inflight += 1
+        try:
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except Exception as e:
+                self._drop_conn(rep)
+                raise _ConnectError(f"{type(e).__name__}: {e}") from e
+            out_headers = {h: resp.getheader(h)
+                           for h in _FWD_RESP_HEADERS if resp.getheader(h)}
+            return resp.status, out_headers, data
+        finally:
+            with self._lock:
+                rep.inflight -= 1
+
+    def _attempt_hedged(self, rep: Replica, path: str, body: bytes,
+                        headers: dict, timeout_s: float,
+                        tried: set) -> Optional[Tuple[int, dict, bytes]]:
+        """Primary attempt + a hedge at a different replica once
+        FLAGS_router_hedge_ms passes without a response; first response
+        wins, the loser's socket is closed.  Hedged attempts run on
+        worker threads with their own connections (the keep-alive pool
+        is thread-local)."""
+        from ..monitor import counter, enabled
+
+        results: "queue.Queue" = queue.Queue()
+        conns: Dict[str, http.client.HTTPConnection] = {}
+        conns_lock = threading.Lock()
+        deadline = time.monotonic() + timeout_s
+
+        def run(r: Replica) -> None:
+            conn = http.client.HTTPConnection(
+                r.host, r.port, timeout=max(0.05, deadline
+                                            - time.monotonic()))
+            with conns_lock:
+                conns[r.rid] = conn
+            with self._lock:
+                r.inflight += 1
+            try:
+                try:
+                    conn.request("POST", path, body=body, headers=headers)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                except Exception as e:
+                    results.put((r, _ConnectError(str(e))))
+                    return
+                out = {h: resp.getheader(h) for h in _FWD_RESP_HEADERS
+                       if resp.getheader(h)}
+                results.put((r, (resp.status, out, data)))
+            finally:
+                with self._lock:
+                    r.inflight -= 1
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=run, args=(rep,), daemon=True).start()
+        hedge_rep = None
+        try:
+            got = results.get(timeout=FLAGS.router_hedge_ms / 1e3)
+        except queue.Empty:
+            hedge_rep = self.pick(exclude=tried | {rep.rid})
+            if hedge_rep is not None and hedge_rep.rid != rep.rid:
+                tried.add(hedge_rep.rid)
+                if enabled():
+                    counter("router.hedges_total").inc()
+                threading.Thread(target=run, args=(hedge_rep,),
+                                 daemon=True).start()
+            else:
+                hedge_rep = None
+            got = self._wait_result(results, deadline)
+        if got is None:
+            raise _ConnectError("hedged attempt timed out")
+        winner, result = got
+        if isinstance(result, _ConnectError) and hedge_rep is not None:
+            # the first finisher failed; its twin may still deliver
+            got = self._wait_result(results, deadline)
+            if got is not None:
+                winner, result = got
+        # cancel the loser: closing its socket aborts the in-flight read
+        with conns_lock:
+            for rid, conn in conns.items():
+                if rid != winner.rid:
+                    try:
+                        conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+        if hedge_rep is not None and winner.rid == hedge_rep.rid:
+            if enabled():
+                counter("router.hedges_won_total").inc()
+                counter(
+                    f"router.replica.{winner.rid}.hedges_won").inc()
+        if isinstance(result, _ConnectError):
+            raise result
+        return result
+
+    @staticmethod
+    def _wait_result(results: "queue.Queue", deadline: float):
+        try:
+            return results.get(
+                timeout=max(0.01, deadline - time.monotonic()))
+        except queue.Empty:
+            return None
+
+    # -- connections -----------------------------------------------------
+    def _conn(self, rep: Replica,
+              timeout_s: float) -> http.client.HTTPConnection:
+        """Keep-alive connection to `rep` for THIS thread (handler
+        threads are per-client-connection, so the pool amortizes the
+        TCP handshake across a client's whole keep-alive session)."""
+        pool = getattr(self._local, "conns", None)
+        if pool is None:
+            pool = self._local.conns = {}
+        key = (rep.rid, rep.host, rep.port)
+        conn = pool.get(key)
+        if conn is None:
+            conn = http.client.HTTPConnection(rep.host, rep.port,
+                                              timeout=timeout_s)
+            pool[key] = conn
+        else:
+            conn.timeout = timeout_s
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout_s)
+        return conn
+
+    def _drop_conn(self, rep: Replica) -> None:
+        pool = getattr(self._local, "conns", None)
+        if not pool:
+            return
+        conn = pool.pop((rep.rid, rep.host, rep.port), None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _http_get(self, rep: Replica, path: str,
+                  timeout_s: float) -> Tuple[int, bytes]:
+        """Probe-side GET on a fresh connection (the probe thread must
+        never contend with request traffic for a socket)."""
+        conn = http.client.HTTPConnection(rep.host, rep.port,
+                                          timeout=timeout_s)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _body_timeout_s(body: bytes, ctype: Optional[str]) -> float:
+    """The request's own deadline (JSON `timeout_s`, default 30 — the
+    same default the replica's handler applies); npz bodies keep the
+    default rather than paying a parse."""
+    if body and (ctype or "application/json").lower().startswith(
+            "application/json"):
+        try:
+            t = float(json.loads(body).get("timeout_s", 30.0))
+            if t > 0:
+                return t
+        except Exception:  # noqa: BLE001 — replica returns the real 400
+            pass
+    return 30.0
+
+
+def _json_error(status: int, msg: str,
+                reason: str) -> Tuple[int, dict, bytes]:
+    body = (json.dumps({"error": msg, "reason": reason}) + "\n").encode()
+    return status, {"Content-Type": "application/json"}, body

@@ -144,6 +144,19 @@ class ServingHandler(mserve.MonitorHandler):
 
     # -- POST: prediction ------------------------------------------------
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        from ..testing import chaos
+
+        # whole-request-path chaos hooks (one flag read each when off):
+        # straggler latency BEFORE admission, replica death AFTER the
+        # response is written — the router sees a slow replica / a dead
+        # socket on its next request, never a half-written response
+        chaos.maybe_replica_latency()
+        try:
+            self._do_post_inner()
+        finally:
+            chaos.on_request_done()
+
+    def _do_post_inner(self):
         trace = None
         try:
             t_req0 = time.perf_counter()
@@ -476,6 +489,7 @@ class InferenceServer:
         self._thread: Optional[threading.Thread] = None
         self._started = False
         self._draining = False
+        self._drain_reason = ""
         # server-level in-flight accounting: the FLAGS_serving_max_inflight
         # admission cap, and the drain path's "every admitted request has
         # written its response" condition
@@ -729,25 +743,29 @@ class InferenceServer:
             threading.Thread(target=_one, daemon=True).start()
 
     # -- graceful drain ---------------------------------------------------
-    def drain(self, timeout_s: Optional[float] = None) -> bool:
+    def drain(self, timeout_s: Optional[float] = None,
+              reason: str = "shutdown") -> bool:
         """Graceful drain (the SIGTERM path): flip /health readiness to
         'draining' (load balancers stop sending), reject new requests
         with 503, let in-flight and queued-admitted work complete up to
         FLAGS_serving_drain_timeout_s, then stop the serving tier.
-        Returns True when every admitted request completed inside the
-        budget."""
+        `reason` lands in the /health body (draining_reason) so a fleet
+        router can tell a PLANNED drain (rolling restart: keep the slot,
+        re-admit soon) from an unexplained one.  Returns True when every
+        admitted request completed inside the budget."""
         from ..flags import FLAGS
         from ..monitor import flight
 
         if timeout_s is None:
             timeout_s = FLAGS.serving_drain_timeout_s
+        self._drain_reason = reason
         self._draining = True
         batchers = (list(self._batchers.values())
                     + list(self._gen_batchers.values()))
         for b in batchers:
             b.begin_drain()
         flight.record("serving.drain", timeout_s=float(timeout_s),
-                      models=self.model_names)
+                      models=self.model_names, reason=reason)
         deadline = time.monotonic() + max(0.0, float(timeout_s))
         ok = True
         for b in batchers:
@@ -776,23 +794,31 @@ class InferenceServer:
 
     def readiness(self) -> dict:
         models = {
-            n: {"ready": m.ready, "precisions": m.precisions}
+            n: m.readiness_detail()
             for n, m in self._models.items()
         }
         models.update({
-            n: {"ready": m.ready, "type": "generation"}
+            n: m.readiness_detail()
             for n, m in self._gen_models.items()
         })
         all_models = list(self._models.values()) \
             + list(self._gen_models.values())
+        ready = bool(all_models) and all(m.ready for m in all_models)
+        # chaos probe-flap rides the readiness verdict itself (one flag
+        # read when chaos is off): the flapped probe reports not_ready
+        # while every model detail still says ready/warming — exactly the
+        # flicker a router's eviction hysteresis must ride out
+        from ..testing import chaos
+
+        ready = chaos.probe_flap(ready)
         out = {
-            "ready": bool(all_models)
-            and all(m.ready for m in all_models),
+            "ready": ready,
             "models": models,
         }
         if self._draining:
             out["ready"] = False
             out["draining"] = True
+            out["draining_reason"] = self._drain_reason
         # liveness satellite: a dead scheduler thread leaves a healthy-
         # LOOKING server that times out every request — name it so the
         # probe can evict the process

@@ -20,6 +20,13 @@ production hooks are auditable:
     raise RuntimeError — circuit-breaker fodder)
   * request flood                              (one deterministic burst of
     synthetic duplicate requests — queue-pressure spike)
+  * replica death                              (SIGKILL after the Nth
+    served request — router failover / supervisor-restart fodder)
+  * probe flap                                 (every Nth /health readiness
+    evaluation reports not-ready — router eviction hysteresis fodder)
+  * slow replica                               (sleep per serving HTTP
+    request at the handler level — a whole-path straggler, unlike the
+    per-batch serve-latency hook)
 
 Gating: every hook first checks FLAGS_chaos (the master switch); when it is
 off — the default — hooks return immediately without touching any state, so
@@ -53,6 +60,8 @@ class _State:
         self.flood_fired = False
         self.run_count = 0
         self.save_count = 0
+        self.request_done_count = 0
+        self.probe_count = 0
         self.injected = {}  # kind -> count (introspection for tests)
 
 
@@ -231,6 +240,61 @@ def serve_flood() -> int:
         _state.flood_fired = True
     _count("serve_flood")
     return n
+
+
+def on_request_done() -> None:
+    """The serving HTTP handler reports each FINISHED predict/generate
+    request; SIGKILLs the replica right after the
+    FLAGS.chaos_kill_replica_after-th one (1-based).  Dying after the
+    response is written means the router's NEXT request to this replica
+    hits a dead socket — the clean failover case; the supervisor must
+    notice the exit and restart."""
+    if not enabled():
+        return
+    k = FLAGS.chaos_kill_replica_after
+    if k < 0:
+        return
+    with _state.lock:
+        _state.request_done_count += 1
+        n = _state.request_done_count
+    if n == k:
+        _count("kill_replica")
+        kill(f"kill_replica_after {n} requests")
+
+
+def probe_flap(ready: bool) -> bool:
+    """Serving readiness evaluations pass their verdict through; every
+    FLAGS.chaos_probe_flap-th call (1-based, process-global) comes back
+    False — a replica that flickers not-ready without dying, the
+    eviction/re-admission hysteresis the router must ride out."""
+    if not enabled():
+        return ready
+    k = FLAGS.chaos_probe_flap
+    if k <= 0:
+        return ready
+    with _state.lock:
+        _state.probe_count += 1
+        n = _state.probe_count
+    if n % k == 0:
+        _count("probe_flap")
+        return False
+    return ready
+
+
+def maybe_replica_latency() -> None:
+    """The serving HTTP handler calls this once per proxied request
+    BEFORE admission; sleeps FLAGS.chaos_replica_latency_s.  Unlike
+    maybe_serve_latency (per executed batch), this drags the whole
+    request path — the straggler-replica simulation behind hedging and
+    SLO-weighted balancing tests."""
+    if not enabled():
+        return
+    s = FLAGS.chaos_replica_latency_s
+    if s > 0:
+        _count("replica_latency")
+        import time
+
+        time.sleep(s)
 
 
 def nan_loss(step: int, loss):

@@ -35,16 +35,34 @@ def backoff_delays(
     max_delay: float = 2.0,
     jitter: float = 0.25,
     seed: Optional[int] = None,
+    deadline_s: Optional[float] = None,
 ) -> Iterator[float]:
     """Yield `retries` sleep durations: capped exponential with
     multiplicative jitter in [1-jitter, 1+jitter].  `seed` pins the jitter
-    sequence (tests / deterministic chaos replay)."""
+    sequence (tests / deterministic chaos replay).
+
+    `deadline_s` is a sleep budget (the caller's REMAINING deadline, not a
+    wall-clock instant): the generator stops yielding once the cumulative
+    sleep it has handed out would exceed it, so a retry loop driven by
+    these delays can never sleep a request past its own timeout.  The
+    final yielded delay is clipped to the remaining budget rather than
+    dropped — a 100 ms budget gets at most 100 ms of total sleep, never
+    the full next exponential step.  None = unbudgeted (legacy behavior);
+    a non-positive budget yields nothing (no sleeps, thus no retries for
+    retry_call callers)."""
     rng = random.Random(seed) if seed is not None else random
+    remaining = deadline_s
     for i in range(retries):
+        if remaining is not None and remaining <= 0:
+            return
         d = min(max_delay, base_delay * (factor ** i))
         if jitter:
             d *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
-        yield max(0.0, d)
+        d = max(0.0, d)
+        if remaining is not None:
+            d = min(d, remaining)
+            remaining -= d
+        yield d
 
 
 def retry_call(
@@ -60,6 +78,7 @@ def retry_call(
     name: str = "",
     on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
     sleep: Optional[Callable[[float], None]] = None,
+    deadline_s: Optional[float] = None,
     **kwargs,
 ):
     """Call `fn(*args, **kwargs)`; on a `retry_on` exception, back off and
@@ -69,11 +88,13 @@ def retry_call(
 
     `on_retry(exc, attempt, delay)` observes each scheduled retry (the
     call sites log / bump monitor counters there); `name` labels the
-    default telemetry.  Total attempts = retries + 1."""
+    default telemetry.  Total attempts = retries + 1.  `deadline_s`
+    bounds the cumulative backoff sleep (see backoff_delays): once the
+    budget is spent, the next failure gives up instead of retrying."""
     if sleep is None:
         sleep = time.sleep  # resolved per call: tests patch time.sleep
     delays = backoff_delays(retries, base_delay, factor, max_delay,
-                            jitter, seed)
+                            jitter, seed, deadline_s=deadline_s)
     attempt = 0
     while True:
         attempt += 1

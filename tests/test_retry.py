@@ -75,6 +75,56 @@ def test_backoff_is_exponential_capped_and_deterministic_when_seeded():
 
 
 # ---------------------------------------------------------------------------
+# deadline budget (ISSUE 18 satellite): cumulative sleep never exceeds the
+# caller's remaining deadline
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_deadline_budget_caps_cumulative_sleep():
+    """The regression the satellite names: a 100 ms-deadline request must
+    never sleep 200 ms — the generator stops yielding once cumulative
+    sleep would exceed the budget, clipping the final delay."""
+    for seed in range(20):
+        total = sum(backoff_delays(10, base_delay=0.05, max_delay=2.0,
+                                   seed=seed, deadline_s=0.1))
+        assert total <= 0.1 + 1e-9, (seed, total)
+
+
+def test_backoff_deadline_clips_final_delay_not_drops_it():
+    # budget 0.12 with 50 ms then ~100 ms raw steps: the second delay is
+    # clipped to the ~70 ms remainder, not dropped entirely
+    ds = list(backoff_delays(5, base_delay=0.05, jitter=0.0,
+                             deadline_s=0.12))
+    assert ds == pytest.approx([0.05, 0.07])
+    assert sum(ds) == pytest.approx(0.12)
+
+
+def test_backoff_nonpositive_deadline_yields_nothing():
+    assert list(backoff_delays(5, deadline_s=0.0)) == []
+    assert list(backoff_delays(5, deadline_s=-1.0)) == []
+
+
+def test_backoff_no_deadline_is_legacy_unbudgeted():
+    assert len(list(backoff_delays(5, jitter=0.0))) == 5
+
+
+def test_retry_call_deadline_stops_retrying_past_budget():
+    """retry_call with deadline_s: the total sleep handed to the sleeper
+    stays inside the budget and the call gives up (RetryError) instead of
+    sleeping on."""
+    slept = []
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(RetryError):
+        retry_call(always, retries=50, base_delay=0.05, jitter=0.0,
+                   sleep=slept.append, deadline_s=0.1)
+    assert sum(slept) <= 0.1 + 1e-9
+    assert len(slept) >= 1  # it did retry inside the budget
+
+
+# ---------------------------------------------------------------------------
 # dataset download hardening
 # ---------------------------------------------------------------------------
 

@@ -246,17 +246,24 @@ def make_traceparent(nonce: str, i: int) -> str:
 
 def _send_with_retry(conn: _Conn, target: str, body: bytes,
                      stats: _Stats, retries: int, seed: int,
-                     headers=None):
+                     headers=None, deadline_s=None):
     """POST with jittered exponential backoff (utils/retry.backoff_delays
     — the shared production policy) on transport failures and 429/503,
     honoring the server's Retry-After: the sleep is
-    max(jittered backoff, server hint).  Returns (response bytes,
+    max(jittered backoff, server hint).  `deadline_s` is the request's
+    own timeout_s: the backoff generator's sleep budget (it stops
+    yielding once cumulative sleep would exceed it) AND a hard clamp on
+    the Retry-After hint — a client must never still be backing off a
+    request whose deadline already passed.  Returns (response bytes,
     served-attempt latency seconds) on 2xx — the latency of the attempt
     the server actually SERVED, excluding backoff sleeps, so the
     artifact's percentiles measure the server and not the retry policy —
     or (None, None) after recording the terminal outcome."""
     delays = _retry.backoff_delays(max(0, retries), base_delay=0.05,
-                                   max_delay=2.0, seed=seed)
+                                   max_delay=2.0, seed=seed,
+                                   deadline_s=deadline_s)
+    deadline = (time.perf_counter() + deadline_s
+                if deadline_s is not None else None)
     while True:
         t0 = time.perf_counter()
         resp = conn.request_raw(target, body, headers=headers)
@@ -287,32 +294,37 @@ def _send_with_retry(conn: _Conn, target: str, body: bytes,
         except StopIteration:
             stats.terminal(kind)
             return None, None
+        sleep = max(d, hint or 0.0)
+        if deadline is not None:
+            sleep = min(sleep, max(0.0, deadline - time.perf_counter()))
         stats.retried()
-        time.sleep(max(d, hint or 0.0))
+        time.sleep(sleep)
 
 
 def _fire(conn: _Conn, model: str, body: bytes, precision: str,
           stats: _Stats, lag: float = 0.0, retries: int = 0,
-          seed: int = 0, trace_id=None, headers=None) -> None:
+          seed: int = 0, trace_id=None, headers=None,
+          deadline_s=None) -> None:
     target = f"/v1/models/{model}:predict"
     if precision != "fp32":
         target += f"?precision={precision}"
     data, dt = _send_with_retry(conn, target, body, stats, retries, seed,
-                                headers=headers)
+                                headers=headers, deadline_s=deadline_s)
     if data is not None:
         stats.ok(dt, lag, trace_id=trace_id)
 
 
 def _fire_generate(conn: _Conn, model: str, body: bytes,
                    stats: _Stats, retries: int = 0, seed: int = 0,
-                   trace_id=None, headers=None) -> None:
+                   trace_id=None, headers=None,
+                   deadline_s=None) -> None:
     """Prompt-in/tokens-out request: records the server-side TTFT from
     the response meta (the continuous batcher stamps time-to-first-token
     at the decode step that produced it) and the generated token count
     (client tokens/sec = sum(tokens) / wall)."""
     data, dt = _send_with_retry(conn, f"/v1/models/{model}:generate",
                                 body, stats, retries, seed,
-                                headers=headers)
+                                headers=headers, deadline_s=deadline_s)
     if data is None:
         return
     try:
@@ -380,6 +392,13 @@ def main(argv=None) -> int:
     p.add_argument("--trace-top", type=int, default=5,
                    help="how many slowest requests to resolve against "
                         "/v1/traces (with --trace)")
+    p.add_argument("--router", action="store_true",
+                   help="the url is a serving ROUTER (serving/router.py "
+                        "fleet front-end): scrape its failover/hedge/"
+                        "eviction counters and the /v1/replicas fleet "
+                        "snapshot into the artifact's router section "
+                        "(model discovery and requests proxy through "
+                        "unchanged)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default="",
                    help="write the JSON artifact here (always printed to "
@@ -464,12 +483,14 @@ def main(argv=None) -> int:
                         _fire_generate(conn, args.model,
                                        bodies[i % len(bodies)], stats,
                                        retries=args.max_retries, seed=i,
-                                       trace_id=tid, headers=hdrs)
+                                       trace_id=tid, headers=hdrs,
+                                       deadline_s=args.timeout_s)
                     else:
                         _fire(conn, args.model, bodies[i % len(bodies)],
                               args.precision, stats,
                               retries=args.max_retries, seed=i,
-                              trace_id=tid, headers=hdrs)
+                              trace_id=tid, headers=hdrs,
+                              deadline_s=args.timeout_s)
             finally:
                 conn.close()
 
@@ -499,7 +520,8 @@ def main(argv=None) -> int:
                     _fire(conn, args.model, bodies[i % len(bodies)],
                           args.precision, stats, lag,
                           retries=args.max_retries, seed=i,
-                          trace_id=tid, headers=hdrs)
+                          trace_id=tid, headers=hdrs,
+                          deadline_s=args.timeout_s)
             finally:
                 conn.close()
 
@@ -566,6 +588,26 @@ def main(argv=None) -> int:
                 "prefills": delta(f"serving_gen_{mname}_prefills"),
             },
         }
+    # --router: the fleet-level story (failovers absorbed, hedges fired,
+    # replicas evicted/re-admitted/restarted) + the final fleet snapshot
+    router_block = None
+    if args.router:
+        router_block = {
+            "requests_total": delta("router_requests_total"),
+            "failover_total": delta("router_failover_total"),
+            "hedges_total": delta("router_hedges_total"),
+            "hedges_won_total": delta("router_hedges_won_total"),
+            "evictions_total": delta("router_evictions_total"),
+            "readmissions_total": delta("router_readmissions_total"),
+            "replica_restarts_total": delta(
+                "router_replica_restarts_total"),
+        }
+        try:
+            router_block["replicas"] = _get_json(
+                f"{args.url}/v1/replicas")["replicas"]
+        except Exception as e:  # noqa: BLE001 — snapshot is best-effort
+            router_block["replicas"] = f"{type(e).__name__}: {e}"
+
     artifact = {
         "tool": "loadgen",
         "url": args.url,
@@ -598,6 +640,7 @@ def main(argv=None) -> int:
             round(float(np.percentile(stats.lag, 99)) * 1e3, 3)
             if stats.lag else None),
         "generation": generation,
+        "router": router_block,
         "trace": bool(args.trace),
         "slow_traces": slow_traces,
         "policy": {

@@ -35,12 +35,17 @@
 #      drain-trigger flight dump — overload_smoke.json), and the
 #      generation continuous-batching gate (late joins without
 #      retrace/stall, concurrent streams >= 2x batch-1 decode tokens/sec)
-#   9. compile-check + multichip dryrun (the driver's graft contract)
+#   9. router smoke gate: a 3-replica supervised fleet behind the
+#      scale-out router survives a chaos SIGKILL mid-flood with zero
+#      non-429 client errors (failover + evict/readmit + crash restart)
+#      and < 5ms p50 router tax — tools/router_smoke.py,
+#      ci_artifacts/serving/router_smoke.json
+#  10. compile-check + multichip dryrun (the driver's graft contract)
 # Usage: tools/run_ci.sh [fast]   — "fast" skips the bench smoke.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/9] lint gate"
+echo "== [1/10] lint gate"
 if command -v ruff >/dev/null 2>&1; then
   ruff check paddle_tpu tools tests bench.py __graft_entry__.py
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -51,17 +56,17 @@ else
 fi
 python tools/lint_rules.py
 
-echo "== [2/9] graph-lint gate (static analysis over the model matrix)"
+echo "== [2/10] graph-lint gate (static analysis over the model matrix)"
 mkdir -p ci_artifacts
 JAX_PLATFORMS=cpu python tools/graph_lint.py \
   --out ci_artifacts/graph_lint.json
 echo "-- graph-lint findings artifact: ci_artifacts/graph_lint.json"
 
-echo "== [3/9] test suite (virtual 8-device CPU mesh)"
+echo "== [3/10] test suite (virtual 8-device CPU mesh)"
 python -m pytest tests/ -q
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [4/9] bench smoke (telemetry on; snapshot + flight artifacts)"
+  echo "== [4/10] bench smoke (telemetry on; snapshot + flight artifacts)"
   mkdir -p ci_artifacts
   rm -f ci_artifacts/bench_steps.jsonl  # StepMonitor appends; keep one run
   rm -rf ci_artifacts/flight && mkdir -p ci_artifacts/flight
@@ -285,7 +290,7 @@ PY
 fi
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [5/9] bench regression sentry (diff vs committed baselines)"
+  echo "== [5/10] bench regression sentry (diff vs committed baselines)"
   # Provenance contract (ISSUE 16 satellite): every archived record must
   # say which commit/flags/jax produced it, or the baseline ledger is
   # unreviewable.
@@ -340,7 +345,7 @@ PY
 fi
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [6/9] chaos smoke: kill-and-resume fault-tolerance gate"
+  echo "== [6/10] chaos smoke: kill-and-resume fault-tolerance gate"
   # A training subprocess is SIGKILLed mid-run by the chaos harness, then
   # resumed from the latest verifiable checkpoint; the gate passes when the
   # resumed run reports a non-zero start step and finishes.  Artifacts: the
@@ -374,7 +379,7 @@ PY
   ls ci_artifacts/chaos/ckpt
 fi
 
-echo "== [7/9] numerics observability gate (NaN-origin locate red-gate)"
+echo "== [7/10] numerics observability gate (NaN-origin locate red-gate)"
 # A REAL NaN is chaos-injected at one known op output in the compiled
 # graph; the gate passes only when the watchdog-tripped locate replay
 # NAMES that op in the flight dump — under the same warnings gate as the
@@ -387,7 +392,7 @@ echo "-- numerics gate artifacts:"
 ls ci_artifacts/numerics/ ci_artifacts/numerics/flight/
 
 if [[ "${1:-}" != "fast" ]]; then
-  echo "== [8/9] serving smoke: dynamic-batching inference gate"
+  echo "== [8/10] serving smoke: dynamic-batching inference gate"
   # Exports a demo model, boots two inference servers (batched + forced
   # --max-batch 1), and drives tools/loadgen.py through both:
   #   * a shape-varying stream must finish with the executor compile
@@ -444,7 +449,21 @@ PY
   ls ci_artifacts/serving/
 fi
 
-echo "== [9/9] entry compile-check + multichip dryrun"
+if [[ "${1:-}" != "fast" ]]; then
+  echo "== [9/10] router smoke: scale-out fleet fault-tolerance gate"
+  # A 3-replica supervised fleet behind the router survives a chaos
+  # SIGKILL mid-flood (FLAGS_chaos_kill_replica_after arms one replica):
+  # zero non-429 client-visible errors, failover_total > 0, the victim
+  # is evicted AND re-admitted (flight events), the supervisor's crash
+  # restart brings it back, and the router's proxy tax stays < 5 ms p50
+  # over direct-to-replica at --max-batch 1.
+  mkdir -p ci_artifacts/serving
+  JAX_PLATFORMS=cpu python tools/router_smoke.py \
+    --out-dir ci_artifacts/serving
+  echo "-- router fleet artifact: ci_artifacts/serving/router_smoke.json"
+fi
+
+echo "== [10/10] entry compile-check + multichip dryrun"
 python __graft_entry__.py
 
 echo "CI OK"
