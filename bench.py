@@ -272,10 +272,15 @@ def cost_probe(prog, batch_size, name):
         return {
             "cost_device": cost.device.name,
             "cost_launches": cost.n_launches,
+            "cost_launches_fused": cost.n_launches_fused,
             "cost_predicted_step_us": round(
                 cost.predicted_seconds * 1e6, 2),
+            "cost_predicted_step_us_fused": round(
+                cost.predicted_seconds_fused * 1e6, 2),
             "cost_launch_bound_fraction": round(
                 cost.launch_bound_fraction, 4),
+            "cost_launch_bound_fraction_fused": round(
+                cost.launch_bound_fraction_fused, 4),
         }
     except Exception as e:  # noqa: BLE001
         print(f"[bench] cost probe failed: {type(e).__name__}: {e}",
@@ -651,9 +656,15 @@ def bench_decode(batch_size=1, max_tokens=64, tiny=False, repeats=1,
 def run_decode(args, peak):
     """Emit decode_tokens_per_sec at the ROADMAP batch pair (1 and 64;
     tiny shapes under --smoke).  config records the kv_cache /
-    flash_decode flags — tools/run_ci.sh pairs a FLAGS_kv_cache=0
-    recompute record next to the cached one for the A/B — and
-    compile_flat, which run_ci asserts True."""
+    flash_decode / fused_decode_step flags — tools/run_ci.sh pairs a
+    FLAGS_kv_cache=0 recompute record next to the cached one for the
+    A/B — and compile_flat, which run_ci asserts True.
+
+    When FLAGS_fused_decode_step is on (the default) each batch emits a
+    PAIR: the fused record under the baseline-continuous metric name,
+    then a `_unfused` record rebuilt with the flag off — the megastep
+    speedup ratio run_ci's decode smoke gate reads (fused b1 tokens/sec
+    must not lose to unfused)."""
     from paddle_tpu.flags import FLAGS
 
     repeats = _repeats(args)
@@ -661,23 +672,36 @@ def run_decode(args, peak):
     batches = ([1, 8] if args.smoke else [1, 64])
     if args.batch_size:
         batches = [args.batch_size]
+    # the pair only means something on the cached route (the recompute
+    # oracle never runs cached_decoder_step)
+    variants = ([(True, ""), (False, "_unfused")]
+                if FLAGS.fused_decode_step and FLAGS.kv_cache
+                else [(bool(FLAGS.fused_decode_step), "")])
     for bs in batches:
-        runs, prefill_s, flat, n_compiles, cost = bench_decode(
-            batch_size=bs, max_tokens=max_tokens, tiny=args.smoke,
-            repeats=repeats)
-        tps, spread, run_list = _mean_spread(runs)
-        config = {"batch": bs, "max_tokens": max_tokens, "tiny": args.smoke,
-                  "kv_cache": bool(FLAGS.kv_cache),
-                  "flash_decode": bool(FLAGS.flash_decode),
-                  "prefill_ms": round(prefill_s * 1e3, 2),
-                  "compile_flat": bool(flat),
-                  "compiled_signatures": n_compiles,
-                  "runs": [round(r, 1) for r in run_list],
-                  "spread": round(spread, 1)}
-        config.update(cost)
-        emit_metric(
-            f"decode_tokens_per_sec_b{bs}", tps, "tokens/sec",
-            None, None, 0.0, config)
+        for fused, suffix in variants:
+            try:
+                if not fused:
+                    FLAGS.set("fused_decode_step", False)
+                runs, prefill_s, flat, n_compiles, cost = bench_decode(
+                    batch_size=bs, max_tokens=max_tokens, tiny=args.smoke,
+                    repeats=repeats)
+            finally:
+                FLAGS.reset("fused_decode_step")
+            tps, spread, run_list = _mean_spread(runs)
+            config = {"batch": bs, "max_tokens": max_tokens,
+                      "tiny": args.smoke,
+                      "kv_cache": bool(FLAGS.kv_cache),
+                      "flash_decode": bool(FLAGS.flash_decode),
+                      "fused_decode_step": fused,
+                      "prefill_ms": round(prefill_s * 1e3, 2),
+                      "compile_flat": bool(flat),
+                      "compiled_signatures": n_compiles,
+                      "runs": [round(r, 1) for r in run_list],
+                      "spread": round(spread, 1)}
+            config.update(cost)
+            emit_metric(
+                f"decode_tokens_per_sec_b{bs}{suffix}", tps, "tokens/sec",
+                None, None, 0.0, config)
 
 
 def bench_dispatch(calls=300, warmup=30, repeats=3):

@@ -163,6 +163,28 @@ class OpCost:
                 "t_memory": self.t_memory, "bound": self.bound}
 
 
+#: op families XLA reliably folds into a neighboring kernel's prologue/
+#: epilogue: elementwise arithmetic and activations, dtype casts, pure
+#: layout moves, constant fills, aliasing bookkeeping, and the feed/
+#: fetch markers (host transfers, not launches).  The fusion-corrected
+#: launch count charges these ZERO and everything else (dots, Pallas
+#: kernels, reductions, gathers) ONE — the r13-documented fix for the
+#: one-launch-per-IR-op decode bias (predicted-vs-measured 10.5x on
+#: decode b1).  `n_launches` stays the honest upper bound; the corrected
+#: figure is reported NEXT to it, never instead of it.
+FUSED_EPILOGUE_OPS = frozenset({
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min", "scale",
+    "cast", "reshape", "reshape2", "transpose", "transpose2", "split",
+    "concat", "expand", "squeeze", "squeeze2", "unsqueeze", "unsqueeze2",
+    "stack", "slice", "fill_constant",
+    "fill_constant_batch_size_like", "assign", "equal", "not_equal",
+    "less_than", "greater_than", "sign", "abs", "relu", "gelu",
+    "sigmoid", "tanh", "exp", "sqrt", "square", "clip", "dropout",
+    "feed", "fetch",
+})
+
+
 class ProgramCost:
     """The cost model's product for one program."""
 
@@ -173,12 +195,17 @@ class ProgramCost:
         self.total_flops = 0.0
         self.total_bytes = 0
         self.n_launches = 0
+        self.n_launches_fused = 0
         self.warnings: List[dict] = []
 
     # -- derived ----------------------------------------------------------
     @property
     def launch_seconds(self) -> float:
         return self.n_launches * self.device.launch_overhead_s
+
+    @property
+    def launch_seconds_fused(self) -> float:
+        return self.n_launches_fused * self.device.launch_overhead_s
 
     @property
     def roofline_seconds(self) -> float:
@@ -191,11 +218,23 @@ class ProgramCost:
         return self.roofline_seconds + self.launch_seconds
 
     @property
+    def predicted_seconds_fused(self) -> float:
+        """Roofline + the fusion-corrected launch count (compiler-fused
+        epilogue ops charged zero) — the better point estimate; the
+        plain predicted_seconds stays the upper bound."""
+        return self.roofline_seconds + self.launch_seconds_fused
+
+    @property
     def launch_bound_fraction(self) -> float:
         """Fraction of the predicted step spent on dispatch — ROADMAP
         item 1's go/no-go number for the decode megakernel."""
         p = self.predicted_seconds
         return (self.launch_seconds / p) if p > 0 else 0.0
+
+    @property
+    def launch_bound_fraction_fused(self) -> float:
+        p = self.predicted_seconds_fused
+        return (self.launch_seconds_fused / p) if p > 0 else 0.0
 
     def bound_counts(self) -> Dict[str, int]:
         out = {"compute": 0, "memory": 0, "launch": 0}
@@ -217,12 +256,16 @@ class ProgramCost:
             "device": self.device.to_dict(),
             "n_ops": len(self.ops),
             "n_launches": self.n_launches,
+            "n_launches_fused": self.n_launches_fused,
             "total_flops": self.total_flops,
             "total_bytes": self.total_bytes,
             "roofline_seconds": self.roofline_seconds,
             "launch_seconds": self.launch_seconds,
             "predicted_seconds": self.predicted_seconds,
+            "predicted_seconds_fused": self.predicted_seconds_fused,
             "launch_bound_fraction": round(self.launch_bound_fraction, 4),
+            "launch_bound_fraction_fused":
+                round(self.launch_bound_fraction_fused, 4),
             "bound_counts": self.bound_counts(),
             "ops": [oc.to_dict() for oc in self.ops],
             "warnings": list(self.warnings),
@@ -242,7 +285,11 @@ class ProgramCost:
             f"roofline {self.roofline_seconds * us:.1f} us + "
             f"{self.n_launches} launches x "
             f"{self.device.launch_overhead_s * us:.1f} us",
-            f"  launch-bound fraction {self.launch_bound_fraction:.1%}   "
+            f"  fusion-corrected {self.predicted_seconds_fused * us:6.1f} "
+            f"us ({self.n_launches_fused} launches after compiler fusion "
+            f"of epilogue ops)",
+            f"  launch-bound fraction {self.launch_bound_fraction:.1%} "
+            f"(corrected {self.launch_bound_fraction_fused:.1%})   "
             f"ops: {bc['compute']} compute / {bc['memory']} memory / "
             f"{bc['launch']} launch",
             f"  total {self.total_flops:.3g} FLOPs, "
@@ -296,6 +343,8 @@ def _walk_block(block: fw.Block, cost: ProgramCost,
         cost.total_flops += flops
         cost.total_bytes += nbytes
         cost.n_launches += 1
+        if op.type not in FUSED_EPILOGUE_OPS:
+            cost.n_launches_fused += 1
         idx += 1
         for sub in _sub_blocks(op):
             cost.warn("sub-block", op.type,
@@ -344,19 +393,30 @@ def publish_cost(cost: ProgramCost, name: Optional[str] = None) -> None:
     tag = name or cost.name
     monitor.gauge(f"cost.{tag}.op_count").set(len(cost.ops))
     monitor.gauge(f"cost.{tag}.launch_count").set(cost.n_launches)
+    monitor.gauge(f"cost.{tag}.launch_count_fused").set(
+        cost.n_launches_fused)
     monitor.gauge(f"cost.{tag}.predicted_step_seconds").set(
         cost.predicted_seconds)
+    monitor.gauge(f"cost.{tag}.predicted_step_seconds_fused").set(
+        cost.predicted_seconds_fused)
     monitor.gauge(f"cost.{tag}.launch_bound_fraction").set(
         cost.launch_bound_fraction)
+    monitor.gauge(f"cost.{tag}.launch_bound_fraction_fused").set(
+        cost.launch_bound_fraction_fused)
     monitor.gauge(f"cost.{tag}.total_flops").set(cost.total_flops)
     monitor.gauge(f"cost.{tag}.hbm_bytes").set(cost.total_bytes)
     flight.record(
         "cost.program", name=tag, device=cost.device.name,
         device_source=cost.device.source, n_ops=len(cost.ops),
-        n_launches=cost.n_launches, total_flops=cost.total_flops,
+        n_launches=cost.n_launches,
+        n_launches_fused=cost.n_launches_fused,
+        total_flops=cost.total_flops,
         total_bytes=cost.total_bytes,
         roofline_seconds=cost.roofline_seconds,
         launch_seconds=cost.launch_seconds,
         predicted_seconds=cost.predicted_seconds,
+        predicted_seconds_fused=cost.predicted_seconds_fused,
         launch_bound_fraction=round(cost.launch_bound_fraction, 4),
+        launch_bound_fraction_fused=round(
+            cost.launch_bound_fraction_fused, 4),
         bound_counts=cost.bound_counts(), warnings=len(cost.warnings))

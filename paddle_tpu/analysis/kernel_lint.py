@@ -329,6 +329,74 @@ def check_decode_plan(cfg: dict, ok, block_t, interpret,
             f"budget the gate claims to enforce", fam, label))
 
 
+def check_megastep_plan(cfg: dict, plan, findings: List[Finding]):
+    """Fused decode megastep plan (kernels/decode_step.py
+    _megastep_plan): one whole decoder layer per launch — weights
+    resident in VMEM, both walks block-DMA'd, the cache row written in
+    place through input_output_aliases."""
+    from ..kernels import decode_step as kds
+
+    fam, label = "decode_step", cfg["label"]
+    dm, h, dh, di = cfg["dm"], cfg["h"], cfg["dh"], cfg["di"]
+    max_t, cross_t = cfg["max_t"], cfg["cross_t"]
+    esize = _np_dtype(cfg["dtype"]).itemsize
+    sub = _sublane(cfg["dtype"])
+    if cfg.get("must_accept", True) and not plan.ok:
+        findings.append(_finding(
+            "kernel-plan-reject",
+            f"plan gate rejects the canonical layer shape dm={dm} h={h} "
+            f"dh={dh} di={di} max_t={max_t} cross_t={cross_t} "
+            f"{cfg['dtype']} — decode would silently run the composed "
+            f"XLA fallback and the per-token launch count stays at the "
+            f"unfused wall", fam, label))
+        return
+    if not plan.ok:
+        return
+    if "expect_fuse_ffn" in cfg and plan.fuse_ffn != cfg["expect_fuse_ffn"]:
+        findings.append(_finding(
+            "kernel-fusion-mode",
+            f"plan fuses the FFN={plan.fuse_ffn}, expected "
+            f"{cfg['expect_fuse_ffn']} — the launch-count story this "
+            f"shape was accepted under no longer holds", fam, label))
+    if max_t % plan.block_t or cross_t % plan.cross_block_t:
+        findings.append(_finding(
+            "kernel-grid-divisibility",
+            f"blocks ({plan.block_t},{plan.cross_block_t}) do not divide "
+            f"(max_t={max_t}, cross_t={cross_t})", fam, label))
+    if dh % 64 or dm % _LANE or di % _LANE:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"dh {dh} %% 64 or dm {dm} %% 128 or di {di} %% 128 "
+            f"misaligned (lane dims of the resident weight tiles)", fam,
+            label))
+    if h % sub:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"n_head {h} violates the {sub}-sublane tiling of the "
+            f"[h, t, d] walk views for {cfg['dtype']}", fam, label))
+    if plan.block_t % 8 or plan.cross_block_t % 8:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"blocks ({plan.block_t},{plan.cross_block_t}) are not "
+            f"8-sublane aligned", fam, label))
+    # independent working-set re-estimate vs the gate's own budget: the
+    # resident weights (qkv + out + cross-q + cross-out + q scratch),
+    # both walks' k/v scratch blocks with their f32 promotions, the
+    # score planes — and the FFN weights when the plan claims they fit
+    hd = h * dh
+    bt, cbt = plan.block_t, plan.cross_block_t
+    resident = 6 * hd * dm * esize + dm * dh * 4 \
+        + 2 * (bt + cbt) * hd * (esize + 4) + 2 * h * max(bt, cbt) * 4
+    if plan.fuse_ffn:
+        resident += 2 * dm * di * esize + di * 4
+    if resident > kds._VMEM_BUDGET:
+        findings.append(_finding(
+            "kernel-vmem-budget",
+            f"megastep working set {resident} bytes exceeds the "
+            f"{kds._VMEM_BUDGET}-byte budget the gate claims to enforce "
+            f"(fuse_ffn={plan.fuse_ffn})", fam, label))
+
+
 def check_embedding_group(cfg: dict, block_rows: int,
                           findings: List[Finding]):
     """Fused multi-table gather/apply group: alias validity + the 8 MB
@@ -497,6 +565,25 @@ _DECODE_MATRIX = [
          dtype="float32", must_accept=False),
 ]
 
+# fused decode megastep: whole-decoder-layer-per-launch plans
+# (kernels/decode_step.py) over the generation-tier model geometries —
+# transformer-base splits the FFN into a second launch by design (the
+# FFN weights alone are ~8 MB), the small geometry fuses it
+_MEGASTEP_MATRIX = [
+    dict(label="megastep-base", dm=512, h=8, dh=64, di=2048, max_t=128,
+         cross_t=256, dtype="float32", expect_fuse_ffn=False),
+    dict(label="megastep-fused-ffn", dm=128, h=8, dh=64, di=256,
+         max_t=128, cross_t=128, dtype="float32", expect_fuse_ffn=True),
+    # the CI smoke config (dm=128, h=4, dh=32): dh %% 64 rejects by
+    # design -> composed XLA fallback, numerically identical
+    dict(label="megastep-smoke-dh32", dm=128, h=4, dh=32, di=256,
+         max_t=128, cross_t=128, dtype="float32", must_accept=False),
+    # bf16 with h=8 violates the 16-sublane [h, t, d] walk tiling ->
+    # rejects by design (same contract as decode_attention bf16-h8)
+    dict(label="megastep-bf16-h8", dm=512, h=8, dh=64, di=2048,
+         max_t=128, cross_t=256, dtype="bfloat16", must_accept=False),
+]
+
 _EMBEDDING_MATRIX = [
     # deepfm: 26 slots x [10001, 10] emb tables + [10001, 1] w1 tables
     dict(label="deepfm-emb", tables=[((10001, 10), "float32")] * 26,
@@ -609,6 +696,21 @@ def lint_kernel_plans() -> Tuple[List[Finding], Dict[str, Any]]:
         rows.append(dict(label=cfg["label"], accepted=bool(ok),
                          block_t=int(bt)))
     report["decode_attention"] = rows
+
+    from ..kernels import decode_step as kds
+
+    rows = []
+    for cfg in _MEGASTEP_MATRIX:
+        with _pretend_tpu():
+            plan = kds._megastep_plan(
+                cfg["dm"], cfg["h"], cfg["dh"], cfg["di"], cfg["max_t"],
+                cfg["cross_t"], cfg["dtype"])
+        check_megastep_plan(cfg, plan, findings)
+        rows.append(dict(label=cfg["label"], accepted=bool(plan.ok),
+                         fuse_ffn=bool(plan.fuse_ffn),
+                         block_t=int(plan.block_t),
+                         cross_block_t=int(plan.cross_block_t)))
+    report["decode_step"] = rows
 
     # ring attention reuses the attention _plan gate per sequence CHUNK
     # (kernels/ring_attention.py); audit the real per-rank chunk shapes

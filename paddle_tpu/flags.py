@@ -191,6 +191,20 @@ FLAGS.define(
     "loop; off = the per-step full-prefix recompute route, output-"
     "identical (parity asserted in tests/test_generation.py)")
 FLAGS.define(
+    "fused_decode_step", bool, True,
+    "cached_decoder_step lowers each decoder layer of the per-token "
+    "decode program to ONE fused_decode_step op (kernels/decode_step.py "
+    "per-layer Pallas megastep: qkv projection, in-place cache row write "
+    "at the runtime counter, single-query online-softmax walk, output "
+    "projection, residual+layer-norm epilogues — q/k/v and the attention "
+    "context never exist in HBM), and greedy kv-cache decode programs "
+    "self-feed the sampled token through scope state (the host stops "
+    "round-tripping it); off = the reference per-layer composition "
+    "(fc + kv_cache_update + decode_attention + fc + layer_norm chain), "
+    "graphs op-for-op identical to the pre-fusion ones and parameter "
+    "names unchanged (checkpoints interop); off-contract shapes run the "
+    "numerically-identical XLA fallback inside the op")
+FLAGS.define(
     "flash_decode", bool, True,
     "the decode_attention op lowers to the Pallas single-query flash-"
     "decode kernel (kernels/decode_attention.py: one q row against the "

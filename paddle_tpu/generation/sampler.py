@@ -57,6 +57,19 @@ class GenerationSession:
         if p.kv_cache:
             p.self_cache.allocate(self.scope)
             p.cross_cache.allocate(self.scope)
+            if getattr(p, "self_feed_token", False):
+                # greedy self-feed state (FLAGS_fused_decode_step):
+                # the decode program reads/latches these in-graph; the
+                # prefill's active mask resets joining lanes, so a
+                # BOS/zero fill here only pins the scope signature
+                import jax
+
+                i64 = jax.dtypes.canonicalize_dtype(np.int64)
+                self.scope.set_var(
+                    p.last_tok_name,
+                    jnp.full((p.lanes, 1), p.bos_id, i64))
+                self.scope.set_var(
+                    p.finished_name, jnp.zeros((p.lanes,), jnp.int32))
         else:
             self.scope.set_var(
                 p.enc_out_name,
@@ -100,16 +113,19 @@ class GenerationSession:
     def decode_step(self, tokens, active=None, prefix=None, t=None):
         """One decode step -> next token per lane [lanes, 1] int64.
 
-        Cached route: feed the last token (+ active mask).  Recompute
+        Cached route: feed the last token (+ active mask) — unless the
+        program self-feeds (greedy under FLAGS_fused_decode_step: the
+        token lives in scope state and `tokens` is ignored).  Recompute
         route: feed the full host-maintained prefix buffer and the step
         index instead (tokens/active are ignored)."""
         p = self.p
         if p.kv_cache:
             a = (np.ones((p.lanes, 1), np.float32) if active is None
                  else np.asarray(active, np.float32).reshape(p.lanes, 1))
-            feed = {"gen_token":
-                    np.asarray(tokens, np.int64).reshape(p.lanes, 1),
-                    "gen_active": a}
+            feed = {"gen_active": a}
+            if not getattr(p, "self_feed_token", False):
+                feed["gen_token"] = np.asarray(
+                    tokens, np.int64).reshape(p.lanes, 1)
         else:
             feed = {"gen_prefix":
                     np.asarray(prefix, np.int64).reshape(

@@ -560,8 +560,24 @@ def cached_decoder_step(dec_input, self_cache, cross_cache, write_pos,
     cross-attention over cross_lens rows of the prefilled cross cache,
     then the feed-forward — the op-for-op cached counterpart of
     decoder_layer (same post-process "dan" chain, same parameter-name
-    draws), minus the O(T²) full-prefix recompute."""
+    draws), minus the O(T²) full-prefix recompute.
+
+    Under FLAGS_fused_decode_step (default on) each layer lowers to ONE
+    fused_decode_step op instead of the ~10-op composition below —
+    kernels/decode_step.py runs the whole layer per Pallas launch (or
+    its numerically-identical XLA fallback off-contract/off-TPU).
+    Parameter names, shapes and draw order are EXACTLY the flag-off
+    path's, so checkpoints interop across the flag; flag-off graphs are
+    op-for-op identical to the pre-fusion ones (asserted in
+    tests/test_decode_step.py)."""
     from ..core.framework import unique_name
+    from ..flags import FLAGS
+
+    if FLAGS.fused_decode_step and dropout_rate == 0.0 and d_key == d_value:
+        return _fused_cached_decoder_step(
+            dec_input, self_cache, cross_cache, write_pos, self_lens,
+            cross_lens, n_layer, n_head, d_key, d_value, d_model,
+            d_inner_hid, active=active)
 
     x = dec_input
     b = x.shape[0]
@@ -595,6 +611,92 @@ def cached_decoder_step(dec_input, self_cache, cross_cache, write_pos,
         x = pre_post_process_layer(x, cross_out, "dan", dropout_rate)
         ffd = positionwise_feed_forward(x, d_inner_hid, d_model)
         x = pre_post_process_layer(x, ffd, "dan", dropout_rate)
+    return x
+
+
+def _fused_cached_decoder_step(dec_input, self_cache, cross_cache,
+                               write_pos, self_lens, cross_lens, n_layer,
+                               n_head, d_key, d_value, d_model,
+                               d_inner_hid, active=None):
+    """The FLAGS_fused_decode_step lowering of cached_decoder_step: one
+    fused_decode_step op per layer (ops/generation_ops.py ->
+    kernels/decode_step.py).  Parameters are created through the SAME
+    LayerHelper recipes and unique_name draws as the composition —
+    attn_qkv_w, attn_out_w, layer_norm, attn_q_w, attn_out_w,
+    layer_norm, ffn_in_w/b, ffn_out_w/b, layer_norm per layer — so a
+    scope trained with `transformer(...)` runs either path and the
+    flag-off graph's names never shift."""
+    from ..core.framework import unique_name
+    from ..initializer import ConstantInitializer
+    from ..layer_helper import LayerHelper
+
+    x = dec_input
+    dtype = x.dtype
+
+    def fc_param(key, shape):
+        helper = LayerHelper(
+            "fc", param_attr=ParamAttr(name=unique_name(key)))
+        return helper.create_parameter(helper.param_attr(), shape=shape,
+                                       dtype=dtype)
+
+    def fc_bias(key, shape):
+        helper = LayerHelper(
+            "fc", bias_attr=ParamAttr(name=unique_name(key)))
+        return helper.create_parameter(helper.bias_attr(), shape=shape,
+                                       dtype=dtype, is_bias=True)
+
+    def ln_params():
+        helper = LayerHelper("layer_norm",
+                             param_attr=ParamAttr(initializer=None))
+        scale = helper.create_parameter(
+            helper.param_attr(), shape=[d_model], dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        bias = helper.create_parameter(
+            helper.bias_attr(), shape=[d_model], dtype=dtype,
+            is_bias=True)
+        return scale, bias
+
+    cache_k, cache_v, _ = self_cache.vars_in()
+    cross_k, cross_v, _ = cross_cache.vars_in()
+    for i in range(n_layer):
+        w_qkv = fc_param("attn_qkv_w", [d_model, 3 * d_key * n_head])
+        w_out = fc_param("attn_out_w", [n_head * d_value, d_model])
+        ln1_s, ln1_b = ln_params()
+        w_cq = fc_param("attn_q_w", [d_model, d_key * n_head])
+        w_cout = fc_param("attn_out_w", [n_head * d_value, d_model])
+        ln2_s, ln2_b = ln_params()
+        ffn_iw = fc_param("ffn_in_w", [d_model, d_inner_hid])
+        ffn_ib = fc_bias("ffn_in_b", [d_inner_hid])
+        ffn_ow = fc_param("ffn_out_w", [d_inner_hid, d_model])
+        ffn_ob = fc_bias("ffn_out_b", [d_model])
+        ln3_s, ln3_b = ln_params()
+
+        helper = LayerHelper("fused_decode_step")
+        out = helper.create_variable_for_type_inference(dtype)
+        inputs = {
+            "X": [x], "WQkv": [w_qkv], "WOut": [w_out],
+            "Ln1Scale": [ln1_s], "Ln1Bias": [ln1_b], "WCq": [w_cq],
+            "WCOut": [w_cout], "Ln2Scale": [ln2_s], "Ln2Bias": [ln2_b],
+            "FfnInW": [ffn_iw], "FfnInB": [ffn_ib], "FfnOutW": [ffn_ow],
+            "FfnOutB": [ffn_ob], "Ln3Scale": [ln3_s], "Ln3Bias": [ln3_b],
+            "CacheK": [cache_k], "CacheV": [cache_v],
+            "CrossK": [cross_k], "CrossV": [cross_v],
+            "Pos": [write_pos], "Lengths": [self_lens],
+            "CrossLengths": [cross_lens],
+        }
+        if active is not None:
+            inputs["Active"] = [active]
+        # cache outputs carry the SAME var objects — the persistable
+        # read-then-write the executor donates (kv_cache_update contract
+        # verbatim)
+        helper.append_op(
+            "fused_decode_step", inputs=inputs,
+            outputs={"Out": [out], "CacheKOut": [cache_k],
+                     "CacheVOut": [cache_v]},
+            attrs={"layer": i, "n_head": n_head, "scale": d_key ** -0.5,
+                   "epsilon": 1e-5})
+        out.shape = list(x.shape)
+        x = out
     return x
 
 
@@ -975,11 +1077,25 @@ def build_generation_programs(
                           _cache_rows(src_seq_len), n_head, d_key)
     enc_out_name = f"{cache_prefix}_enc_out"
     src_bias_name = f"{cache_prefix}_src_bias"
+    last_tok_name = f"{cache_prefix}_last_tok"
+    finished_name = f"{cache_prefix}_finished"
+    # greedy self-feed (FLAGS_fused_decode_step tail trim): the decode
+    # program reads its own last sampled token from scope state instead
+    # of a host feed, and latches eos in-graph exactly like the host
+    # loop's masking — the per-token host round-trip of the argmax
+    # disappears.  Sampled/beam paths are unchanged (they need the host
+    # token stream / beam state anyway).
+    use_self_feed = bool(kv_cache and beam_size is None
+                         and strategy == "greedy"
+                         and FLAGS.fused_decode_step)
+
+    def state_var(name, shape, dtype):
+        return fw.default_main_program().global_block().create_var(
+            name=name, shape=list(shape), dtype=dtype,
+            persistable=True, stop_gradient=True)
 
     def aux_var(name, shape):
-        return fw.default_main_program().global_block().create_var(
-            name=name, shape=list(shape), dtype="float32",
-            persistable=True, stop_gradient=True)
+        return state_var(name, shape, "float32")
 
     with fw.guard_unique_name():
         # ---- prefill ----------------------------------------------------
@@ -1040,6 +1156,28 @@ def build_generation_programs(
                     output=cross_len)
                 layers.assign(layers.elementwise_mul(inv, self_len),
                               output=self_len)
+                if use_self_feed:
+                    # self-feed state: joining lanes restart from BOS
+                    # with a cleared finished latch; the rest keep their
+                    # in-flight token (continuous batching's late joins)
+                    last_tok = state_var(last_tok_name, (lanes, 1),
+                                         "int64")
+                    fin = state_var(finished_name, (lanes,), "int32")
+                    a64 = layers.cast(a32, "int64")
+                    inv64 = layers.cast(inv, "int64")
+                    bos_c = layers.fill_constant([lanes], "int64",
+                                                 bos_id)
+                    layers.assign(
+                        layers.reshape(
+                            layers.elementwise_add(
+                                layers.elementwise_mul(a64, bos_c),
+                                layers.elementwise_mul(
+                                    inv64,
+                                    layers.reshape(last_tok, [lanes]))),
+                            [lanes, 1]),
+                        output=last_tok)
+                    layers.assign(layers.elementwise_mul(inv, fin),
+                                  output=fin)
             else:
                 layers.assign(enc_output,
                               output=aux_var(enc_out_name,
@@ -1053,8 +1191,14 @@ def build_generation_programs(
         # ---- decode -----------------------------------------------------
         with fw.program_guard(decode, startup):
             if beam_size is None:
-                token = layers.data(name="gen_token", shape=[1],
-                                    dtype="int64")
+                if use_self_feed:
+                    # scope-resident token state (read-then-written, so
+                    # the executor donates it like the cache counters)
+                    token = state_var(last_tok_name, (lanes, 1), "int64")
+                    fin = state_var(finished_name, (lanes,), "int32")
+                else:
+                    token = layers.data(name="gen_token", shape=[1],
+                                        dtype="int64")
                 dactive = layers.data(name="gen_active", shape=[1],
                                       dtype="float32")
                 if kv_cache:
@@ -1087,6 +1231,34 @@ def build_generation_programs(
                         layers.reshape(logits, [lanes, trg_vocab_size]),
                         strategy=strategy, temperature=temperature,
                         top_k=top_k)
+                    if use_self_feed:
+                        # in-graph eos latch — the exact host masking of
+                        # GenerationSession.generate: finished lanes
+                        # keep emitting (and self-feeding) eos, the
+                        # latch ORs in fresh eos hits.  The masked token
+                        # both writes the self-feed state and is the
+                        # fetch, so host and device streams stay
+                        # bit-identical.
+                        eos_c = layers.fill_constant([lanes, 1], "int64",
+                                                     eos_id)
+                        one_c = layers.fill_constant([lanes, 1], "int64",
+                                                     1)
+                        fin64 = layers.cast(
+                            layers.reshape(fin, [lanes, 1]), "int64")
+                        not_fin = layers.elementwise_sub(one_c, fin64)
+                        masked = layers.elementwise_add(
+                            layers.elementwise_mul(fin64, eos_c),
+                            layers.elementwise_mul(not_fin, next_tok))
+                        is_eos = layers.reshape(
+                            layers.cast(layers.equal(masked, eos_c),
+                                        "int32"), [lanes])
+                        layers.assign(
+                            layers.elementwise_sub(
+                                layers.elementwise_add(fin, is_eos),
+                                layers.elementwise_mul(fin, is_eos)),
+                            output=fin)
+                        layers.assign(masked, output=token)
+                        next_tok = masked
                     # advance the counters of the stepped sequences LAST
                     # (every read above wants the pre-step lengths)
                     layers.assign(att_len, output=self_len)
@@ -1203,10 +1375,20 @@ def build_generation_programs(
                     parents=parent_steps)
                 hyps_fetch = [sent_ids.name, sent_scores.name]
 
+    if beam_size is not None:
+        decode_feeds = ["gen_pre_ids", "gen_pre_scores", "gen_parents"]
+    elif not kv_cache:
+        decode_feeds = ["gen_prefix", "gen_t"]
+    elif use_self_feed:
+        decode_feeds = ["gen_active"]
+    else:
+        decode_feeds = ["gen_token", "gen_active"]
     return GenerationPrograms(
         prefill=prefill, decode=decode, hyps=hyps, startup=startup,
         self_cache=self_cache, cross_cache=cross_cache,
         enc_out_name=enc_out_name, src_bias_name=src_bias_name,
+        self_feed_token=use_self_feed, last_tok_name=last_tok_name,
+        finished_name=finished_name, decode_feeds=decode_feeds,
         prefill_fetch=prefill_fetch, decode_fetch=decode_fetch,
         hyps_fetch=hyps_fetch if hyps is not None else None,
         batch_size=b, beam_size=beam_size, lanes=lanes,

@@ -14,6 +14,11 @@ Ops (all no_grad — generation never differentiates through the cache):
   decode_attention  one query row against the length-masked cache
                     (kernels/decode_attention.py flash-decode kernel or
                     its XLA fallback, FLAGS_flash_decode)
+  fused_decode_step ONE whole decoder layer per launch at decode time
+                    (kernels/decode_step.py megastep or its XLA
+                    composition fallback, FLAGS_fused_decode_step);
+                    carries the kv_cache_update donation contract on the
+                    cache vars verbatim
   kv_cache_reorder  gather cache slots along batch (beam-search parent
                     reordering; all layers in one op)
   sample_token      greedy / temperature / top-k next-token selection;
@@ -65,6 +70,50 @@ def lower_kv_cache_update(ctx, ins):
 
     return {"CacheKOut": [write(cache_k, k_new)],
             "CacheVOut": [write(cache_v, v_new)]}
+
+
+#: input slot order of fused_decode_step, mirrored by the kernel
+#: dispatcher's positional signature (models/transformer.py appends the
+#: op with exactly these slots)
+_FUSED_STEP_SLOTS = (
+    "X", "WQkv", "WOut", "Ln1Scale", "Ln1Bias", "WCq", "WCOut",
+    "Ln2Scale", "Ln2Bias", "FfnInW", "FfnInB", "FfnOutW", "FfnOutB",
+    "Ln3Scale", "Ln3Bias", "CacheK", "CacheV", "CrossK", "CrossV",
+    "Pos", "Lengths", "CrossLengths")
+
+
+def _fused_step_infer(ctx):
+    xs = ctx.input_shape("X")
+    if xs is not None:
+        ctx.set_output("Out", tuple(xs), ctx.input_dtype("X"))
+    _cache_infer(ctx)
+
+
+@register("fused_decode_step", no_grad=True, infer_shape=_fused_step_infer,
+          inplace_outputs={"CacheKOut": "CacheK", "CacheVOut": "CacheV"})
+def lower_fused_decode_step(ctx, ins):
+    """One fused decoder layer over a single embedded token X
+    [b, 1, d_model]: qkv projection, in-place cache row write at Pos,
+    the online-softmax walk over the first Lengths rows, output
+    projection + norm, the cross-attention walk over CrossLengths rows
+    of the prefilled cross cache, and the feed-forward + final norm —
+    ONE Pallas launch per layer when kernels/decode_step.py's plan gate
+    accepts (two when the FFN weights exceed the VMEM budget), the
+    numerically-identical XLA composition otherwise.  CacheKOut/
+    CacheVOut carry the SAME var names as CacheK/CacheV: the executor
+    donates the ring buffers exactly as it does for kv_cache_update."""
+    from ..kernels import decode_step as kds
+
+    args = [ins[slot][0] for slot in _FUSED_STEP_SLOTS]
+    active = ins.get("Active", [None])[0]
+    out, cache_k, cache_v = kds.fused_decode_step(
+        *args, active,
+        layer=int(ctx.attr("layer", 0)),
+        n_head=int(ctx.attr("n_head", 1)),
+        scale=float(ctx.attr("scale", 1.0)),
+        eps=float(ctx.attr("epsilon", 1e-5)))
+    return {"Out": [out], "CacheKOut": [cache_k],
+            "CacheVOut": [cache_v]}
 
 
 def _decode_attn_infer(ctx):

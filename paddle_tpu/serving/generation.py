@@ -176,8 +176,16 @@ class GenerationServingModel:
         monitor.gauge(f"generation.{self.name}.slots").set(self.slots)
         p = self.session.p
         for tag, prog in (("prefill", p.prefill), ("decode", p.decode)):
-            publish_cost(cost_program(prog, name=f"gen.{self.name}.{tag}",
-                                      batch_size=self.slots))
+            cost = cost_program(prog, name=f"gen.{self.name}.{tag}",
+                                batch_size=self.slots)
+            publish_cost(cost)
+            if tag == "decode":
+                # the megastep scoreboard: fusion-corrected launches per
+                # generated token (FLAGS_fused_decode_step collapses the
+                # per-layer op chains into 1-2 launches each)
+                monitor.gauge(
+                    f"generation.{self.name}.launches_per_token").set(
+                    cost.n_launches_fused)
 
     @property
     def compile_count(self) -> int:

@@ -418,8 +418,10 @@ class TestGenerationStaticAnalysis:
             for prog, feeds, fetch in (
                     (p.prefill, ["src_word", "src_pos", "gen_active"],
                      p.prefill_fetch),
-                    (p.decode, ["gen_token", "gen_active"],
-                     p.decode_fetch)):
+                    # greedy self-feeds the token under
+                    # FLAGS_fused_decode_step; decode_feeds names the
+                    # route's actual feed list
+                    (p.decode, p.decode_feeds, p.decode_fetch)):
                 findings = verify_program(prog, feed_names=feeds,
                                           fetch_names=fetch,
                                           check_dead=True)

@@ -33,6 +33,9 @@ PUBLIC_API = [
     # numerics_pack/numerics_zeros (analysis/numerics.py —
     # instrument_program / maybe_instrument are the public way)
     "analysis/numerics.py",
+    # the decode megastep: build_generation_programs emits
+    # fused_decode_step under FLAGS_fused_decode_step
+    "models/transformer.py",
 ]
 
 # Ops a user never spells: emitted by the executor/backward/compiler

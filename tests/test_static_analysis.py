@@ -320,6 +320,7 @@ class TestNoFalsePositives:
         assert set(report) == {
             "attention", "qkv_attention", "conv_bn", "dropout_epilogue",
             "embedding", "ring_attention", "decode_attention",
+            "decode_step",
         }
         for fam, rows in report.items():
             assert rows, fam

@@ -195,8 +195,7 @@ def build_generation():
                         ["src_word", "src_pos", "gen_active"],
                         progs.prefill_fetch, progs.startup))
         out.append((f"generation/decode-{strat}", progs.decode,
-                    ["gen_token", "gen_active"], progs.decode_fetch,
-                    None))
+                    progs.decode_feeds, progs.decode_fetch, None))
     return out
 
 

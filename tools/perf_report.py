@@ -118,8 +118,13 @@ def roofline_section(names, device_name, batch_size, top):
             f"per-token step is dispatch overhead "
             f"({decode_cost.n_launches} launches x "
             f"{decode_cost.device.launch_overhead_s * 1e6:.1f} us on "
-            f"{decode_cost.device.name}, {decode_cost.device.source}) — "
-            f"re-estimate on chip before committing to the megakernel")
+            f"{decode_cost.device.name}, {decode_cost.device.source}); "
+            f"fusion-corrected {decode_cost.launch_bound_fraction_fused:.1%} "
+            f"({decode_cost.n_launches_fused} launches after charging "
+            f"compiler-fused epilogue ops zero) — the corrected number is "
+            f"the one to hold against the executor's measured dispatch_s "
+            f"split, and FLAGS_fused_decode_step's megastep path is what "
+            f"drives it down")
         out.append("")
     return "\n".join(out)
 
@@ -164,7 +169,10 @@ def predicted_vs_measured(recs):
     """One line per record carrying cost-probe fields: predicted (static
     model) vs measured (the bench number) step time and their ratio.
     Ratio >> 1 = the model overcharges (fusion merged launches, shapes
-    overstated); << 1 = hidden costs the model misses."""
+    overstated); << 1 = hidden costs the model misses.  pred_f/ratio_f
+    repeat the prediction with the fusion-corrected launch count
+    (cost_predicted_step_us_fused) — the r13 decode bias fix: epilogue
+    ops XLA fuses into their producers no longer charge a dispatch."""
     rows = []
     for rec in recs:
         cfg = rec.get("config") or {}
@@ -172,8 +180,11 @@ def predicted_vs_measured(recs):
         meas_s = _measured_step_seconds(rec)
         if pred_us is None or meas_s is None or meas_s <= 0:
             continue
+        pred_f = cfg.get("cost_predicted_step_us_fused")
         rows.append((rec["metric"], pred_us, meas_s * 1e6,
                      pred_us / (meas_s * 1e6),
+                     pred_f,
+                     (pred_f / (meas_s * 1e6)) if pred_f else None,
                      cfg.get("cost_launch_bound_fraction"),
                      cfg.get("cost_device", "?")))
     if not rows:
@@ -182,11 +193,14 @@ def predicted_vs_measured(recs):
                 "stamps config.cost_predicted_step_us)\n")
     out = ["== Predicted vs measured (per one-step program call) =="]
     out.append(f"  {'metric':44s} {'pred us':>10s} {'meas us':>10s} "
-               f"{'ratio':>7s} {'launch%':>8s}  device")
-    for m, p, s, r, lf, dev in rows:
+               f"{'ratio':>7s} {'pred_f':>10s} {'ratio_f':>7s} "
+               f"{'launch%':>8s}  device")
+    for m, p, s, r, pf, rf, lf, dev in rows:
         lf_s = f"{lf:.1%}" if lf is not None else "?"
-        out.append(f"  {m:44s} {p:10.1f} {s:10.1f} {r:7.3f} {lf_s:>8s}"
-                   f"  {dev}")
+        pf_s = f"{pf:10.1f}" if pf is not None else f"{'?':>10s}"
+        rf_s = f"{rf:7.3f}" if rf is not None else f"{'?':>7s}"
+        out.append(f"  {m:44s} {p:10.1f} {s:10.1f} {r:7.3f} {pf_s} {rf_s} "
+                   f"{lf_s:>8s}  {dev}")
     out.append("")
     return "\n".join(out)
 

@@ -175,7 +175,7 @@ PY
   # FLAGS_kv_cache=0 full-prefix-recompute baseline record; every record
   # must carry compile_flat=true — the executor compile cache may NOT
   # grow across generated tokens (the length-independent-key contract)
-  python -W error::UserWarning bench.py --model decode --smoke \
+  python -W error::UserWarning bench.py --model decode --smoke --runs 3 \
     | tee ci_artifacts/bench_decode_smoke.json
   FLAGS_kv_cache=0 python -W error::UserWarning bench.py \
     --model decode --smoke | tee -a ci_artifacts/bench_decode_smoke.json
@@ -188,6 +188,25 @@ flags = {r["config"]["kv_cache"] for r in recs}
 assert flags == {True, False}, f"need a cached AND a recompute record: {flags}"
 bad = [r for r in recs if not r["config"]["compile_flat"]]
 assert not bad, f"executor compile cache grew across generated tokens: {bad}"
+# megastep gate (PERF.md r15): the cached run emits fused/unfused PAIRS;
+# at batch 1 the fused decode program may not lose to the unfused one.
+# Noise-aware like bench_diff: red only when the run envelopes SEPARATE
+# (best fused repeat below the worst unfused repeat) — CPU-box b1
+# tokens/sec jitters +-15% run to run
+cached = [r for r in recs if r["config"]["kv_cache"]]
+pairs = {r["metric"]: r for r in cached}
+fused = pairs.get("decode_tokens_per_sec_b1")
+unfused = pairs.get("decode_tokens_per_sec_b1_unfused")
+assert fused is not None and unfused is not None, \
+    f"need the fused/unfused b1 pair, have {sorted(pairs)}"
+assert fused["config"]["fused_decode_step"] is True
+assert unfused["config"]["fused_decode_step"] is False
+assert max(fused["config"]["runs"]) >= min(unfused["config"]["runs"]), (
+    f"fused decode LOST to unfused at b1 beyond noise: fused runs "
+    f"{fused['config']['runs']} vs unfused {unfused['config']['runs']}")
+print(f"decode megastep gate OK: fused b1 {fused['value']:.1f} vs "
+      f"unfused {unfused['value']:.1f} tokens/sec "
+      f"(runs {fused['config']['runs']} / {unfused['config']['runs']})")
 print("decode A/B records OK:", [(r["config"]["kv_cache"], r["metric"],
                                   r["value"]) for r in recs])
 PY
