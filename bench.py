@@ -576,6 +576,41 @@ def bench_transformer(batch_size=32, seq_len=256, scan_steps=8, calls=4,
     return [toks / d for d in dt], flops_tok, first_loss, last_loss, mem
 
 
+# fixed HBM budget the decode records' serving-capacity gauge is quoted
+# against: concurrent_slots_at_budget = how many sequences of the
+# benched shape fit this many KV bytes.  The ring layout charges every
+# sequence its full ring rows; the paged layout charges only the blocks
+# the sequence touches — tools/run_ci.sh gates the paged/ring ratio.
+KV_CAPACITY_BUDGET_BYTES = 64 << 20
+
+
+def _kv_capacity(progs, batch_size, src_len, max_tokens):
+    """Serving-capacity fields for one decode record: bytes one
+    sequence of this workload's shape holds resident, the slot count at
+    the fixed budget, and the planner's kv_cache row (the same number
+    hlo_diag --memory prints — keeps the bench and the planner honest
+    against each other)."""
+    from paddle_tpu import memory as M
+
+    self_c, cross_c = progs.self_cache, progs.cross_cache
+    if getattr(progs, "paged", False):
+        per_seq = (self_c.blocks_for(max_tokens) * self_c.block_bytes
+                   + cross_c.blocks_for(src_len) * cross_c.block_bytes)
+    else:
+        per_seq = (self_c.hbm_bytes + cross_c.hbm_bytes) // batch_size
+    kv_row = M.plan_program(progs.decode, [], []).class_peaks.get(
+        "kv_cache", 0)
+    budget = KV_CAPACITY_BUDGET_BYTES
+    return {
+        "paged": bool(getattr(progs, "paged", False)),
+        "kv_bytes_per_seq": int(per_seq),
+        "kv_budget_bytes": int(budget),
+        "concurrent_slots_at_budget": int(budget // max(per_seq, 1)),
+        "planner_kv_cache_bytes": int(kv_row),
+        "kv_resident_gb": (self_c.hbm_bytes + cross_c.hbm_bytes) / 1e9,
+    }
+
+
 def bench_decode(batch_size=1, max_tokens=64, tiny=False, repeats=1,
                  use_flash=True):
     """Autoregressive decode tokens/sec (ROADMAP item 2's named metric:
@@ -650,6 +685,10 @@ def bench_decode(batch_size=1, max_tokens=64, tiny=False, repeats=1,
     # static roofline attribution of the per-token decode program — the
     # launch-bound-fraction input ROADMAP item 1 reads off this record
     cost = cost_probe(progs.decode, batch_size, "bench.decode")
+    if progs.kv_cache:
+        cost = dict(cost)
+        cost.update(_kv_capacity(progs, batch_size, cfg["src_len"],
+                                 max_tokens))
     return runs, prefill_s, compile_flat, sess.compile_count, cost
 
 
@@ -699,8 +738,41 @@ def run_decode(args, peak):
                       "runs": [round(r, 1) for r in run_list],
                       "spread": round(spread, 1)}
             config.update(cost)
+            if config.get("kv_resident_gb"):
+                # ROADMAP item 2's capacity-efficiency metric, bench-side
+                config["tokens_per_sec_per_hbm_gb"] = round(
+                    tps / config["kv_resident_gb"], 1)
             emit_metric(
                 f"decode_tokens_per_sec_b{bs}{suffix}", tps, "tokens/sec",
+                None, None, 0.0, config)
+        if FLAGS.kv_cache and not FLAGS.paged_kv_cache:
+            # paired paged record next to the ring one: same shape, the
+            # block-pool cache layout — run_ci's capacity gate reads the
+            # concurrent_slots_at_budget ratio off this pair
+            try:
+                FLAGS.set("paged_kv_cache", True)
+                runs, prefill_s, flat, n_compiles, cost = bench_decode(
+                    batch_size=bs, max_tokens=max_tokens, tiny=args.smoke,
+                    repeats=repeats)
+            finally:
+                FLAGS.reset("paged_kv_cache")
+            tps, spread, run_list = _mean_spread(runs)
+            config = {"batch": bs, "max_tokens": max_tokens,
+                      "tiny": args.smoke,
+                      "kv_cache": bool(FLAGS.kv_cache),
+                      "flash_decode": bool(FLAGS.flash_decode),
+                      "fused_decode_step": bool(FLAGS.fused_decode_step),
+                      "prefill_ms": round(prefill_s * 1e3, 2),
+                      "compile_flat": bool(flat),
+                      "compiled_signatures": n_compiles,
+                      "runs": [round(r, 1) for r in run_list],
+                      "spread": round(spread, 1)}
+            config.update(cost)
+            if config.get("kv_resident_gb"):
+                config["tokens_per_sec_per_hbm_gb"] = round(
+                    tps / config["kv_resident_gb"], 1)
+            emit_metric(
+                f"decode_tokens_per_sec_b{bs}_paged", tps, "tokens/sec",
                 None, None, 0.0, config)
 
 

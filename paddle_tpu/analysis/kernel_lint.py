@@ -397,6 +397,125 @@ def check_megastep_plan(cfg: dict, plan, findings: List[Finding]):
             f"(fuse_ffn={plan.fuse_ffn})", fam, label))
 
 
+def check_paged_decode_plan(cfg: dict, ok, block_t, interpret,
+                            findings: List[Finding]):
+    """Paged flash-decode plan (kernels/decode_attention.py
+    _paged_plan): single-query attention walking [num_blocks, block_t,
+    h, dh] pool tiles at scalar-prefetched block-table addresses.
+    block_t is fixed by the pool geometry — a misaligned pool must
+    REJECT (no snapping), and an accepted table must fit the SMEM
+    scalar-prefetch cap."""
+    from ..kernels import decode_attention as kda
+
+    fam, label = "paged_decode_attention", cfg["label"]
+    b, h, dh = cfg["b"], cfg["h"], cfg["dh"]
+    bt, mb = cfg["block_t"], cfg["max_blocks"]
+    esize = _np_dtype(cfg["dtype"]).itemsize
+    sub = _sublane(cfg["dtype"])
+    if cfg.get("must_accept", True) and not ok:
+        findings.append(_finding(
+            "kernel-plan-reject",
+            f"plan gate rejects the canonical pool shape b={b} h={h} "
+            f"dh={dh} block_t={bt} max_blocks={mb} {cfg['dtype']} — "
+            f"paged decode would silently gather the whole pool through "
+            f"the XLA fallback", fam, label))
+        return
+    if not cfg.get("must_accept", True) and ok:
+        findings.append(_finding(
+            "kernel-plan-reject",
+            f"plan gate ACCEPTS an off-contract pool (block_t={bt}, "
+            f"b*max_blocks={b * mb}) it is required to reject — the "
+            f"kernel would DMA misaligned tiles or overflow the SMEM "
+            f"table", fam, label))
+        return
+    if not ok:
+        return
+    if block_t % 8 or dh % 64 or h % sub:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"accepted plan violates tiling: block_t={block_t} %% 8, "
+            f"dh={dh} %% 64 or n_head={h} %% {sub}", fam, label))
+    if b * mb > kda._PAGED_TABLE_CAP:
+        findings.append(_finding(
+            "kernel-vmem-budget",
+            f"accepted table {b}x{mb} exceeds the "
+            f"{kda._PAGED_TABLE_CAP}-entry scalar-prefetch cap the gate "
+            f"claims to enforce", fam, label))
+    resident = 2 * block_t * h * dh * (esize + 4) + h * block_t * 4
+    if resident > 4 * 1024 * 1024:
+        findings.append(_finding(
+            "kernel-vmem-budget",
+            f"paged decode working set {resident} bytes exceeds the "
+            f"4 MB budget the gate claims to enforce", fam, label))
+
+
+def check_paged_megastep_plan(cfg: dict, plan, findings: List[Finding]):
+    """Paged fused decode megastep plan (kernels/decode_step.py
+    _paged_megastep_plan): the ring megastep's contract plus both
+    flattened block tables under the scalar-prefetch cap; walk blocks
+    are fixed by the pool geometry (reject, never snap)."""
+    from ..kernels import decode_attention as kda
+    from ..kernels import decode_step as kds
+
+    fam, label = "paged_decode_step", cfg["label"]
+    dm, h, dh, di = cfg["dm"], cfg["h"], cfg["dh"], cfg["di"]
+    bt, cbt = cfg["block_t"], cfg["cross_block_t"]
+    b, mb, cmb = cfg["b"], cfg["max_blocks"], cfg["cross_max_blocks"]
+    esize = _np_dtype(cfg["dtype"]).itemsize
+    sub = _sublane(cfg["dtype"])
+    if cfg.get("must_accept", True) and not plan.ok:
+        findings.append(_finding(
+            "kernel-plan-reject",
+            f"plan gate rejects the canonical paged layer shape dm={dm} "
+            f"h={h} dh={dh} di={di} block_t={bt} cross_block_t={cbt} "
+            f"tables {b}x{mb}/{b}x{cmb} {cfg['dtype']} — decode falls "
+            f"back to the per-op launch storm the megastep exists to "
+            f"collapse", fam, label))
+        return
+    if not cfg.get("must_accept", True) and plan.ok:
+        findings.append(_finding(
+            "kernel-plan-reject",
+            f"plan gate ACCEPTS an off-contract paged layer (block_t="
+            f"{bt}, tables {b * mb}/{b * cmb} entries) it is required "
+            f"to reject", fam, label))
+        return
+    if not plan.ok:
+        return
+    if "expect_fuse_ffn" in cfg and plan.fuse_ffn != cfg["expect_fuse_ffn"]:
+        findings.append(_finding(
+            "kernel-fusion-mode",
+            f"plan fuses the FFN={plan.fuse_ffn}, expected "
+            f"{cfg['expect_fuse_ffn']}", fam, label))
+    if plan.block_t % 8 or plan.cross_block_t % 8:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"blocks ({plan.block_t},{plan.cross_block_t}) are not "
+            f"8-sublane aligned", fam, label))
+    if dh % 64 or dm % _LANE or di % _LANE or h % sub:
+        findings.append(_finding(
+            "kernel-misaligned-block",
+            f"dh {dh} %% 64, dm {dm} %% 128, di {di} %% 128 or n_head "
+            f"{h} %% {sub} misaligned", fam, label))
+    if b * mb > kda._PAGED_TABLE_CAP or b * cmb > kda._PAGED_TABLE_CAP:
+        findings.append(_finding(
+            "kernel-vmem-budget",
+            f"accepted tables {b}x{mb}/{b}x{cmb} exceed the "
+            f"{kda._PAGED_TABLE_CAP}-entry scalar-prefetch cap", fam,
+            label))
+    hd = h * dh
+    resident = 6 * hd * dm * esize + dm * dh * 4 \
+        + 2 * (plan.block_t + plan.cross_block_t) * hd * (esize + 4) \
+        + 2 * h * max(plan.block_t, plan.cross_block_t) * 4
+    if plan.fuse_ffn:
+        resident += 2 * dm * di * esize + di * 4
+    if resident > kds._VMEM_BUDGET:
+        findings.append(_finding(
+            "kernel-vmem-budget",
+            f"paged megastep working set {resident} bytes exceeds the "
+            f"{kds._VMEM_BUDGET}-byte budget the gate claims to enforce "
+            f"(fuse_ffn={plan.fuse_ffn})", fam, label))
+
+
 def check_embedding_group(cfg: dict, block_rows: int,
                           findings: List[Finding]):
     """Fused multi-table gather/apply group: alias validity + the 8 MB
@@ -584,6 +703,46 @@ _MEGASTEP_MATRIX = [
          max_t=128, cross_t=256, dtype="bfloat16", must_accept=False),
 ]
 
+# paged flash-decode: block-pool walks at FLAGS_kv_block_t granularity
+# (kernels/decode_attention.py _paged_plan).  block_t comes from the pool
+# and is never snapped, so the misaligned-pool and oversized-table rows
+# are MUST-REJECTS: accepting either would DMA off-tile or overflow the
+# SMEM-resident table
+_PAGED_MATRIX = [
+    # the ROADMAP metric pair on the paged layout (128 logical rows =
+    # 8 blocks of 16)
+    dict(label="paged-base-b1", b=1, h=8, dh=64, block_t=16,
+         max_blocks=8, dtype="float32"),
+    dict(label="paged-base-b64", b=64, h=8, dh=64, block_t=16,
+         max_blocks=8, dtype="float32"),
+    # pool built with block_t % 8 != 0: reject, never snap
+    dict(label="paged-bt12-reject", b=4, h=8, dh=64, block_t=12,
+         max_blocks=8, dtype="float32", must_accept=False),
+    # table past the scalar-prefetch cap (64 * 128 = 8192 entries)
+    dict(label="paged-table-overflow-reject", b=64, h=8, dh=64,
+         block_t=16, max_blocks=128, dtype="float32",
+         must_accept=False),
+]
+
+# paged fused decode megastep (kernels/decode_step.py
+# _paged_megastep_plan): both walks block-indexed, both flattened
+# tables scalar-prefetched
+_PAGED_MEGASTEP_MATRIX = [
+    dict(label="paged-megastep-base", dm=512, h=8, dh=64, di=2048,
+         block_t=16, cross_block_t=16, b=64, max_blocks=8,
+         cross_max_blocks=16, dtype="float32", expect_fuse_ffn=False),
+    dict(label="paged-megastep-fused-ffn", dm=128, h=8, dh=64, di=256,
+         block_t=16, cross_block_t=16, b=4, max_blocks=8,
+         cross_max_blocks=8, dtype="float32", expect_fuse_ffn=True),
+    dict(label="paged-megastep-bt12-reject", dm=128, h=8, dh=64, di=256,
+         block_t=12, cross_block_t=16, b=4, max_blocks=8,
+         cross_max_blocks=8, dtype="float32", must_accept=False),
+    dict(label="paged-megastep-table-overflow-reject", dm=128, h=8,
+         dh=64, di=256, block_t=16, cross_block_t=16, b=64,
+         max_blocks=128, cross_max_blocks=8, dtype="float32",
+         must_accept=False),
+]
+
 _EMBEDDING_MATRIX = [
     # deepfm: 26 slots x [10001, 10] emb tables + [10001, 1] w1 tables
     dict(label="deepfm-emb", tables=[((10001, 10), "float32")] * 26,
@@ -711,6 +870,33 @@ def lint_kernel_plans() -> Tuple[List[Finding], Dict[str, Any]]:
                          block_t=int(plan.block_t),
                          cross_block_t=int(plan.cross_block_t)))
     report["decode_step"] = rows
+
+    rows = []
+    for cfg in _PAGED_MATRIX:
+        q = _spec((cfg["b"], cfg["h"], cfg["dh"]), cfg["dtype"])
+        pool = _spec((cfg["b"] * cfg["max_blocks"], cfg["block_t"],
+                      cfg["h"], cfg["dh"]), cfg["dtype"])
+        table = _spec((cfg["b"], cfg["max_blocks"]), "int32")
+        with _pretend_tpu():
+            ok, bt, interp = kda._paged_plan(q, pool, table, None)
+        check_paged_decode_plan(cfg, ok, bt, interp, findings)
+        rows.append(dict(label=cfg["label"], accepted=bool(ok),
+                         block_t=int(bt)))
+    report["paged_decode_attention"] = rows
+
+    rows = []
+    for cfg in _PAGED_MEGASTEP_MATRIX:
+        with _pretend_tpu():
+            plan = kds._paged_megastep_plan(
+                cfg["dm"], cfg["h"], cfg["dh"], cfg["di"],
+                cfg["block_t"], cfg["cross_block_t"], cfg["b"],
+                cfg["max_blocks"], cfg["cross_max_blocks"], cfg["dtype"])
+        check_paged_megastep_plan(cfg, plan, findings)
+        rows.append(dict(label=cfg["label"], accepted=bool(plan.ok),
+                         fuse_ffn=bool(plan.fuse_ffn),
+                         block_t=int(plan.block_t),
+                         cross_block_t=int(plan.cross_block_t)))
+    report["paged_decode_step"] = rows
 
     # ring attention reuses the attention _plan gate per sequence CHUNK
     # (kernels/ring_attention.py); audit the real per-rank chunk shapes

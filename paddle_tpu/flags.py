@@ -213,6 +213,29 @@ FLAGS.define(
     "never read) when the plan gate accepts; off or off-contract = the "
     "numerically-identical XLA fallback")
 FLAGS.define(
+    "paged_kv_cache", bool, False,
+    "generation programs allocate the KV cache as a paged block pool "
+    "(generation/kv_cache.py PagedKVCache: a global [layers, blocks, "
+    "block_t, heads, d_head] pool per side plus per-slot int32 block "
+    "tables, free-list/ref-count allocator with copy-on-write append) "
+    "instead of the contiguous ring buffer; decode attention and the "
+    "fused megastep walk blocks through the table. Off (default) = the "
+    "ring layout, byte-stable graphs, unchanged parameter names")
+FLAGS.define(
+    "kv_block_t", int, 16,
+    "rows (time steps) per KV-cache block when FLAGS_paged_kv_cache is "
+    "on; must be a multiple of 8 (TPU sublane quantum). Small blocks "
+    "cut per-sequence HBM waste to <block_t rows (vs the ring's 128-"
+    "row quanta) which is the concurrent-slot capacity win; large "
+    "blocks amortize DMA issue overhead in the block walk")
+FLAGS.define(
+    "kv_cache_blocks", int, 0,
+    "total blocks in the paged KV pool per side (self/cross); 0 = "
+    "auto, sized ring-equivalent (slots x ceil(max_t / block_t)) so "
+    "the static identity mapping reproduces the ring capacity exactly. "
+    "Serving deployments set this to the HBM budget and let block-"
+    "budget admission carry more short sequences than slot-count would")
+FLAGS.define(
     "serving_decode_slots", int, 4,
     "default cache-slot count (the decode batch dimension) of a "
     "generation serving model (paddle_tpu/serving/generation.py): the "

@@ -24,6 +24,10 @@ from __future__ import annotations
 
 from ..layer_helper import LayerHelper
 
+#: jit'd donated pool-block copy, cached per (shape, dtype) by jax.jit
+#: itself — src/dst ride as traced scalars so COW never retraces
+_POOL_COPY = None
+
 
 class KVCache:
     """Names + shapes of one ring-buffer cache (self- or cross-attention).
@@ -85,6 +89,9 @@ class KVCache:
                 v = block.create_var(name=name, shape=list(shape),
                                      dtype=dtype, persistable=persistable,
                                      stop_gradient=True)
+            # memory/planner.py classifies tagged vars into the kv_cache
+            # footprint class (hlo_diag --memory names the cache row)
+            v.is_kv_cache = True
             return v
 
         return (declare(self.k_name, self.shape, self.dtype),
@@ -144,6 +151,365 @@ class KVCache:
         scope.set_var(self.k_name, jnp.zeros(self.shape, target))
         scope.set_var(self.v_name, jnp.zeros(self.shape, target))
         scope.set_var(self.len_name, jnp.zeros((self.batch,), jnp.int32))
+
+    def lengths(self, scope):
+        import numpy as np
+
+        return np.asarray(scope.find_var(self.len_name))
+
+
+class BlockAllocator:
+    """Host-side ledger over a paged pool: free-list + per-block
+    ref-counts.
+
+    Blocks are plain ints into the pool's block axis.  `alloc` hands out
+    exclusively-owned blocks (ref 1); `share` bumps refs when a later
+    request maps an existing prefix's blocks into its own table;
+    `free` decrefs and reclaims at zero.  A block with ref > 1 must
+    never be written — the cache's `cow_if_shared` copies it first
+    (copy-on-write) so the sharer's rows survive a divergent append.
+
+    `reserve` low blocks are withheld from the free list; dynamic
+    serving reserves block 0 as the TRAP block: unallocated table-row
+    tails point at it, so a (bug-induced) write past a request's block
+    budget lands in the trap instead of another request's cache, and
+    reads beyond the length counter are masked regardless.
+    """
+
+    def __init__(self, num_blocks: int, reserve: int = 0):
+        self.num_blocks = int(num_blocks)
+        self.reserve = int(reserve)
+        # pop() from the tail -> lowest block first (stable tests)
+        self._free = list(range(self.num_blocks - 1, self.reserve - 1, -1))
+        self._refs = {}
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_blocks - self.reserve - len(self._free)
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(int(block), 0)
+
+    def alloc(self, n: int):
+        """n fresh blocks at ref 1; raises MemoryError when the pool
+        can't cover them (admission checks free_count FIRST — the
+        batcher treats this as 'stay pending', never a request error)."""
+        if n > len(self._free):
+            raise MemoryError(
+                f"paged KV pool exhausted: want {n} blocks, "
+                f"{len(self._free)} free of {self.num_blocks}")
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def share(self, blocks) -> None:
+        for b in blocks:
+            b = int(b)
+            if self._refs.get(b, 0) <= 0:
+                raise ValueError(f"share of unallocated block {b}")
+            self._refs[b] += 1
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            b = int(b)
+            r = self._refs.get(b, 0)
+            if r <= 0:
+                raise ValueError(f"double free of block {b}")
+            if r == 1:
+                del self._refs[b]
+                self._free.append(b)
+            else:
+                self._refs[b] = r - 1
+
+
+class PagedKVCache:
+    """Paged-pool variant of KVCache: serve by HBM bytes, not slot rows.
+
+    Layout (FLAGS_paged_kv_cache; vLLM PagedAttention rebuilt on the
+    flash-decode/megastep DMA path):
+
+        <prefix>_k / <prefix>_v : [num_layers, num_blocks, block_t,
+                                   n_head, d_head]   (the global pool)
+        <prefix>_btab           : [batch, max_blocks] int32 block table
+        <prefix>_len            : [batch] int32 valid-row counters
+
+    A sequence's logical rows [0, len) live at pool block
+    `table[slot, r // block_t]`, row `r % block_t` — decode walks blocks
+    through the table instead of contiguous ring rows, so a sequence
+    only OWNS ceil(len / block_t) blocks (< block_t rows of waste) while
+    the ring charges every slot max_t rows up front.  Pool + length
+    counters are persistable read-then-write scope vars (donated,
+    in-place HBM, length-independent compile key — same contract as the
+    ring).  The TABLE is graph-READ-ONLY: the host rewrites it between
+    steps via scope.set_var (allocation / free / prefix mapping), which
+    never changes a shape and therefore never retraces.
+
+    Two allocation modes:
+      * `allocate(scope)` — STATIC identity mapping, slot i owns blocks
+        [i*max_blocks, (i+1)*max_blocks): bit-for-bit the ring capacity
+        and the layout the b1/b64 identity tests pin.
+      * `reset_dynamic(scope)` — serving mode: block 0 reserved as the
+        trap block, everything else on the allocator free list; the
+        batcher maps blocks per request (prefix sharing = `share` +
+        table row patch, divergence = `cow_if_shared`).
+    """
+
+    def __init__(self, prefix: str, num_layers: int, batch: int,
+                 max_t: int, n_head: int, d_head: int,
+                 dtype: str = "float32", block_t: int = 16,
+                 num_blocks: int = 0):
+        if block_t <= 0 or block_t % 8:
+            raise ValueError(
+                f"block_t must be a positive multiple of 8 (TPU sublane "
+                f"quantum), got {block_t}")
+        self.prefix = prefix
+        self.num_layers = num_layers
+        self.batch = batch
+        self.max_t = max_t
+        self.n_head = n_head
+        self.d_head = d_head
+        self.dtype = dtype
+        self.block_t = int(block_t)
+        self.max_blocks = -(-int(max_t) // self.block_t)
+        # 0 = ring-equivalent: every slot can hold max_t rows at once
+        self.num_blocks = int(num_blocks) or batch * self.max_blocks
+        self.k_name = f"{prefix}_k"
+        self.v_name = f"{prefix}_v"
+        self.len_name = f"{prefix}_len"
+        self.table_name = f"{prefix}_btab"
+        self.allocator = None  # armed by reset_dynamic
+
+    @property
+    def shape(self):
+        return (self.num_layers, self.num_blocks, self.block_t,
+                self.n_head, self.d_head)
+
+    @property
+    def logical_max_t(self) -> int:
+        return self.max_blocks * self.block_t
+
+    @property
+    def block_bytes(self) -> int:
+        """K + V bytes one block pins across all layers — the quantum of
+        the batcher's block-budget admission."""
+        from ..memory.planner import _DTYPE_BYTES
+
+        return (2 * self.num_layers * self.block_t * self.n_head
+                * self.d_head * _DTYPE_BYTES.get(self.dtype, 4))
+
+    def blocks_for(self, rows: int) -> int:
+        return -(-max(int(rows), 0) // self.block_t)
+
+    @property
+    def hbm_bytes(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        from ..memory.planner import _DTYPE_BYTES
+
+        return (2 * n * _DTYPE_BYTES.get(self.dtype, 4)
+                + 4 * self.batch                       # length counters
+                + 4 * self.batch * self.max_blocks)    # block table
+
+    # -- program side ----------------------------------------------------
+    def vars_in(self, program=None, persistable=True):
+        """(k_pool_var, v_pool_var, len_var) — same 3-tuple contract as
+        KVCache.vars_in so the transformer's destructuring is layout-
+        blind.  The block table is declared alongside (table_in)."""
+        if not persistable:
+            raise NotImplementedError(
+                "program-local paged caches are unsupported: the block "
+                "table is host-owned state (the While decoder route "
+                "keeps the ring layout)")
+        from ..core import framework as fw
+
+        block = (program or fw.default_main_program()).global_block()
+
+        def declare(name, shape, dtype):
+            v = block._find_var_recursive(name)
+            if v is None:
+                v = block.create_var(name=name, shape=list(shape),
+                                     dtype=dtype, persistable=True,
+                                     stop_gradient=True)
+            v.is_kv_cache = True
+            return v
+
+        declare(self.table_name, (self.batch, self.max_blocks), "int32")
+        return (declare(self.k_name, self.shape, self.dtype),
+                declare(self.v_name, self.shape, self.dtype),
+                declare(self.len_name, (self.batch,), "int32"))
+
+    def table_in(self, program=None):
+        from ..core import framework as fw
+
+        block = (program or fw.default_main_program()).global_block()
+        v = block._find_var_recursive(self.table_name)
+        if v is None:
+            self.vars_in(program)
+            v = block._find_var_recursive(self.table_name)
+        return v
+
+    def write(self, k, v, pos, layer: int, active=None):
+        """Append a paged_kv_cache_update op: K/V [b, t, h, dh] rows land
+        at logical positions pos..pos+t-1, scattered to pool blocks
+        through the table."""
+        ck, cv, _ = self.vars_in()
+        tab = self.table_in()
+        helper = LayerHelper("paged_kv_cache_update")
+        ins = {"K": [k], "V": [v], "CacheK": [ck], "CacheV": [cv],
+               "Table": [tab], "Pos": [pos]}
+        if active is not None:
+            ins["Active"] = [active]
+        helper.append_op(
+            "paged_kv_cache_update", inputs=ins,
+            outputs={"CacheKOut": [ck], "CacheVOut": [cv]},
+            attrs={"layer": layer})
+
+    def attend(self, q, lengths, layer: int, scale: float = 1.0):
+        """Append a paged_decode_attention op: Q [b, 1, h, dh] against
+        the first `lengths` logical rows walked through the table."""
+        ck, cv, _ = self.vars_in()
+        tab = self.table_in()
+        helper = LayerHelper("paged_decode_attention")
+        out = helper.create_variable_for_type_inference(q.dtype)
+        helper.append_op(
+            "paged_decode_attention",
+            inputs={"Q": [q], "CacheK": [ck], "CacheV": [cv],
+                    "Table": [tab], "Lengths": [lengths]},
+            outputs={"Out": [out]},
+            attrs={"layer": layer, "scale": float(scale)})
+        return out
+
+    def reorder(self, parents):
+        """Append a paged_kv_cache_reorder op: copy block CONTENTS from
+        each lane's beam parent through the (static, per-lane-disjoint)
+        tables — tables themselves stay fixed."""
+        ck, cv, _ = self.vars_in()
+        tab = self.table_in()
+        helper = LayerHelper("paged_kv_cache_reorder")
+        helper.append_op(
+            "paged_kv_cache_reorder",
+            inputs={"CacheK": [ck], "CacheV": [cv], "Table": [tab],
+                    "Parents": [parents]},
+            outputs={"CacheKOut": [ck], "CacheVOut": [cv]})
+
+    # -- host side -------------------------------------------------------
+    def allocate(self, scope) -> None:
+        """STATIC mode: zero pools + counters, identity block table
+        (slot i owns blocks [i*max_blocks, (i+1)*max_blocks)) — ring
+        semantics exactly, zero host choreography per step."""
+        import jax.numpy as jnp
+
+        if self.num_blocks < self.batch * self.max_blocks:
+            raise ValueError(
+                f"static paged cache needs >= batch*max_blocks = "
+                f"{self.batch * self.max_blocks} blocks, pool has "
+                f"{self.num_blocks} (size it, or run reset_dynamic)")
+        target = jnp.bfloat16 if self.dtype == "bfloat16" else self.dtype
+        scope.set_var(self.k_name, jnp.zeros(self.shape, target))
+        scope.set_var(self.v_name, jnp.zeros(self.shape, target))
+        scope.set_var(self.len_name, jnp.zeros((self.batch,), jnp.int32))
+        table = jnp.arange(
+            self.batch * self.max_blocks, dtype=jnp.int32
+        ).reshape(self.batch, self.max_blocks)
+        scope.set_var(self.table_name, table)
+        self.allocator = None
+
+    def reset_dynamic(self, scope) -> None:
+        """DYNAMIC mode: arm the allocator (block 0 = trap), park every
+        table entry on the trap block, zero the counters.  Pool contents
+        are NOT cleared — stale rows sit behind the length masks."""
+        import jax.numpy as jnp
+
+        target = jnp.bfloat16 if self.dtype == "bfloat16" else self.dtype
+        if scope.find_var(self.k_name) is None:
+            scope.set_var(self.k_name, jnp.zeros(self.shape, target))
+            scope.set_var(self.v_name, jnp.zeros(self.shape, target))
+        scope.set_var(self.len_name, jnp.zeros((self.batch,), jnp.int32))
+        scope.set_var(
+            self.table_name,
+            jnp.zeros((self.batch, self.max_blocks), jnp.int32))
+        self.allocator = BlockAllocator(self.num_blocks, reserve=1)
+
+    def host_table(self, scope):
+        import numpy as np
+
+        return np.array(scope.find_var(self.table_name))
+
+    def set_table_row(self, scope, slot: int, blocks) -> None:
+        """Point `slot`'s table row at `blocks` (tail entries -> trap)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        table = self.host_table(scope)
+        row = np.zeros((self.max_blocks,), np.int32)
+        row[:len(blocks)] = blocks
+        table[slot] = row
+        scope.set_var(self.table_name, jnp.asarray(table))
+
+    def slot_blocks(self, scope, slot: int, rows: int):
+        """The block ids backing `slot`'s first `rows` logical rows."""
+        return [int(b) for b in
+                self.host_table(scope)[slot][:self.blocks_for(rows)]]
+
+    def cow_if_shared(self, scope, slot: int, pos: int) -> bool:
+        """Copy-on-write guard before the graph appends at logical row
+        `pos` of `slot`: when the covering block is shared (ref > 1),
+        copy it into a fresh block, re-point this slot's table entry,
+        and decref the original — the sharer keeps its rows.  Returns
+        True when a copy happened.  Requires dynamic mode."""
+        alloc = self.allocator
+        if alloc is None:
+            return False
+        idx = int(pos) // self.block_t
+        table = self.host_table(scope)
+        old = int(table[slot, idx])
+        if alloc.refcount(old) <= 1:
+            return False
+        import jax.numpy as jnp
+
+        new = alloc.alloc(1)[0]
+        global _POOL_COPY
+        if _POOL_COPY is None:
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def _copy(pool, src, dst):
+                return pool.at[:, dst].set(pool[:, src])
+
+            _POOL_COPY = _copy
+        src = jnp.int32(old)
+        dst = jnp.int32(new)
+        scope.set_var(self.k_name,
+                      _POOL_COPY(scope.find_var(self.k_name), src, dst))
+        scope.set_var(self.v_name,
+                      _POOL_COPY(scope.find_var(self.v_name), src, dst))
+        table[slot, idx] = new
+        scope.set_var(self.table_name, jnp.asarray(table))
+        alloc.free([old])
+        return True
+
+    def fork_slot(self, scope, dst_slot: int, src_slot: int,
+                  rows: int) -> None:
+        """Map `src_slot`'s first `rows` logical rows into `dst_slot`'s
+        table by SHARING the covering blocks (ref++) — the speculative-
+        decode skeleton and the COW test vehicle.  The next divergent
+        append on either slot must go through cow_if_shared."""
+        blocks = self.slot_blocks(scope, src_slot, rows)
+        self.allocator.share(blocks)
+        old = self.slot_blocks(
+            scope, dst_slot,
+            int(self.lengths(scope)[dst_slot]))
+        self.set_table_row(scope, dst_slot, blocks)
+        if old:
+            self.allocator.free(old)
 
     def lengths(self, scope):
         import numpy as np

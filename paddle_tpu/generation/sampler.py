@@ -54,9 +54,23 @@ class GenerationSession:
         import jax.numpy as jnp
 
         p = self.p
+        self._paged_dynamic_only = False
         if p.kv_cache:
-            p.self_cache.allocate(self.scope)
-            p.cross_cache.allocate(self.scope)
+            if getattr(p, "paged", False) and any(
+                    c.num_blocks < c.batch * c.max_blocks
+                    for c in (p.self_cache, p.cross_cache)):
+                # FLAGS_kv_cache_blocks sized the pool BELOW full static
+                # occupancy — the whole point of paging (serve by HBM
+                # bytes, not slot count), but only the serving batcher
+                # maps blocks per request; static identity tables can't
+                # exist, so arm dynamic mode and refuse the one-shot
+                # generate() driver (it would read trap rows).
+                p.self_cache.reset_dynamic(self.scope)
+                p.cross_cache.reset_dynamic(self.scope)
+                self._paged_dynamic_only = True
+            else:
+                p.self_cache.allocate(self.scope)
+                p.cross_cache.allocate(self.scope)
             if getattr(p, "self_feed_token", False):
                 # greedy self-feed state (FLAGS_fused_decode_step):
                 # the decode program reads/latches these in-graph; the
@@ -144,6 +158,12 @@ class GenerationSession:
         exit once every sequence has emitted eos."""
         p = self.p
         assert p.beam_size is None, "use generate_beam for beam programs"
+        if self._paged_dynamic_only:
+            raise RuntimeError(
+                "paged KV pool is smaller than batch*max_blocks (dynamic "
+                "serving mode): drive it through ContinuousBatcher, which "
+                "maps blocks per request — generate() needs the static "
+                "identity tables")
         max_tokens = min(max_tokens or p.max_out_len, p.max_out_len)
         src_word = np.asarray(src_word, np.int64)
         b = src_word.shape[0]

@@ -213,3 +213,212 @@ def flash_decode(q, k, v, lengths, scale=1.0, block_t=256, interpret=None):
         out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
         interpret=bool(interp),
     )(lengths.astype(jnp.int32), q, k, v)
+
+
+# -- paged variant (FLAGS_paged_kv_cache) --------------------------------
+#
+# The cache is a global block POOL [num_blocks, block_t, h, dh] (one
+# layer's slice); a sequence's logical row r lives at pool block
+# table[seq, r // block_t], row r % block_t.  The kv walk is identical to
+# the ring kernel's except the DMA source address comes from the
+# scalar-prefetched block table instead of a contiguous row window — the
+# vLLM PagedAttention layout on the make_async_copy idiom.
+
+
+def reference_decode_paged(q, k_pool, v_pool, table, lengths, scale=1.0):
+    """Pure-XLA paged fallback: gather the table-addressed blocks into
+    the contiguous logical view and run the ring oracle on it.
+
+    q [b, h, dh]; k_pool/v_pool [num_blocks, block_t, h, dh]; table
+    [b, max_blocks] int32; lengths [b].  Positions >= length mask to
+    -1e30 exactly as the ring path does, so whatever garbage sits in
+    unreferenced (or trap) blocks contributes an exact softmax zero —
+    the result is bit-identical to the ring cache holding the same
+    valid rows.
+    """
+    nb, bt, h, dh = k_pool.shape
+    b, mb = table.shape
+    flat = table.reshape(-1)
+    view_k = k_pool[flat].reshape(b, mb * bt, h, dh)
+    view_v = v_pool[flat].reshape(b, mb * bt, h, dh)
+    return reference_decode(q, view_k, view_v, lengths, scale)
+
+
+def paged_scatter_rows(cache, new, table, pos, active, layer):
+    """Functional core of the paged cache write, shared by the
+    paged_kv_cache_update lowering and the fused megastep's XLA
+    composition (so flag-on fused/unfused programs stay bit-identical).
+
+    cache [L, num_blocks, block_t, h, dh]; new [b, t, h, dh]; table
+    [b, max_blocks] int32; pos [b].  Logical rows pos..pos+t-1 of each
+    sequence scatter to pool row table[b, r // bt] * bt + r % bt of
+    layer `layer`; inactive lanes and rows past the logical window
+    route out of bounds and DROP (the paged analogue of the ring's
+    keep-mask + clamp).
+    """
+    import jax.numpy as jnp
+
+    nb, bt = cache.shape[1], cache.shape[2]
+    h, dh = cache.shape[3], cache.shape[4]
+    b, t = new.shape[0], new.shape[1]
+    mb = table.shape[1]
+    pos32 = pos.reshape(-1).astype(jnp.int32)
+    rows = pos32[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    blk = jnp.take_along_axis(
+        table.astype(jnp.int32), jnp.clip(rows // bt, 0, mb - 1), axis=1)
+    flat = blk * bt + rows % bt
+    total = nb * bt
+    oob = rows >= mb * bt
+    if active is not None:
+        keep = active.reshape(-1).astype(jnp.bool_)
+        oob = oob | ~keep[:, None]
+    flat = jnp.where(oob, total, flat)
+    pool = cache[layer].reshape(total, h, dh)
+    pool = pool.at[flat.reshape(-1)].set(
+        new.reshape(b * t, h, dh).astype(pool.dtype), mode="drop")
+    return cache.at[layer].set(pool.reshape(nb, bt, h, dh))
+
+
+def _paged_decode_kernel(lens_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                         k_scr, v_scr, sem_k, sem_v, *, scale, block_t,
+                         max_blocks, n_head, d_head):
+    """Ring kernel with a table hop: block t of sequence i streams from
+    pool block tab[i * max_blocks + t]."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+    length = lens_ref[i]
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [h, dh]
+    m0 = jnp.full((n_head,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((n_head,), jnp.float32)
+    acc0 = jnp.zeros((n_head, d_head), jnp.float32)
+
+    n_blk = jax.lax.div(length + (block_t - 1), block_t)
+
+    def body(t, carry):
+        m, l, acc = carry
+        blk = tab_ref[i * max_blocks + t]
+        ck = pltpu.make_async_copy(k_ref.at[blk], k_scr, sem_k)
+        cv = pltpu.make_async_copy(v_ref.at[blk], v_scr, sem_v)
+        ck.start()
+        cv.start()
+        ck.wait()
+        cv.wait()
+        kb = jnp.transpose(k_scr[...].astype(jnp.float32), (1, 0, 2))
+        vb = jnp.transpose(v_scr[...].astype(jnp.float32), (1, 0, 2))
+        s = jax.lax.dot_general(
+            q[:, None, :], kb,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )[:, 0, :]
+        k_pos = t * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, (n_head, block_t), 1)
+        s = jnp.where(k_pos < length, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=1)
+        pv = jax.lax.dot_general(
+            p[:, None, :], vb,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )[:, 0, :]
+        acc_new = acc * alpha[:, None] + pv
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_blk, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+#: the flattened block table rides scalar prefetch into SMEM alongside
+#: the lengths; past this many entries it no longer fits the scalar
+#: budget and the plan rejects (the lint matrix's oversized-table leg)
+_PAGED_TABLE_CAP = 4096
+
+
+def _paged_plan(q, k_pool, table, interpret):
+    """Static feasibility gate for the paged walk; returns
+    (ok, block_t, interpret).
+
+    block_t is FIXED by the pool geometry (no snapping — a misaligned
+    pool is a build error, not a tuning knob), so the gate rejects:
+      * block_t % 8 != 0 (sublane quantum of the DMA'd [bt, h, dh]
+        tile) — plus the ring kernel's dh % 64 / n_head sublane checks;
+      * b * max_blocks > _PAGED_TABLE_CAP (the whole table must stay
+        SMEM-resident for per-iteration address lookups);
+      * scratch + compute tiles past the 4 MB VMEM working-set budget.
+    """
+    import jax
+    import numpy as np
+
+    b, h, dh = q.shape
+    block_t = int(k_pool.shape[1])
+    max_blocks = int(table.shape[1])
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+    esize = np.dtype(q.dtype).itemsize
+    sublane = 8 if esize >= 4 else 16
+    ok = (
+        dh % 64 == 0
+        and h % sublane == 0
+        and block_t % 8 == 0
+        and b * max_blocks <= _PAGED_TABLE_CAP
+        and (2 * block_t * h * dh * (esize + 4) + h * block_t * 4)
+        <= 4 * 1024 * 1024
+    )
+    return ok, block_t, interpret
+
+
+def flash_decode_paged(q, k_pool, v_pool, table, lengths, scale=1.0,
+                       interpret=None):
+    """Single-query attention over the paged pool.
+
+    q [b, h, dh]; k_pool/v_pool [num_blocks, block_t, h, dh] (one
+    layer's HBM-resident slice); table [b, max_blocks] int32; lengths
+    [b].  Returns [b, h, dh].  Off-contract (or off-TPU without an
+    explicit interpret=True) runs reference_decode_paged.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ok, block_t, interp = _paged_plan(q, k_pool, table, interpret)
+    if not ok or (interp and interpret is None):
+        return reference_decode_paged(q, k_pool, v_pool, table, lengths,
+                                      scale)
+
+    b, h, dh = q.shape
+    max_blocks = int(table.shape[1])
+    kernel = functools.partial(
+        _paged_decode_kernel, scale=scale, block_t=block_t,
+        max_blocks=max_blocks, n_head=h, d_head=dh)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, h, dh), lambda i, lens, tab: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # k pool (HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),  # v pool (HBM)
+        ],
+        out_specs=pl.BlockSpec((1, h, dh), lambda i, lens, tab: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_t, h, dh), k_pool.dtype),
+            pltpu.VMEM((block_t, h, dh), v_pool.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
+        interpret=bool(interp),
+    )(lengths.astype(jnp.int32), table.reshape(-1).astype(jnp.int32),
+      q, k_pool, v_pool)

@@ -517,3 +517,379 @@ def fused_decode_step(x, wqkv, wout, ln1_scale, ln1_bias, wcq, wcout,
         )(out, ffn_in_w, row2d(ffn_in_b), ffn_out_w, row2d(ffn_out_b),
           row2d(ln3_scale), row2d(ln3_bias))
     return out, cache_k, cache_v
+
+
+# ---------------------------------------------------------------------------
+# paged-cache variants (FLAGS_paged_kv_cache) — the ring path above is
+# untouched so flag-off graphs stay byte-stable
+# ---------------------------------------------------------------------------
+
+
+def _paged_megastep_plan(d_model, n_head, d_head, d_inner, block_t,
+                         cross_block_t, batch, max_blocks,
+                         cross_max_blocks, dtype, interpret=None):
+    """Static feasibility gate for the paged megastep; returns a
+    MegastepPlan.  Unlike _megastep_plan the walk blocks are FIXED by
+    the pool geometry (misaligned block_t is a build error → reject, no
+    snapping), and both flattened block tables must fit the scalar-
+    prefetch budget (_PAGED_TABLE_CAP entries) since every walk
+    iteration reads its DMA address from SMEM."""
+    import jax
+
+    from .decode_attention import _PAGED_TABLE_CAP
+
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+    esize = _itemsize(dtype)
+    bt = int(block_t)
+    cbt = int(cross_block_t)
+    sublane = 8 if esize >= 4 else 16
+    hd = n_head * d_head
+    aligned = (
+        d_model % 128 == 0
+        and d_inner % 128 == 0
+        and d_head % 64 == 0
+        and n_head % sublane == 0
+        and bt % 8 == 0 and bt > 0
+        and cbt % 8 == 0 and cbt > 0
+        and batch * max_blocks <= _PAGED_TABLE_CAP
+        and batch * cross_max_blocks <= _PAGED_TABLE_CAP
+    )
+    attn_bytes = (
+        6 * hd * d_model * esize + d_model * d_head * 4
+        + 2 * (bt + cbt) * hd * (esize + 4)
+        + 2 * n_head * max(bt, cbt) * 4
+    )
+    ffn_bytes = 2 * d_model * d_inner * esize + d_inner * 4
+    ok = aligned and attn_bytes <= _VMEM_BUDGET and ffn_bytes <= _VMEM_BUDGET
+    fuse_ffn = ok and attn_bytes + ffn_bytes <= _VMEM_BUDGET
+    return MegastepPlan(ok, fuse_ffn, bt, cbt, interpret)
+
+
+def reference_decode_step_paged(x, wqkv, wout, ln1_scale, ln1_bias, wcq,
+                                wcout, ln2_scale, ln2_bias, ffn_in_w,
+                                ffn_in_b, ffn_out_w, ffn_out_b, ln3_scale,
+                                ln3_bias, cache_k, cache_v, cross_k,
+                                cross_v, pos, lengths, cross_lengths,
+                                self_table, cross_table, active=None, *,
+                                layer, n_head, scale, eps=1e-5):
+    """The composed decoder step over PAGED caches — the exact op chain
+    cached_decoder_step emits with FLAGS_paged_kv_cache on and
+    FLAGS_fused_decode_step off (paged_kv_cache_update's shared scatter
+    core, paged_decode_attention's table-gathered walk), so fused/
+    unfused paged programs stay numerically identical on every backend.
+    cache_k/cache_v are [L, num_blocks, block_t, h, dh] pools; the
+    tables are [b, max_blocks] int32.  Returns (out, cache_k',
+    cache_v')."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..flags import FLAGS
+    from . import decode_attention as kda
+
+    b = x.shape[0]
+    h = n_head
+    dh = cache_k.shape[-1]
+    hd = h * dh
+
+    def mul(a, w):
+        a2 = a.reshape((b * 1, -1))
+        return (a2 @ w).reshape((b, 1, w.shape[-1]))
+
+    def layer_norm(y, s, bias):
+        stat = jnp.float32 if y.dtype == jnp.bfloat16 else y.dtype
+        ys = y.astype(stat)
+        mean = jnp.mean(ys, axis=2, keepdims=True)
+        var = jnp.mean(jnp.square(ys - mean), axis=2, keepdims=True)
+        out = (ys - mean) * jax.lax.rsqrt(var + eps)
+        out = out * s.reshape((1, 1, -1)).astype(stat)
+        out = out + bias.reshape((1, 1, -1)).astype(stat)
+        return out.astype(y.dtype)
+
+    def write(cache, new):
+        return kda.paged_scatter_rows(cache, new.reshape(b, 1, h, dh),
+                                      self_table, pos, active, layer)
+
+    def attend(q, kc, vc, tab, lens):
+        q3 = q.reshape(b, h, dh)
+        lens32 = lens.reshape(-1).astype(jnp.int32)
+        if FLAGS.flash_decode:
+            o = kda.flash_decode_paged(q3, kc[layer], vc[layer], tab,
+                                       lens32, scale=scale)
+        else:
+            o = kda.reference_decode_paged(q3, kc[layer], vc[layer], tab,
+                                           lens32, scale=scale)
+        return o.reshape(b, 1, h, dh)
+
+    qkv = mul(x, wqkv)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    cache_k = write(cache_k, k)
+    cache_v = write(cache_v, v)
+    ctx = attend(q, cache_k, cache_v, self_table, lengths)
+    attn_out = mul(ctx.reshape(b, 1, hd), wout)
+    x = layer_norm(x + attn_out, ln1_scale, ln1_bias)
+    cq = mul(x, wcq)
+    cctx = attend(cq, cross_k, cross_v, cross_table, cross_lengths)
+    cross_out = mul(cctx.reshape(b, 1, hd), wcout)
+    x = layer_norm(x + cross_out, ln2_scale, ln2_bias)
+    hid = jax.nn.relu(mul(x, ffn_in_w) + ffn_in_b.reshape((1, 1, -1)))
+    ffd = mul(hid, ffn_out_w) + ffn_out_b.reshape((1, 1, -1))
+    x = layer_norm(x + ffd, ln3_scale, ln3_bias)
+    return x, cache_k, cache_v
+
+
+def _paged_megastep_kernel(pos_ref, lens_ref, clens_ref, act_ref,
+                           stab_ref, ctab_ref, *refs, layer, scale, eps,
+                           block_t, cross_block_t, n_head, d_head,
+                           d_model, fuse_ffn, max_blocks,
+                           cross_max_blocks):
+    """The megastep with table-hopped cache traffic: the fresh k/v row
+    lands at pool block stab[i, pos // bt] row pos % bt, and both walks
+    DMA [block_t, h, dh] pool blocks at scalar-prefetched table
+    addresses instead of contiguous ring windows."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    f32 = jnp.float32
+    dh = d_head
+    hd = n_head * d_head
+    n_w = 15 if fuse_ffn else 9
+    x_ref = refs[0]
+    (wqkv, wout, ln1s, ln1b, wcq, wcout, ln2s, ln2b) = refs[1:9]
+    ffn_refs = refs[9:n_w]
+    xk_ref, xv_ref = refs[n_w + 2:n_w + 4]
+    o_ref, cko_ref, cvo_ref = refs[n_w + 4:n_w + 7]
+    (q_scr, krow, vrow, kblk, vblk, ckblk, cvblk,
+     sem_w, sem_k, sem_v) = refs[n_w + 7:]
+
+    i = pl.program_id(0)
+    p = pos_ref[i]
+    length = lens_ref[i]
+    clen = clens_ref[i]
+    act = act_ref[i]
+
+    x0 = x_ref[0].astype(f32)  # [1, d_model]
+
+    for hi in range(n_head):
+        q_scr[hi, :] = jnp.dot(
+            x0, wqkv[:, hi * dh:(hi + 1) * dh].astype(f32),
+            preferred_element_type=f32)[0] * scale
+        krow[0, hi, :] = jnp.dot(
+            x0, wqkv[:, hd + hi * dh:hd + (hi + 1) * dh].astype(f32),
+            preferred_element_type=f32)[0].astype(krow.dtype)
+        vrow[0, hi, :] = jnp.dot(
+            x0, wqkv[:, 2 * hd + hi * dh:2 * hd + (hi + 1) * dh]
+            .astype(f32),
+            preferred_element_type=f32)[0].astype(vrow.dtype)
+
+    # in-place row write through the table: the covering block's pool
+    # address comes from SMEM, the row offset is pos % block_t
+    @pl.when(act != 0)
+    def _write_row():
+        wblk = stab_ref[i * max_blocks + p // block_t]
+        woff = p % block_t
+        wk = pltpu.make_async_copy(
+            krow, cko_ref.at[layer, wblk, pl.ds(woff, 1)], sem_w)
+        wv = pltpu.make_async_copy(
+            vrow, cvo_ref.at[layer, wblk, pl.ds(woff, 1)], sem_w)
+        wk.start()
+        wv.start()
+        wk.wait()
+        wv.wait()
+
+    def walk(src_k, src_v, tab_ref, mb, kscr, vscr, n_valid, blk):
+        """The online-softmax walk, block t streaming from pool block
+        tab[i * mb + t] of layer `layer`."""
+        q = q_scr[...]
+        m0 = jnp.full((n_head,), -jnp.inf, f32)
+        l0 = jnp.zeros((n_head,), f32)
+        acc0 = jnp.zeros((n_head, d_head), f32)
+        n_blk = jax.lax.div(n_valid + (blk - 1), blk)
+
+        def body(t, carry):
+            m, l, acc = carry
+            pb = tab_ref[i * mb + t]
+            ck = pltpu.make_async_copy(
+                src_k.at[layer, pb], kscr, sem_k)
+            cv = pltpu.make_async_copy(
+                src_v.at[layer, pb], vscr, sem_v)
+            ck.start()
+            cv.start()
+            ck.wait()
+            cv.wait()
+            kb = jnp.transpose(kscr[...].astype(f32), (1, 0, 2))
+            vb = jnp.transpose(vscr[...].astype(f32), (1, 0, 2))
+            s = jax.lax.dot_general(
+                q[:, None, :], kb,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=f32,
+            )[:, 0, :]
+            k_pos = t * blk + jax.lax.broadcasted_iota(
+                jnp.int32, (n_head, blk), 1)
+            s = jnp.where(k_pos < n_valid, s, -1e30)
+            m_new = jnp.maximum(m, s.max(axis=1))
+            pexp = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + pexp.sum(axis=1)
+            pv = jax.lax.dot_general(
+                pexp[:, None, :], vb,
+                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=f32,
+            )[:, 0, :]
+            acc_new = acc * alpha[:, None] + pv
+            return m_new, l_new, acc_new
+
+        m, l, acc = jax.lax.fori_loop(0, n_blk, body, (m0, l0, acc0))
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        return acc / l_safe[:, None]
+
+    def proj_heads(ctx, w_ref):
+        out = jnp.zeros((1, d_model), f32)
+        for hi in range(n_head):
+            out = out + jnp.dot(
+                ctx[hi:hi + 1, :],
+                w_ref[hi * dh:(hi + 1) * dh, :].astype(f32),
+                preferred_element_type=f32)
+        return out
+
+    def layer_norm(y, s_ref, b_ref):
+        mean = jnp.mean(y, axis=1, keepdims=True)
+        var = jnp.mean(jnp.square(y - mean), axis=1, keepdims=True)
+        return ((y - mean) * jax.lax.rsqrt(var + eps)
+                * s_ref[...].astype(f32) + b_ref[...].astype(f32))
+
+    ctx = walk(cko_ref, cvo_ref, stab_ref, max_blocks, kblk, vblk,
+               length, block_t)
+    x1 = layer_norm(x0 + proj_heads(ctx, wout), ln1s, ln1b)
+
+    for hi in range(n_head):
+        q_scr[hi, :] = jnp.dot(
+            x1, wcq[:, hi * dh:(hi + 1) * dh].astype(f32),
+            preferred_element_type=f32)[0] * scale
+    cctx = walk(xk_ref, xv_ref, ctab_ref, cross_max_blocks, ckblk,
+                cvblk, clen, cross_block_t)
+    x2 = layer_norm(x1 + proj_heads(cctx, wcout), ln2s, ln2b)
+
+    if fuse_ffn:
+        fiw, fib, fow, fob, ln3s, ln3b = ffn_refs
+        hid = jnp.maximum(
+            jnp.dot(x2, fiw[...].astype(f32),
+                    preferred_element_type=f32)
+            + fib[...].astype(f32), 0.0)
+        ffd = jnp.dot(hid, fow[...].astype(f32),
+                      preferred_element_type=f32) + fob[...].astype(f32)
+        x2 = layer_norm(x2 + ffd, ln3s, ln3b)
+
+    o_ref[0] = x2.astype(o_ref.dtype)
+
+
+def fused_decode_step_paged(x, wqkv, wout, ln1_scale, ln1_bias, wcq,
+                            wcout, ln2_scale, ln2_bias, ffn_in_w,
+                            ffn_in_b, ffn_out_w, ffn_out_b, ln3_scale,
+                            ln3_bias, cache_k, cache_v, cross_k, cross_v,
+                            pos, lengths, cross_lengths, self_table,
+                            cross_table, active=None, *, layer, n_head,
+                            scale, eps=1e-5, interpret=None):
+    """One fused decoder layer over paged caches.
+
+    Same weight operands as fused_decode_step; cache_k/cache_v and
+    cross_k/cross_v are [L, num_blocks, block_t, h, dh] pools and
+    self_table/cross_table [b, max_blocks] int32 block tables (graph-
+    read-only — the host owns allocation).  Returns (out, cache_k',
+    cache_v').  Off-contract shapes (or off-TPU without an explicit
+    interpret=True) run reference_decode_step_paged."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, _, d_model = x.shape
+    h = n_head
+    dh = cache_k.shape[-1]
+    d_inner = ffn_in_w.shape[-1]
+    plan = _paged_megastep_plan(
+        d_model, h, dh, d_inner, cache_k.shape[2], cross_k.shape[2], b,
+        self_table.shape[1], cross_table.shape[1], x.dtype, interpret)
+    if not plan.ok or (plan.interpret and interpret is None):
+        return reference_decode_step_paged(
+            x, wqkv, wout, ln1_scale, ln1_bias, wcq, wcout, ln2_scale,
+            ln2_bias, ffn_in_w, ffn_in_b, ffn_out_w, ffn_out_b,
+            ln3_scale, ln3_bias, cache_k, cache_v, cross_k, cross_v,
+            pos, lengths, cross_lengths, self_table, cross_table,
+            active, layer=layer, n_head=n_head, scale=scale, eps=eps)
+
+    def scal(a):
+        return jnp.asarray(a).reshape(-1).astype(jnp.int32)
+
+    def row2d(a):
+        return jnp.asarray(a).reshape(1, -1)
+
+    act32 = (jnp.ones((b,), jnp.int32) if active is None
+             else scal(active))
+    weights = [wqkv, wout, row2d(ln1_scale), row2d(ln1_bias), wcq,
+               wcout, row2d(ln2_scale), row2d(ln2_bias)]
+    if plan.fuse_ffn:
+        weights += [ffn_in_w, row2d(ffn_in_b), ffn_out_w,
+                    row2d(ffn_out_b), row2d(ln3_scale), row2d(ln3_bias)]
+
+    kernel = functools.partial(
+        _paged_megastep_kernel, layer=layer, scale=scale, eps=eps,
+        block_t=plan.block_t, cross_block_t=plan.cross_block_t,
+        n_head=h, d_head=dh, d_model=d_model, fuse_ffn=plan.fuse_ffn,
+        max_blocks=int(self_table.shape[1]),
+        cross_max_blocks=int(cross_table.shape[1]))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        # pos, lengths, cross_lengths, active, self table, cross table
+        num_scalar_prefetch=6,
+        grid=(b,),
+        in_specs=(
+            [pl.BlockSpec((1, 1, d_model), lambda i, *_: (i, 0, 0))]
+            + [pl.BlockSpec(w.shape, lambda i, *_: (0, 0))
+               for w in weights]
+            + [pl.BlockSpec(memory_space=pltpu.ANY)] * 4  # pools
+        ),
+        out_specs=[
+            pl.BlockSpec((1, 1, d_model), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h, dh), jnp.float32),
+            pltpu.VMEM((1, h, dh), cache_k.dtype),
+            pltpu.VMEM((1, h, dh), cache_v.dtype),
+            pltpu.VMEM((plan.block_t, h, dh), cache_k.dtype),
+            pltpu.VMEM((plan.block_t, h, dh), cache_v.dtype),
+            pltpu.VMEM((plan.cross_block_t, h, dh), cross_k.dtype),
+            pltpu.VMEM((plan.cross_block_t, h, dh), cross_v.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    cache_k_idx = 6 + 1 + len(weights)
+    out, cache_k, cache_v = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1, d_model), x.dtype),
+            jax.ShapeDtypeStruct(cache_k.shape, cache_k.dtype),
+            jax.ShapeDtypeStruct(cache_v.shape, cache_v.dtype),
+        ],
+        input_output_aliases={cache_k_idx: 1, cache_k_idx + 1: 2},
+        interpret=bool(plan.interpret),
+    )(scal(pos), scal(lengths), scal(cross_lengths), act32,
+      scal(self_table), scal(cross_table), x, *weights, cache_k,
+      cache_v, cross_k, cross_v)
+
+    if not plan.fuse_ffn:
+        ffn_kernel = functools.partial(_ffn_kernel, eps=eps)
+        out = pl.pallas_call(
+            ffn_kernel,
+            out_shape=jax.ShapeDtypeStruct((b, 1, d_model), x.dtype),
+            interpret=bool(plan.interpret),
+        )(out, ffn_in_w, row2d(ffn_in_b), ffn_out_w, row2d(ffn_out_b),
+          row2d(ln3_scale), row2d(ln3_bias))
+    return out, cache_k, cache_v

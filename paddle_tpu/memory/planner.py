@@ -55,8 +55,8 @@ _DTYPE_BYTES = {
 PLANNER_XLA_TOLERANCE = 3.0
 
 #: classes, in table order
-CLASSES = ("params", "opt_state", "activations", "workspace", "feeds",
-           "host")
+CLASSES = ("params", "opt_state", "kv_cache", "activations", "workspace",
+           "feeds", "host")
 
 
 def var_bytes(v: Optional[fw.Variable], warn=None, name: str = "?",
@@ -303,6 +303,12 @@ def _classify(name: str, v: Optional[fw.Variable], producer_op,
         return "feeds"
     if v is not None and isinstance(v, fw.Parameter):
         return "params"
+    if v is not None and getattr(v, "is_kv_cache", False):
+        # KV cache pools/tables (KVCache / PagedKVCache vars_in tag):
+        # the capacity denominator serving plans slot budgets against —
+        # split out from opt_state so hlo_diag --memory shows the
+        # resident decode footprint as its own row
+        return "kv_cache"
     if v is not None and v.persistable:
         return "opt_state"
     if producer_op is not None and not _is_bwd(producer_op) \

@@ -658,6 +658,12 @@ def _fused_cached_decoder_step(dec_input, self_cache, cross_cache,
 
     cache_k, cache_v, _ = self_cache.vars_in()
     cross_k, cross_v, _ = cross_cache.vars_in()
+    # paged caches (FLAGS_paged_kv_cache) route to the paged op form,
+    # which adds the graph-read-only block tables to the inputs — the
+    # weight draws and attrs are identical, so flag-on fused/unfused
+    # programs stay numerically interchangeable
+    paged = hasattr(self_cache, "table_in")
+    step_op = "fused_decode_step_paged" if paged else "fused_decode_step"
     for i in range(n_layer):
         w_qkv = fc_param("attn_qkv_w", [d_model, 3 * d_key * n_head])
         w_out = fc_param("attn_out_w", [n_head * d_value, d_model])
@@ -671,7 +677,7 @@ def _fused_cached_decoder_step(dec_input, self_cache, cross_cache,
         ffn_ob = fc_bias("ffn_out_b", [d_model])
         ln3_s, ln3_b = ln_params()
 
-        helper = LayerHelper("fused_decode_step")
+        helper = LayerHelper(step_op)
         out = helper.create_variable_for_type_inference(dtype)
         inputs = {
             "X": [x], "WQkv": [w_qkv], "WOut": [w_out],
@@ -684,13 +690,16 @@ def _fused_cached_decoder_step(dec_input, self_cache, cross_cache,
             "Pos": [write_pos], "Lengths": [self_lens],
             "CrossLengths": [cross_lens],
         }
+        if paged:
+            inputs["SelfTable"] = [self_cache.table_in()]
+            inputs["CrossTable"] = [cross_cache.table_in()]
         if active is not None:
             inputs["Active"] = [active]
         # cache outputs carry the SAME var objects — the persistable
         # read-then-write the executor donates (kv_cache_update contract
         # verbatim)
         helper.append_op(
-            "fused_decode_step", inputs=inputs,
+            step_op, inputs=inputs,
             outputs={"Out": [out], "CacheKOut": [cache_k],
                      "CacheVOut": [cache_v]},
             attrs={"layer": i, "n_head": n_head, "scale": d_key ** -0.5,
@@ -1043,7 +1052,7 @@ def build_generation_programs(
     """
     from ..core import framework as fw
     from ..flags import FLAGS
-    from ..generation.kv_cache import KVCache
+    from ..generation.kv_cache import KVCache, PagedKVCache
 
     if kv_cache is None:
         kv_cache = FLAGS.kv_cache
@@ -1071,10 +1080,27 @@ def build_generation_programs(
     hyps = fw.Program() if beam_size is not None else None
     startup = fw.Program()
 
-    self_cache = KVCache(f"{cache_prefix}_self", n_layer, lanes,
-                         _cache_rows(t_buf), n_head, d_key)
-    cross_cache = KVCache(f"{cache_prefix}_cross", n_layer, lanes,
-                          _cache_rows(src_seq_len), n_head, d_key)
+    # FLAGS_paged_kv_cache swaps the ring buffers for block pools +
+    # per-slot tables; the op surface (write/attend/reorder) is drawn
+    # from the cache object, so the rest of the build is layout-blind.
+    # Flag OFF keeps the ring construction byte-for-byte (parameter and
+    # state names unchanged — checkpoints interop).
+    paged = bool(kv_cache and FLAGS.paged_kv_cache)
+    if paged:
+        self_cache = PagedKVCache(
+            f"{cache_prefix}_self", n_layer, lanes, _cache_rows(t_buf),
+            n_head, d_key, block_t=int(FLAGS.kv_block_t),
+            num_blocks=int(FLAGS.kv_cache_blocks))
+        cross_cache = PagedKVCache(
+            f"{cache_prefix}_cross", n_layer, lanes,
+            _cache_rows(src_seq_len), n_head, d_key,
+            block_t=int(FLAGS.kv_block_t),
+            num_blocks=int(FLAGS.kv_cache_blocks))
+    else:
+        self_cache = KVCache(f"{cache_prefix}_self", n_layer, lanes,
+                             _cache_rows(t_buf), n_head, d_key)
+        cross_cache = KVCache(f"{cache_prefix}_cross", n_layer, lanes,
+                              _cache_rows(src_seq_len), n_head, d_key)
     enc_out_name = f"{cache_prefix}_enc_out"
     src_bias_name = f"{cache_prefix}_src_bias"
     last_tok_name = f"{cache_prefix}_last_tok"
@@ -1393,6 +1419,7 @@ def build_generation_programs(
         hyps_fetch=hyps_fetch if hyps is not None else None,
         batch_size=b, beam_size=beam_size, lanes=lanes,
         src_seq_len=src_seq_len, max_out_len=max_out_len, t_buf=t_buf,
-        bos_id=bos_id, eos_id=eos_id, kv_cache=kv_cache,
+        bos_id=bos_id, eos_id=eos_id, kv_cache=kv_cache, paged=paged,
+        kv_block_t=self_cache.block_t if paged else 0,
         src_vocab_size=src_vocab_size, trg_vocab_size=trg_vocab_size,
         d_model=d_model, strategy=strategy)

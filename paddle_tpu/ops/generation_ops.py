@@ -116,6 +116,36 @@ def lower_fused_decode_step(ctx, ins):
             "CacheVOut": [cache_v]}
 
 
+#: input slot order of fused_decode_step_paged — the ring tuple plus the
+#: two graph-read-only block tables (host-owned allocation state)
+_PAGED_FUSED_STEP_SLOTS = _FUSED_STEP_SLOTS + ("SelfTable", "CrossTable")
+
+
+@register("fused_decode_step_paged", no_grad=True,
+          infer_shape=_fused_step_infer,
+          inplace_outputs={"CacheKOut": "CacheK", "CacheVOut": "CacheV"})
+def lower_fused_decode_step_paged(ctx, ins):
+    """fused_decode_step over PAGED caches: CacheK/CacheV (and CrossK/
+    CrossV) are [L, num_blocks, block_t, h, dh] pools and SelfTable/
+    CrossTable [b, max_blocks] int32 block tables.  The kernel walks
+    pool blocks at table-prefetched addresses (kernels/decode_step.py
+    fused_decode_step_paged) with the same donation contract on the
+    pool vars; the tables are read-only — the host rewrites them
+    between steps (allocation / prefix sharing) without a retrace."""
+    from ..kernels import decode_step as kds
+
+    args = [ins[slot][0] for slot in _PAGED_FUSED_STEP_SLOTS]
+    active = ins.get("Active", [None])[0]
+    out, cache_k, cache_v = kds.fused_decode_step_paged(
+        *args, active,
+        layer=int(ctx.attr("layer", 0)),
+        n_head=int(ctx.attr("n_head", 1)),
+        scale=float(ctx.attr("scale", 1.0)),
+        eps=float(ctx.attr("epsilon", 1e-5)))
+    return {"Out": [out], "CacheKOut": [cache_k],
+            "CacheVOut": [cache_v]}
+
+
 def _decode_attn_infer(ctx):
     qs = ctx.input_shape("Q")
     if qs is not None:
@@ -163,6 +193,87 @@ def lower_kv_cache_reorder(ctx, ins):
     parents = ins["Parents"][0].reshape(-1).astype(jnp.int32)
     return {"CacheKOut": [jnp.take(cache_k, parents, axis=1)],
             "CacheVOut": [jnp.take(cache_v, parents, axis=1)]}
+
+
+@register("paged_kv_cache_update", no_grad=True, infer_shape=_cache_infer,
+          inplace_outputs={"CacheKOut": "CacheK", "CacheVOut": "CacheV"})
+def lower_paged_kv_cache_update(ctx, ins):
+    """Paged form of kv_cache_update: K/V [b, t, h, dh] rows scatter
+    into the [L, num_blocks, block_t, h, dh] pool at addresses walked
+    through Table [b, max_blocks] (logical row r -> pool block
+    table[b, r // bt], row r % bt).  Inactive lanes and rows past the
+    logical window route out of bounds and drop.  Same in-place
+    donation contract as the ring op."""
+    from ..kernels import decode_attention as kda
+
+    k_new, v_new = ins["K"][0], ins["V"][0]
+    cache_k, cache_v = ins["CacheK"][0], ins["CacheV"][0]
+    table = ins["Table"][0]
+    pos = ins["Pos"][0]
+    active = ins.get("Active", [None])[0]
+    layer = int(ctx.attr("layer", 0))
+    return {"CacheKOut": [kda.paged_scatter_rows(cache_k, k_new, table,
+                                                 pos, active, layer)],
+            "CacheVOut": [kda.paged_scatter_rows(cache_v, v_new, table,
+                                                 pos, active, layer)]}
+
+
+@register("paged_decode_attention", no_grad=True,
+          infer_shape=_decode_attn_infer)
+def lower_paged_decode_attention(ctx, ins):
+    """Single-query attention over the paged pool: Q [b, 1, h, dh]
+    against layer `layer` of the [L, num_blocks, block_t, h, dh] pool,
+    the kv walk hopping blocks through Table [b, max_blocks], masked to
+    the first Lengths[b] logical rows.  FLAGS_flash_decode routes to
+    the Pallas paged flash-decode kernel when _paged_plan accepts;
+    otherwise the XLA table-gather fallback — both bit-identical to the
+    ring path holding the same valid rows."""
+    import jax.numpy as jnp
+
+    from ..flags import FLAGS
+    from ..kernels import decode_attention as kda
+
+    q = ins["Q"][0]
+    cache_k, cache_v = ins["CacheK"][0], ins["CacheV"][0]
+    table = ins["Table"][0]
+    lengths = ins["Lengths"][0].reshape(-1).astype(jnp.int32)
+    layer = int(ctx.attr("layer", 0))
+    scale = float(ctx.attr("scale", 1.0))
+
+    b, one, h, dh = q.shape
+    q3 = q.reshape(b, h, dh)
+    k_l, v_l = cache_k[layer], cache_v[layer]
+    if FLAGS.flash_decode:
+        out = kda.flash_decode_paged(q3, k_l, v_l, table, lengths,
+                                     scale=scale)
+    else:
+        out = kda.reference_decode_paged(q3, k_l, v_l, table, lengths,
+                                         scale=scale)
+    return {"Out": [out.reshape(b, 1, h, dh)]}
+
+
+@register("paged_kv_cache_reorder", no_grad=True, infer_shape=_cache_infer,
+          inplace_outputs={"CacheKOut": "CacheK", "CacheVOut": "CacheV"})
+def lower_paged_kv_cache_reorder(ctx, ins):
+    """Beam-parent reorder over paged pools: copy block CONTENTS from
+    each lane's parent through the block tables (gather every lane's
+    parent blocks from the pre-step pool, scatter into the lane's own
+    blocks).  Correct because the static beam allocation gives lanes
+    disjoint tables; the tables themselves never change."""
+    import jax.numpy as jnp
+
+    cache_k, cache_v = ins["CacheK"][0], ins["CacheV"][0]
+    table = ins["Table"][0].astype(jnp.int32)
+    parents = ins["Parents"][0].reshape(-1).astype(jnp.int32)
+    src = jnp.take(table, parents, axis=0).reshape(-1)  # parents' blocks
+    dst = table.reshape(-1)
+
+    def reorder(cache):
+        gathered = jnp.take(cache, src, axis=1)
+        return cache.at[:, dst].set(gathered)
+
+    return {"CacheKOut": [reorder(cache_k)],
+            "CacheVOut": [reorder(cache_v)]}
 
 
 def _sample_infer(ctx):

@@ -139,7 +139,43 @@ class GenerationServingModel:
         # denominator of generation.<name>.tokens_per_sec_per_hbm_gb
         self.kv_cache_bytes = (p.self_cache.hbm_bytes
                                + p.cross_cache.hbm_bytes)
+        # paged cache (FLAGS_paged_kv_cache at build time): the batcher
+        # switches admission to block-budget accounting, shares same-
+        # prefix cross blocks, and guards forked self blocks with COW
+        self.paged = bool(getattr(p, "paged", False))
+        # slots whose SELF blocks may be shared (fork_slot): the per-
+        # step COW guard walks only this set, so unforked serving pays
+        # nothing for the copy-on-write machinery
+        self._shared_self_slots: set = set()
         self.ready = False
+
+    def fork_slot(self, dst_slot: int, src_slot: int) -> None:
+        """Clone src_slot's sequence state into dst_slot by SHARING its
+        self-cache blocks (ref++) — the speculative-decode skeleton on
+        the paged cache.  Counters and self-feed state are copied
+        host-side; the first divergent append on either slot triggers
+        the batcher's copy-on-write guard, so the clone costs zero HBM
+        until the sequences actually diverge."""
+        import jax.numpy as jnp
+
+        if not self.paged:
+            raise ValueError("fork_slot requires the paged KV cache")
+        p = self.session.p
+        scope = self.session.scope
+        rows = int(p.self_cache.lengths(scope)[src_slot])
+        p.self_cache.fork_slot(scope, dst_slot, src_slot, rows)
+
+        def patch(name, value):
+            arr = np.array(scope.find_var(name))
+            arr[dst_slot] = arr[src_slot] if value is None else value
+            scope.set_var(name, jnp.asarray(arr))
+
+        patch(p.self_cache.len_name, None)
+        patch(p.cross_cache.len_name, None)
+        if getattr(p, "self_feed_token", False):
+            patch(p.last_tok_name, None)
+            patch(p.finished_name, None)
+        self._shared_self_slots.update((dst_slot, src_slot))
 
     def init_params(self):
         self.session.init_params()
@@ -265,6 +301,19 @@ class ContinuousBatcher:
         # bookkeeping, counters) is attributed to the iteration instead
         # of leaking into the unattributed remainder; reset while idle
         self._t_anchor: Optional[float] = None
+        # paged-cache bookkeeping (scheduler-thread-private): which
+        # blocks each slot owns, and the shared-prefix registry mapping
+        # a full-prompt content hash to the cross blocks its prefill
+        # populated.  Arming dynamic mode re-points every table entry at
+        # the trap block, so the warmup's all-inactive prefill/decode
+        # stays harmless whether it runs before or after construction.
+        self._slot_blocks: List[Optional[dict]] = [None] * model.slots
+        self._prefix_map: dict = {}
+        if model.paged:
+            p = model.session.p
+            scope = model.session.scope
+            p.self_cache.reset_dynamic(scope)
+            p.cross_cache.reset_dynamic(scope)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -452,6 +501,78 @@ class ContinuousBatcher:
             self._pending_join.append(item)
             block = False
 
+    # -- paged-cache plumbing (no-ops in ring mode) -----------------------
+    def _publish_blocks(self) -> None:
+        """Block-pool occupancy gauges (self + cross pools summed) —
+        the generation.<m>.blocks_{used,free} capacity signal."""
+        from .. import monitor
+
+        if not (self.model.paged and monitor.enabled()):
+            return
+        p = self.model.session.p
+        used = free = 0
+        for cache in (p.self_cache, p.cross_cache):
+            alloc = cache.allocator
+            if alloc is not None:
+                used += alloc.used_count
+                free += alloc.free_count
+        m = self.model.name
+        monitor.gauge(f"generation.{m}.blocks_used").set(used)
+        monitor.gauge(f"generation.{m}.blocks_free").set(free)
+
+    def _patch_sharer_state(self, slot: int, src_len: int) -> None:
+        """A shared-prefix joiner skips prefill, so the per-slot scope
+        state the masked prefill would have reset is patched host-side:
+        cross length = the shared prefix's, self length = 0, and the
+        self-feed latch re-armed at BOS.  Zero-retrace: scope rewrites
+        between steps never change the compile key."""
+        import jax.numpy as jnp
+
+        sess = self.model.session
+        scope, p = sess.scope, sess.p
+
+        def patch(name, value):
+            arr = np.array(scope.find_var(name))
+            arr[slot] = value
+            scope.set_var(name, jnp.asarray(arr))
+
+        patch(p.cross_cache.len_name, src_len)
+        patch(p.self_cache.len_name, 0)
+        if getattr(p, "self_feed_token", False):
+            patch(p.last_tok_name, self.model.bos_id)
+            patch(p.finished_name, 0)
+
+    def _release_slot(self, slot: int) -> None:
+        """Return a retired slot's blocks to the pools: self blocks are
+        freed outright; cross blocks are deref'd (shared-prefix sharers
+        keep them alive) and the prefix registry entry is dropped when
+        its last user leaves."""
+        from .. import monitor
+
+        info = self._slot_blocks[slot]
+        if info is None:
+            return
+        self._slot_blocks[slot] = None
+        p = self.model.session.p
+        if info["self"]:
+            p.self_cache.allocator.free(info["self"])
+        if info["cross"]:
+            p.cross_cache.allocator.free(info["cross"])
+        key = info["key"]
+        if key is not None:
+            ent = self._prefix_map.get(key)
+            if ent is not None:
+                ent["users"] -= 1
+                if ent["users"] <= 0:
+                    del self._prefix_map[key]
+        self.model._shared_self_slots.discard(slot)
+        if monitor.enabled():
+            monitor.flight.record(
+                "kv.page", event="free", model=self.model.name,
+                slot=slot, self_blocks=len(info["self"]),
+                cross_blocks=len(info["cross"]))
+        self._publish_blocks()
+
     def _admit(self) -> None:
         """Prefill every pending request that fits a free slot — ONE
         masked prefill call regardless of how many join this round."""
@@ -489,39 +610,109 @@ class ContinuousBatcher:
                     ).inc()
                     monitor.counter("serving.expired_dropped_total").inc()
                 continue
+            if model.paged:
+                p = model.session.p
+                key = tuple(req.prompt)
+                ent = self._prefix_map.get(key)
+                need_self = p.self_cache.blocks_for(req.max_tokens)
+                need_cross = (0 if ent is not None else
+                              p.cross_cache.blocks_for(len(req.prompt)))
+                if (p.self_cache.allocator.free_count < need_self
+                        or p.cross_cache.allocator.free_count
+                        < need_cross):
+                    # admission is by HBM bytes now, not slot count: a
+                    # free slot without block budget keeps the request
+                    # queued (FIFO head) until a retirement frees pages
+                    self._pending_join.appendleft(req)
+                    break
             # admission-wait EWMA (Retry-After basis for sheds)
             self._wait_ewma_s += 0.2 * (
                 (now - req.t_enqueue) - self._wait_ewma_s)
             slot = free.pop(0)
             self._slot_req[slot] = req
             self._slot_token[slot] = model.bos_id
-            joining.append((slot, req))
+            if not model.paged:
+                joining.append((slot, req, False))
+                continue
+            # map the slot's blocks before the masked prefill.  A
+            # prefix HIT shares the registered cross blocks (ref++) and
+            # skips prefill entirely; a MISS allocates fresh cross
+            # blocks, registers them, and prefills as the prefix's
+            # leader.  Same-round sharers see the leader's entry at
+            # once, so N identical prompts in one round still cost one
+            # prefill lane.
+            scope = model.session.scope
+            self_blocks = p.self_cache.allocator.alloc(need_self)
+            p.self_cache.set_table_row(scope, slot, self_blocks)
+            if ent is not None:
+                p.cross_cache.allocator.share(ent["blocks"])
+                p.cross_cache.set_table_row(scope, slot, ent["blocks"])
+                ent["users"] += 1
+                self._slot_blocks[slot] = {
+                    "self": self_blocks, "cross": list(ent["blocks"]),
+                    "key": key}
+                self._patch_sharer_state(slot, ent["src_len"])
+                joining.append((slot, req, True))
+                if monitor.enabled():
+                    monitor.counter(
+                        f"generation.{model.name}.prefix_hits_total"
+                    ).inc()
+                    monitor.flight.record(
+                        "kv.page", event="hit", model=model.name,
+                        slot=slot, shared_blocks=len(ent["blocks"]),
+                        self_blocks=len(self_blocks))
+            else:
+                cross_blocks = p.cross_cache.allocator.alloc(need_cross)
+                p.cross_cache.set_table_row(scope, slot, cross_blocks)
+                # prompt ids are validated nonzero (submit rejects the
+                # pad id), so the prefill's trailing-pad length scan
+                # lands exactly on len(prompt)
+                self._prefix_map[key] = {"blocks": cross_blocks,
+                                         "src_len": len(req.prompt),
+                                         "users": 1}
+                self._slot_blocks[slot] = {"self": self_blocks,
+                                           "cross": cross_blocks,
+                                           "key": key}
+                joining.append((slot, req, False))
+                if monitor.enabled():
+                    monitor.flight.record(
+                        "kv.page", event="alloc", model=model.name,
+                        slot=slot, cross_blocks=len(cross_blocks),
+                        self_blocks=len(self_blocks))
         if not joining:
             return
+        self._publish_blocks()
+        prefilling = [(slot, req) for slot, req, shared in joining
+                      if not shared]
         src = np.zeros((model.slots, model.max_prompt_len, 1), np.int64)
         active = np.zeros((model.slots,), np.float32)
-        for slot, req in joining:
+        for slot, req in prefilling:
             src[slot, :len(req.prompt), 0] = req.prompt
             active[slot] = 1.0
-        traces = [req.trace for _, req in joining
+        traces = [req.trace for _, req, _s in joining
                   if req.trace is not None]
+        pre_traces = [req.trace for _, req in prefilling
+                      if req.trace is not None]
         if traces:
             t_pre0 = time.perf_counter()
-            for slot, req in joining:
+            for slot, req, _s in joining:
                 if req.trace is not None:
                     # slot wait: enqueue -> this admission round
                     req.trace.add_span(
                         "queue.wait", tracing.pc_to_epoch(req.t_enqueue),
                         tracing.pc_to_epoch(t_pre0), slot=slot)
-            with tracing.executor_context(traces):
-                model.session.prefill(src, active=active)
+            if prefilling:
+                with tracing.executor_context(pre_traces):
+                    model.session.prefill(src, active=active)
             # ONE masked prefill joins N sequences — the generation
-            # tier's fan-in span
+            # tier's fan-in span (prefix-hit joiners skipped it and get
+            # only queue.wait: their cross cache is already resident)
             t_pre1 = time.perf_counter()
-            tracing.add_shared_span(
-                traces, "prefill", tracing.pc_to_epoch(t_pre0),
-                tracing.pc_to_epoch(t_pre1), joined=len(joining))
-            for _, req in joining:
+            if pre_traces:
+                tracing.add_shared_span(
+                    pre_traces, "prefill", tracing.pc_to_epoch(t_pre0),
+                    tracing.pc_to_epoch(t_pre1), joined=len(prefilling))
+            for _, req, _s in joining:
                 if req.trace is not None:
                     # first decode.step span clamps to this: a joiner's
                     # iteration accounting must not overlap its prefill
@@ -533,11 +724,14 @@ class ContinuousBatcher:
                 # flight), leave it — their next iteration span must
                 # keep the prefill stall they just sat through.
                 self._t_anchor = time.perf_counter()
-        else:
+        elif prefilling:
             model.session.prefill(src, active=active)
-        if monitor.enabled():
+        if monitor.enabled() and prefilling:
+            # counts actually-prefilled lanes: N same-prefix joiners
+            # move this by exactly 1 (the leader)
             monitor.counter(
-                f"serving.gen.{model.name}.prefills").inc(len(joining))
+                f"serving.gen.{model.name}.prefills").inc(
+                len(prefilling))
 
     def _step(self) -> bool:
         """One coalesced decode step for every occupied slot; returns
@@ -551,6 +745,30 @@ class ContinuousBatcher:
             np.float32)
         if not active.any():
             return False
+        if model.paged and model._shared_self_slots:
+            # copy-on-write guard for forked sequences (fork_slot, the
+            # speculative-decode skeleton): any slot about to append
+            # into a self block it shares gets a private copy first, so
+            # the divergent write can't corrupt its sharer.  Unforked
+            # serving never enters here — the set stays empty.
+            p = model.session.p
+            scope = model.session.scope
+            lens = p.self_cache.lengths(scope)
+            copies = 0
+            for slot in sorted(model._shared_self_slots):
+                if active[slot] and p.self_cache.cow_if_shared(
+                        scope, slot, int(lens[slot])):
+                    copies += 1
+                    info = self._slot_blocks[slot]
+                    if info is not None:
+                        info["self"] = p.self_cache.slot_blocks(
+                            scope, slot, int(lens[slot]) + 1)
+            if copies and monitor.enabled():
+                monitor.counter(
+                    f"generation.{model.name}.cow_copies_total").inc(
+                    copies)
+                monitor.flight.record("kv.page", event="cow",
+                                      model=model.name, copies=copies)
         # iteration-level accounting (the Orca pattern): one decode.step
         # span per scheduled iteration in EVERY occupied slot's trace,
         # carrying the slot + occupancy; covers the whole iteration
@@ -585,6 +803,7 @@ class ContinuousBatcher:
                 # iteration boundary instead of decoding the rest of
                 # its budget
                 self._slot_req[slot] = None
+                self._release_slot(slot)
                 if req.trace is not None:
                     req.trace.finish(
                         status="expired" if expired else "cancelled")
@@ -621,6 +840,7 @@ class ContinuousBatcher:
                                  else "max_tokens"),
                 }
                 self._slot_req[slot] = None  # retire the slot
+                self._release_slot(slot)
                 finished.append(req)
         if traced:
             t_it1 = time.perf_counter()
@@ -686,6 +906,7 @@ class ContinuousBatcher:
             if req is None:
                 continue
             self._slot_req[slot] = None
+            self._release_slot(slot)
             req.error = exc
             if req.trace is not None:
                 req.trace.finish(status="error:step")
@@ -718,6 +939,8 @@ class ContinuousBatcher:
             # crash drains its callers, with the NAMED 503 error
             slotted = [r for r in self._slot_req if r is not None]
             self._slot_req = [None] * self.model.slots
+            for slot in range(self.model.slots):
+                self._release_slot(slot)
             for r in slotted:
                 r.error = Unavailable(
                     f"generation batcher for {self.model.name!r} stopped",

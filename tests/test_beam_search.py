@@ -3,6 +3,7 @@
 layers.beam_search nn.py:3833, tests/book/test_machine_translation.py)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import layers
@@ -116,6 +117,7 @@ def _copy_task_batch(rng, batch, seq, vocab, bos, eos):
     }, src
 
 
+@pytest.mark.slow
 def test_transformer_beam_decode_end_to_end():
     """Train a tiny transformer on the copy task, then beam-decode through
     the in-program While loop and check it reproduces the source."""

@@ -7,6 +7,7 @@ label_semantic_roles), test_data_feed.py (CTR)).  All datasets run in
 synthetic offline mode."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import layers
@@ -44,6 +45,7 @@ def test_fit_a_line_uci_housing():
     assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
 
 
+@pytest.mark.slow
 def test_image_classification_cifar_resnet():
     """reference tests/book/test_image_classification.py (resnet_cifar10)."""
     from paddle_tpu.models import resnet as R

@@ -604,9 +604,12 @@ class TestZeroCostOff:
         assert ops_off == ops_leg
         assert "conv2d_bn" not in ops_off
 
+    @pytest.mark.slow
     def test_flag_off_hlo_identical_to_legacy(self):
         """...and its compiled train step is HLO-identical (trace-time
-        flag off too: the batch_norm lowering takes the reference path)."""
+        flag off too: the batch_norm lowering takes the reference path).
+        Slow lane: the op-sequence identity above is the fast tripwire;
+        this compiles both towers to cross-check the HLO text."""
         with _fused_bn(False):
             exe = pt.Executor(pt.CPUPlace())
             prog_off, startup_off, loss_off = _build_mini("NHWC", False)
@@ -626,6 +629,7 @@ class TestZeroCostOff:
 
 
 class TestBnFusionReport:
+    @pytest.mark.slow
     def test_fused_path_removes_channel_reduction_passes(self):
         """tools/hlo_diag.py --bn-fusion on the mini tower: the reference
         HLO is full of BN-stat channel reductions over 4-D activations

@@ -342,6 +342,7 @@ class TestTrajectoryParity:
         for n in tf:
             np.testing.assert_allclose(tf[n], tu[n], rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_deepfm_train_step_parity(self):
         """The acceptance A/B on the real DeepFM train step (26 slots,
         both table groups, lazy adam), duplicate-ids batch included."""

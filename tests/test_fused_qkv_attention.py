@@ -287,6 +287,7 @@ class TestOpProgram:
             np.testing.assert_allclose(pf[k], pr[k], rtol=5e-4, atol=1e-6,
                                        err_msg=k)
 
+    @pytest.mark.slow
     def test_dropout_trajectory_identical(self):
         """Dropout ON: the in-kernel hash masks key on the same (seed,
         head, plane-index) tuples as the unfused kernels, so even the
@@ -308,11 +309,13 @@ class TestOpProgram:
                 (p.name, tuple(p.shape)) for p in prog.all_parameters())
         assert shapes[True] == shapes[False]
 
+    @pytest.mark.slow
     def test_checkpoint_interop_across_flag(self):
         """Train 2 steps with the flag ON, transplant the checkpoint into
         a flag-OFF program (and back), evaluate: identical losses — the
         packed [dm, 3hd]/[hd, dm] parameters are the same tensors either
-        way."""
+        way.  Slow lane: test_param_names_identical_across_flag is the
+        fast tripwire for the same interop contract."""
         _, params = _trained(True)
 
         def eval_with(flag, params):
@@ -462,7 +465,10 @@ class TestZeroCostOff:
         assert "split" not in ops
         assert "fused_attention" not in ops
 
+    @pytest.mark.slow
     def test_flag_off_hlo_identical_to_legacy(self):
+        # slow lane: the op-sequence identity above is the fast
+        # tripwire; this compiles both nets to cross-check the HLO text
         with _fused_qkv(False):
             exe = pt.Executor(pt.CPUPlace())
             prog_off, st_off, loss_off = _build_mha_net(self._model_mha)

@@ -444,6 +444,7 @@ class TestRecompute:
                 np.asarray(scope.find_var(p.name)),
                 np.asarray(scope2.find_var(p.name)))
 
+    @pytest.mark.slow
     def test_tiny_transformer_reduction_and_parity(self):
         prog, start, loss_name, feeds = _tiny_transformer()
         pt.amp.enable(prog)

@@ -249,3 +249,34 @@ def test_int8_freeze_shared_weight():
     ref, got = np.asarray(ref), np.asarray(got)
     err = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-6)
     assert err < 0.1, err
+
+
+def test_stack_and_streaming_auc():
+    """layers.stack + the streaming auc op outside the deepfm trainer
+    (their only other in-tree user): stack matches np.stack, and the
+    persistent StatPos/StatNeg histograms accumulate across runs — a
+    perfectly-separating predictor converges to AUC 1.0."""
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        a = layers.data(name="sa", shape=[4], dtype="float32")
+        c = layers.data(name="sb", shape=[4], dtype="float32")
+        st = layers.stack([a, c], axis=1)
+        pred = layers.data(name="pred", shape=[2], dtype="float32")
+        lbl = layers.data(name="lbl", shape=[1], dtype="int64")
+        auc_var, _states = layers.auc(input=pred, label=lbl,
+                                      num_thresholds=255)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    av = rng.randn(3, 4).astype("float32")
+    cv = rng.randn(3, 4).astype("float32")
+    pos = np.array([0.9, 0.8, 0.2], "float32")
+    feed = {"sa": av, "sb": cv,
+            "pred": np.stack([1 - pos, pos], axis=1),
+            "lbl": np.array([[1], [1], [0]], "int64")}
+    with pt.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for _ in range(2):  # second run reads back the stat state
+            s, auc = exe.run(prog, feed=feed, fetch_list=[st, auc_var],
+                             scope=scope)
+    np.testing.assert_allclose(np.asarray(s), np.stack([av, cv], axis=1))
+    assert float(np.asarray(auc)) == pytest.approx(1.0)

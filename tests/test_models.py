@@ -7,6 +7,7 @@ import paddle_tpu as pt
 from paddle_tpu import layers
 
 
+@pytest.mark.slow
 def test_deepfm_trains():
     from paddle_tpu.models import deepfm
 

@@ -362,6 +362,7 @@ def _transformer_parity(pp, scheds, n_layer, steps=2):
                 a, b, rtol=3e-7, atol=0, err_msg=str((pp, sched, i)))
 
 
+@pytest.mark.slow
 def test_transformer_pp2_bit_parity():
     """The acceptance gate, tier-1 shape: pp=2 transformer, dropout ON,
     GPipe AND 1F1B — state bit-parity + loss trajectory to the ulp."""

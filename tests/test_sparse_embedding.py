@@ -139,6 +139,7 @@ def test_selected_rows_merge():
     np.testing.assert_allclose(np.asarray(sr.to_dense()), dense)
 
 
+@pytest.mark.slow
 def test_deepfm_full_hash_dim_trains():
     """The dist_ctr.py north-star config: 26 slots x hash_dim=1,000,001.
     Viable only because grads are row-sparse — the dense path would

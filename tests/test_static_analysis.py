@@ -320,10 +320,24 @@ class TestNoFalsePositives:
         assert set(report) == {
             "attention", "qkv_attention", "conv_bn", "dropout_epilogue",
             "embedding", "ring_attention", "decode_attention",
-            "decode_step",
+            "decode_step", "paged_decode_attention", "paged_decode_step",
         }
         for fam, rows in report.items():
             assert rows, fam
+        # paged matrix contract: the capacity pair accepts, the
+        # misaligned-pool and oversized-table rows reject (block_t is
+        # pool geometry — never snapped)
+        paged = {r["label"]: r["accepted"]
+                 for r in report["paged_decode_attention"]}
+        assert paged["paged-base-b1"] and paged["paged-base-b64"]
+        assert not paged["paged-bt12-reject"]
+        assert not paged["paged-table-overflow-reject"]
+        pstep = {r["label"]: r for r in report["paged_decode_step"]}
+        assert pstep["paged-megastep-base"]["accepted"]
+        assert pstep["paged-megastep-fused-ffn"]["fuse_ffn"]
+        assert not pstep["paged-megastep-bt12-reject"]["accepted"]
+        assert not pstep[
+            "paged-megastep-table-overflow-reject"]["accepted"]
         # the perf-critical plans ACCEPT (no silent fallback regression)
         acc = {r["label"]: r.get("accepted") for r in report["attention"]}
         assert acc["transformer-base-f32"] and acc["bert-base-bf16"]

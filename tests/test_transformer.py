@@ -370,6 +370,7 @@ def test_fused_attention_layer_in_program():
     np.testing.assert_allclose(o, np.asarray(ref), atol=2e-2, rtol=2e-2)
 
 
+@pytest.mark.slow
 def test_transformer_with_flash_matches_unfused():
     # same seed -> same params; flash vs unfused attention give same loss
     prog_a, prog_b = pt.Program(), pt.Program()

@@ -207,9 +207,43 @@ assert max(fused["config"]["runs"]) >= min(unfused["config"]["runs"]), (
 print(f"decode megastep gate OK: fused b1 {fused['value']:.1f} vs "
       f"unfused {unfused['value']:.1f} tokens/sec "
       f"(runs {fused['config']['runs']} / {unfused['config']['runs']})")
+# paged KV-cache capacity gate (ISSUE 20): at the fixed smoke HBM
+# budget the paged layout must admit >= 2x the sequences the ring
+# layout does (it charges blocks actually touched, not full rings),
+# and the bench's resident-bytes claim must match the memory planner's
+# kv_cache row (the hlo_diag --memory number) within 1%
+paged = pairs.get("decode_tokens_per_sec_b1_paged")
+assert paged is not None, f"need the paged b1 record, have {sorted(pairs)}"
+assert paged["config"]["paged"] is True and paged["config"]["compile_flat"]
+r_slots = fused["config"]["concurrent_slots_at_budget"]
+p_slots = paged["config"]["concurrent_slots_at_budget"]
+ratio = p_slots / max(r_slots, 1)
+assert ratio >= 2.0, (
+    f"paged capacity gate RED: {p_slots} paged vs {r_slots} ring slots "
+    f"at {paged['config']['kv_budget_bytes']} bytes (ratio {ratio:.2f} "
+    f"< 2.0)")
+for rec in (fused, paged):
+    resident = rec["config"]["kv_resident_gb"] * 1e9
+    row = rec["config"]["planner_kv_cache_bytes"]
+    assert abs(row - resident) <= 0.01 * resident, (
+        f"planner kv_cache row {row} disagrees with bench resident "
+        f"bytes {resident:.0f} ({rec['metric']})")
+with open("ci_artifacts/kv_capacity_gate.json", "w") as f:
+    json.dump({"ring_slots_at_budget": r_slots,
+               "paged_slots_at_budget": p_slots,
+               "capacity_ratio": round(ratio, 2),
+               "budget_bytes": paged["config"]["kv_budget_bytes"],
+               "ring_bytes_per_seq": fused["config"]["kv_bytes_per_seq"],
+               "paged_bytes_per_seq": paged["config"]["kv_bytes_per_seq"],
+               "paged_tokens_per_sec_per_hbm_gb":
+                   paged["config"]["tokens_per_sec_per_hbm_gb"]}, f,
+              indent=1)
+print(f"paged capacity gate OK: {p_slots} paged vs {r_slots} ring "
+      f"slots at budget (ratio {ratio:.2f} >= 2.0)")
 print("decode A/B records OK:", [(r["config"]["kv_cache"], r["metric"],
                                   r["value"]) for r in recs])
 PY
+  echo "-- paged capacity gate artifact: ci_artifacts/kv_capacity_gate.json"
   echo "-- decode A/B record artifact: ci_artifacts/bench_decode_smoke.json"
   # Pipeline-parallel leg (PERF.md r11): pp=2 GPipe vs 1F1B vs single-
   # program run_accumulated on the CPU mesh — every pipeline record must

@@ -210,6 +210,33 @@ def embedding_census(doc: dict):
     return last
 
 
+def kv_page_activity(doc: dict):
+    """Per-model aggregation of the paged-KV-cache `kv.page` flight
+    events (serving/generation.py ContinuousBatcher: block alloc/free,
+    shared-prefix hits, copy-on-write copies)."""
+    agg = {}
+    for ev in doc.get("flight", {}).get("events", []):
+        if ev.get("kind") != "kv.page":
+            continue
+        a = agg.setdefault(ev.get("model", "?"),
+                           {"alloc": 0, "hit": 0, "free": 0, "cow": 0,
+                            "blocks_alloc": 0, "blocks_shared": 0})
+        event = ev.get("event", "?")
+        if event == "alloc":
+            a["alloc"] += 1
+            a["blocks_alloc"] += (int(ev.get("self_blocks", 0))
+                                  + int(ev.get("cross_blocks", 0)))
+        elif event == "hit":
+            a["hit"] += 1
+            a["blocks_alloc"] += int(ev.get("self_blocks", 0))
+            a["blocks_shared"] += int(ev.get("shared_blocks", 0))
+        elif event == "free":
+            a["free"] += 1
+        elif event == "cow":
+            a["cow"] += int(ev.get("copies", 1))
+    return agg
+
+
 def report(doc: dict, k: int = 20) -> str:
     lines = []
     hdr = doc.get("flight", {}).get("header", {})
@@ -306,8 +333,9 @@ def report(doc: dict, k: int = 20) -> str:
             if by:
                 lines.append("    at peak: " + ", ".join(
                     f"{c} {float(by.get(c, 0)) / 1e6:.2f} MB"
-                    for c in ("params", "opt_state", "activations",
-                              "workspace", "feeds") if by.get(c)))
+                    for c in ("params", "opt_state", "kv_cache",
+                              "activations", "workspace", "feeds")
+                    if by.get(c)))
 
     stages, sched = pipeline_stages(doc)
     if stages or sched:
@@ -370,6 +398,20 @@ def report(doc: dict, k: int = 20) -> str:
                     f"padded={ev.get('padded_rows')} "
                     f"bucket={pad.get('bucket')} "
                     f"fill={pad.get('fill')}")
+
+    pages = kv_page_activity(doc)
+    if pages:
+        lines.append("")
+        lines.append("Generation (paged KV cache, kv.page events)")
+        lines.append(
+            f"{'model':<14} {'admits':>7} {'hits':>6} {'frees':>6} "
+            f"{'cow':>5} {'blk alloc':>10} {'blk shared':>11}")
+        for name in sorted(pages):
+            a = pages[name]
+            lines.append(
+                f"{name[:14]:<14} {a['alloc'] + a['hit']:>7} "
+                f"{a['hit']:>6} {a['free']:>6} {a['cow']:>5} "
+                f"{a['blocks_alloc']:>10} {a['blocks_shared']:>11}")
 
     verdict, num_summary, _locates = numerics_info(doc)
     if verdict is not None or num_summary is not None:
